@@ -1,0 +1,28 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+Capability parity with the reference (Ray) — tasks/actors/objects/placement
+groups under a Python API, plus Train/Data/Tune/Serve libraries — re-designed
+for TPU pods: gang-scheduled slices, SPMD meshes, XLA collectives over ICI,
+Pallas kernels for the hot ops.
+"""
+
+from ray_tpu.version import __version__
+
+# Heavy submodules (runtime, jax) are imported lazily so `import ray_tpu`
+# stays cheap for CLI tools.
+_API = (
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
+    "available_resources", "timeline", "ObjectRef", "ActorHandle",
+)
+
+
+def __getattr__(name):
+    if name in _API:
+        from ray_tpu import api
+        return getattr(api, name)
+    if name in ("util", "train", "data", "serve", "tune", "models", "ops",
+                "parallel", "api"):
+        import importlib
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -1,0 +1,117 @@
+"""Central config table for the runtime.
+
+Equivalent in spirit to the reference's ``RAY_CONFIG`` X-macro table
+(reference: src/ray/common/ray_config_def.h) — every tunable has a typed
+default and is overridable from the environment as ``RAY_TPU_<NAME>`` or from
+the ``system_config`` dict handed to :func:`ray_tpu.init`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+
+def _env(name: str, default: Any, typ: type) -> Any:
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if typ in (dict, list):
+        return json.loads(raw)
+    return typ(raw)
+
+
+@dataclass
+class Config:
+    # --- control service (head) ---
+    head_host: str = "127.0.0.1"
+    head_port: int = 0                      # 0 = pick a free port
+    health_check_period_s: float = 1.0      # head -> agent liveness probes
+    health_check_failure_threshold: int = 5
+    kv_max_value_bytes: int = 64 * 1024 * 1024
+
+    # --- node agent / workers ---
+    num_workers_prestart: int = 2           # warm pool per node
+    worker_start_timeout_s: float = 60.0
+    worker_idle_reap_s: float = 600.0
+    max_workers_per_node: int = 64
+
+    # --- scheduling ---
+    scheduler_policy: str = "hybrid"        # hybrid | spread | random
+    hybrid_local_threshold: float = 0.5     # pack locally until this utilization
+    lease_timeout_s: float = 30.0
+
+    # --- object plane ---
+    inline_object_max_bytes: int = 100 * 1024   # small objects ride RPC replies
+    shm_store_bytes: int = 2 * 1024 * 1024 * 1024
+    shm_fallback_dir: str = "/tmp"
+    object_transfer_chunk_bytes: int = 4 * 1024 * 1024
+    object_spill_dir: str = ""              # "" = <session>/spill
+
+    # --- rpc ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_retry_max_attempts: int = 5
+    rpc_retry_backoff_s: float = 0.1
+    # Deterministic fault injection, reference: src/ray/rpc/rpc_chaos.h.
+    # Format: "Method=max_failures:deadline_ms,Method2=..."
+    testing_rpc_failure: str = ""
+
+    # --- tasks / actors ---
+    default_max_task_retries: int = 3
+    default_max_actor_restarts: int = 0
+    actor_call_queue_depth: int = 10_000
+
+    # --- observability ---
+    event_buffer_size: int = 65536
+    metrics_export_interval_s: float = 5.0
+    log_dir: str = ""                       # "" = <session>/logs
+
+    extra: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        # Env overrides apply only to fields left at their class default, so
+        # explicit constructor args beat the environment.
+        for f in fields(self):
+            if f.name == "extra":
+                continue
+            if getattr(self, f.name) != f.default:
+                continue
+            typ = _FIELD_TYPES.get(f.name, str)
+            setattr(self, f.name, _env(f.name, getattr(self, f.name), typ))
+
+    def update(self, overrides: dict[str, Any] | None) -> "Config":
+        for k, v in (overrides or {}).items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+
+# dataclasses.fields gives string annotations under future-annotations;
+# resolve each field's concrete type once so _env can coerce env overrides.
+_TYPES = {"str": str, "int": int, "float": float, "bool": bool,
+          "dict": dict, "list": list}
+_FIELD_TYPES = {
+    f.name: _TYPES.get(str(f.type).replace("builtins.", ""), str)
+    for f in fields(Config)
+}
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config()
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,3 @@
+from ray_tpu.models import llama
+
+__all__ = ["llama"]

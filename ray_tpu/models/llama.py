@@ -1,0 +1,257 @@
+"""Llama-family decoder, TPU-first.
+
+Pure-functional JAX: params are a pytree, layers are stacked on a leading
+axis and driven by ``lax.scan`` (compile time independent of depth), each
+layer rematerialized with ``jax.checkpoint``. Attention dispatches between
+the Pallas flash kernel (single-shard seq), ring attention (context-parallel
+mesh axis), and the XLA reference (CPU tests).
+
+This is the framework's flagship model family — the analog of what reference
+users run through TorchTrainer/vLLM (the reference ships no model code of its
+own for this; see SURVEY.md section 3.4 for the JaxTrainer north-star path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.ops.attention import attention as _attention_op, _on_tpu
+from ray_tpu.ops.ring_attention import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxes:
+    data: str = "data"
+    fsdp: str = "fsdp"
+    tensor: str = "tensor"
+    context: str = "context"
+
+    @property
+    def batch(self):
+        return (self.data, self.fsdp)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = True
+    attn_impl: str = "auto"        # auto | reference | flash | flash_interpret | ring
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def num_params(self) -> int:
+        d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        h, kvh, hd = self.n_heads, self.n_kv_heads, self.head_dim
+        per_layer = d * h * hd + 2 * d * kvh * hd + h * hd * d \
+            + 3 * d * f + 2 * d
+        return v * d + self.n_layers * per_layer + d + d * v
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs/token (fwd+bwd ~ 6*N plus attention term)."""
+        n_matmul = self.num_params() - self.vocab_size * self.dim  # embed is a gather
+        attn = 12 * self.n_layers * self.dim * seq_len  # 2*2*3? qk + pv fwd+bwd
+        return 6.0 * n_matmul + attn
+
+
+def llama2_7b(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama2_13b(**kw) -> LlamaConfig:
+    defaults = dict(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
+                    ffn_dim=13824)
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
+
+
+def llama3_8b(**kw) -> LlamaConfig:
+    defaults = dict(vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+                    n_kv_heads=8, ffn_dim=14336, rope_theta=500000.0,
+                    max_seq_len=8192)
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
+
+
+def tiny(**kw) -> LlamaConfig:
+    defaults = dict(vocab_size=512, dim=128, n_layers=2, n_heads=4,
+                    n_kv_heads=2, ffn_dim=256, max_seq_len=256)
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
+
+
+# --- params ----------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> dict:
+    dtype = jnp.dtype(cfg.dtype)
+    d, f = cfg.dim, cfg.ffn_dim
+    h, kvh, hd, L = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
+    ks = jax.random.split(rng, 9)
+
+    def norm_init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        "embed": norm_init(ks[0], (cfg.vocab_size, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), dtype),
+            "wq": norm_init(ks[1], (L, d, h * hd), d),
+            "wk": norm_init(ks[2], (L, d, kvh * hd), d),
+            "wv": norm_init(ks[3], (L, d, kvh * hd), d),
+            "wo": norm_init(ks[4], (L, h * hd, d), h * hd),
+            "mlp_norm": jnp.ones((L, d), dtype),
+            "w_gate": norm_init(ks[5], (L, d, f), d),
+            "w_up": norm_init(ks[6], (L, d, f), d),
+            "w_down": norm_init(ks[7], (L, f, d), f),
+        },
+        "final_norm": jnp.ones((d,), dtype),
+        "lm_head": norm_init(ks[8], (d, cfg.vocab_size), d),
+    }
+
+
+def param_shardings(cfg: LlamaConfig, axes: MeshAxes = MeshAxes()) -> dict:
+    """PartitionSpec pytree matching init_params. Megatron-style tensor
+    sharding + FSDP on the complementary dim."""
+    t, fs = axes.tensor, axes.fsdp
+    return {
+        "embed": P(t, fs),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, fs, t),
+            "wk": P(None, fs, t),
+            "wv": P(None, fs, t),
+            "wo": P(None, t, fs),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, fs, t),
+            "w_up": P(None, fs, t),
+            "w_down": P(None, t, fs),
+        },
+        "final_norm": P(None),
+        "lm_head": P(fs, t),
+    }
+
+
+# --- forward ---------------------------------------------------------------
+
+def _rmsnorm(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def _rope(x, positions, theta):
+    """x: (b, s, h, d). Rotates pairs (d/2 split)."""
+    b, s, h, d = x.shape
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None].astype(jnp.float32) * freqs  # (b, s, half)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _attend(q, k, v, cfg: LlamaConfig, mesh: Optional[Mesh],
+            axes: MeshAxes):
+    impl = cfg.attn_impl
+    if mesh is None:
+        if impl in ("auto", "ring"):
+            impl = "flash" if _on_tpu() and q.shape[1] >= 128 \
+                else "reference"
+        return _attention_op(q, k, v, causal=True, impl=impl)
+
+    cp = mesh.shape.get(axes.context, 1)
+    bspec = P(axes.batch, axes.context, axes.tensor, None)
+
+    if cp > 1 or impl == "ring":
+        def f(q, k, v):
+            return ring_attention(q, k, v, axis_name=axes.context)
+        return jax.shard_map(f, mesh=mesh, in_specs=(bspec, bspec, bspec),
+                             out_specs=bspec)(q, k, v)
+
+    if impl == "auto":
+        impl = "flash" if _on_tpu() and q.shape[1] >= 128 \
+            else "reference"
+
+    def f(q, k, v):
+        return _attention_op(q, k, v, causal=True, impl=impl)
+    # check_vma=False: pallas_call outputs carry no vma under shard_map.
+    return jax.shard_map(f, mesh=mesh, in_specs=(bspec, bspec, bspec),
+                         out_specs=bspec, check_vma=False)(q, k, v)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+            mesh: Optional[Mesh] = None,
+            axes: MeshAxes = MeshAxes()) -> jax.Array:
+    """tokens: (batch, seq) int32 -> logits (batch, seq, vocab) float32."""
+    b, s = tokens.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def act_constraint(x, spec):
+        if mesh is not None:
+            return lax.with_sharding_constraint(
+                x, jax.sharding.NamedSharding(mesh, spec))
+        return x
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+    x = act_constraint(x, P(axes.batch, axes.context, None))
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+
+    def layer(x, lp):
+        # attention block
+        y = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (y @ lp["wq"]).reshape(b, s, h, hd)
+        k = (y @ lp["wk"]).reshape(b, s, kvh, hd)
+        v = (y @ lp["wv"]).reshape(b, s, kvh, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        o = _attend(q, k, v, cfg, mesh, axes).astype(x.dtype)
+        x = x + (o.reshape(b, s, h * hd) @ lp["wo"])
+        x = act_constraint(x, P(axes.batch, axes.context, None))
+        # mlp block
+        y = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(y @ lp["w_gate"])
+        up = y @ lp["w_up"]
+        x = x + ((gate * up) @ lp["w_down"])
+        x = act_constraint(x, P(axes.batch, axes.context, None))
+        return x, None
+
+    step = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = lax.scan(step, x, params["layers"])
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits
+
+
+def loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
+            mesh: Optional[Mesh] = None,
+            axes: MeshAxes = MeshAxes()) -> jax.Array:
+    """batch: {"tokens": (b, s), "targets": (b, s), "mask": optional}."""
+    logits = forward(params, batch["tokens"], cfg, mesh, axes)
+    targets = batch["targets"]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    mask = batch.get("mask")
+    if mask is None:
+        return jnp.mean(nll)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,121 @@
+"""Attention ops: jnp reference + Pallas flash attention with custom VJP.
+
+Public entry point is :func:`attention` which dispatches to the Pallas kernel
+on TPU (or interpret mode when forced) and to the XLA reference elsewhere.
+Shapes follow (batch, seq, heads, head_dim); GQA is supported by num_kv_heads
+dividing num_heads.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.pallas import flash_attention as _fa
+
+
+def _repeat_kv(k: jax.Array, num_heads: int) -> jax.Array:
+    """(b, s, kv_heads, d) -> (b, s, num_heads, d) for GQA."""
+    b, s, kvh, d = k.shape
+    if kvh == num_heads:
+        return k
+    if num_heads % kvh:
+        raise ValueError(f"num_heads {num_heads} not divisible by kv_heads {kvh}")
+    reps = num_heads // kvh
+    return jnp.repeat(k, reps, axis=2)
+
+
+def mha_reference(q, k, v, *, causal: bool = True,
+                  sm_scale: Optional[float] = None,
+                  segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Plain XLA attention. (b, s, h, d) layout. O(S^2) memory — the
+    correctness oracle and the CPU-test path."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg_mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# --- flash attention with custom vjp (pallas fwd + pallas bwd) -------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    return _fa.flash_attention_fwd(q, k, v, sm_scale=sm_scale, causal=causal,
+                                   block_q=block_q, block_k=block_k,
+                                   interpret=interpret)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o = _fa.flash_attention_fwd(q, k, v, sm_scale=sm_scale, causal=causal,
+                                block_q=block_q, block_k=block_k,
+                                interpret=interpret)
+    return o, (q, k, v, o)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o = res
+    dq, dk, dv = _fa.flash_attention_bwd(
+        q, k, v, o, do, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False) -> jax.Array:
+    """Pallas flash attention, (b, s, h, d) layout, differentiable."""
+    b, sq, h, d = q.shape
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    sk = k.shape[1]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    # (b, s, h, d) -> (b*h, s, d)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    of = _flash(qf, kf, vf, scale, causal, block_q, block_k, interpret)
+    return of.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def attention(q, k, v, *, causal: bool = True,
+              sm_scale: Optional[float] = None,
+              impl: str = "auto") -> jax.Array:
+    """Dispatch: 'auto' uses the Pallas kernel on TPU for seq >= 128 and the
+    XLA reference otherwise. 'flash' / 'reference' force a path;
+    'flash_interpret' runs the kernel in interpret mode (CPU tests)."""
+    if impl == "auto":
+        impl = "flash" if (_on_tpu() and q.shape[1] >= 128) else "reference"
+    if impl == "reference":
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if impl == "flash_interpret":
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               interpret=True)
+    raise ValueError(f"unknown attention impl: {impl}")

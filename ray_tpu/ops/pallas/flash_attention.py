@@ -1,0 +1,357 @@
+"""Pallas TPU flash-attention (forward + backward kernels).
+
+The hot op of the framework's model stack. Online-softmax tiling keeps the
+S x S score matrix out of HBM; blocks are sized for the MXU (128 lanes) and
+VMEM residency. Used by :mod:`ray_tpu.ops.attention` which wires it into a
+``jax.custom_vjp``.
+
+Sequence lengths need not divide the block size: wrappers zero-pad to block
+multiples and kernels mask out-of-bounds columns (padded rows are sliced off
+and padded inputs are zeros, so gradients through padding vanish).
+
+Capability analog of what the reference delegates to vLLM/FlashAttention CUDA
+kernels (reference has no TPU attention kernel; see SURVEY.md section 5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128  # m/l scratch are broadcast along the lane dim
+
+
+def _pad_seq(x, block):
+    """Zero-pad (bh, s, d) along s to a multiple of block."""
+    s = x.shape[1]
+    pad = (-s) % block
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+
+
+def _mask_s(s, qi, ki, block_q, block_k, kv_len, causal):
+    """Bounds + causal mask for a (block_q, block_k) score tile."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    keep = cols < kv_len
+    if causal:
+        keep = jnp.logical_and(keep, rows >= cols)
+    return jnp.where(keep, s, NEG_INF), keep
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Last kv block this q block attends to (inclusive).
+    if causal:
+        last_k = jnp.minimum(num_kv_blocks - 1,
+                             ((qi + 1) * block_q - 1) // block_k)
+    else:
+        last_k = num_kv_blocks - 1
+
+    @pl.when(ki <= last_k)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)            # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)            # (block_k, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s, keep = _mask_s(s * sm_scale, qi, ki, block_q, block_k,
+                          kv_len, causal)
+
+        m_prev = m_scr[...][:, :1]                  # (block_q, 1)
+        l_prev = l_scr[...][:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # (block_q, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.where(keep, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)             # (block_q, 1)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+        acc = acc_scr[...]
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        acc_scr[...] = acc
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == last_k)
+    def _finalize():
+        l = l_scr[...][:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)             # fully-masked rows
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def flash_attention_fwd(q, k, v, *, sm_scale, causal, block_q=128, block_k=128,
+                        interpret=False):
+    """q,k,v: (BH, S, D) -> o: (BH, S, D)."""
+    bh, sq, d = q.shape
+    _, sk, _ = k.shape
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    qp, kp, vp = _pad_seq(q, block_q), _pad_seq(k, block_k), _pad_seq(v, block_k)
+    nq = qp.shape[1] // block_q
+    nk = kp.shape[1] // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_kv_blocks=nk, kv_len=sk)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :sq]
+
+
+# ---------------------------------------------------------------------------
+# Backward. Kernels: (1) row logsumexp (flash-style recompute); (2) dk/dv with
+# grid over kv blocks, inner loop over q blocks; (3) dq with grid over q
+# blocks, inner loop over kv blocks. p is recomputed per tile from q,k and
+# lse; delta = rowsum(do * o).
+# ---------------------------------------------------------------------------
+
+def _lse_kernel(q_ref, k_ref, lse_ref, m_scr, l_scr,
+                *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    if causal:
+        last_k = jnp.minimum(num_kv_blocks - 1,
+                             ((qi + 1) * block_q - 1) // block_k)
+    else:
+        last_k = num_kv_blocks - 1
+
+    @pl.when(ki <= last_k)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        s, keep = _mask_s(s, qi, ki, block_q, block_k, kv_len, causal)
+        m_prev = m_scr[...][:, :1]
+        l_prev = l_scr[...][:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.where(keep, jnp.exp(s - m_new), 0.0)
+        l_new = jnp.exp(m_prev - m_new) * l_prev + jnp.sum(
+            p, axis=-1, keepdims=True)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == last_k)
+    def _finalize():
+        m = m_scr[...][:, :1]
+        l = l_scr[...][:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), lse_ref[0].shape)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, sm_scale, causal, block_q, block_k, num_q_blocks, kv_len):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    if causal:
+        first_q = (ki * block_k) // block_q
+        should_run = qi >= first_q
+    else:
+        should_run = qi >= 0
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # (bq, d)
+        k = k_ref[0].astype(jnp.float32)            # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)          # (bq, d)
+        lse = lse_ref[0][:, :1]                     # (bq, 1)
+        delta = delta_ref[0][:, :1]                 # (bq, 1)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        s, keep = _mask_s(s, qi, ki, block_q, block_k, kv_len, causal)
+        p = jnp.where(keep, jnp.exp(s - lse), 0.0)  # (bq, bk)
+        # dv += p^T do
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        # dp = do v^T ; ds = p * (dp - delta)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr,
+               *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_len):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    if causal:
+        last_k = jnp.minimum(num_kv_blocks - 1,
+                             ((qi + 1) * block_q - 1) // block_k)
+    else:
+        last_k = num_kv_blocks - 1
+
+    @pl.when(ki <= last_k)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        s, keep = _mask_s(s, qi, ki, block_q, block_k, kv_len, causal)
+        p = jnp.where(keep, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == last_k)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def flash_attention_bwd(q, k, v, o, do, *, sm_scale, causal,
+                        block_q=128, block_k=128, interpret=False):
+    bh, sq, d = q.shape
+    _, sk, _ = k.shape
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    qp = _pad_seq(q, block_q)
+    kp, vp = _pad_seq(k, block_k), _pad_seq(v, block_k)
+    op, dop = _pad_seq(o, block_q), _pad_seq(do, block_q)
+    sqp, skp = qp.shape[1], kp.shape[1]
+    nq = sqp // block_q
+    nk = skp // block_k
+
+    lse = pl.pallas_call(
+        functools.partial(_lse_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_kv_blocks=nk,
+                          kv_len=sk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sqp, LANES), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp)
+
+    delta = jnp.sum(dop.astype(jnp.float32) * op.astype(jnp.float32),
+                    axis=-1)                                  # (bh, sqp)
+    delta = jnp.broadcast_to(delta[:, :, None], (bh, sqp, LANES))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq,
+                          kv_len=sk),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, ki, qi: (b, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, skp, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, skp, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_kv_blocks=nk,
+                          kv_len=sk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sqp, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, delta)
+
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]

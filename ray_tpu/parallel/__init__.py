@@ -1,0 +1,3 @@
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh, make_train_step, TrainState
+
+__all__ = ["MeshSpec", "make_mesh", "make_train_step", "TrainState"]

@@ -1,0 +1,133 @@
+"""Device mesh + SPMD train step.
+
+The TPU-native answer to the reference's whole parallelism-strategy table
+(SURVEY.md section 2.3): DP/FSDP/TP/CP are axes of ONE ``jax.sharding.Mesh``;
+XLA GSPMD inserts the collectives (psum for grads over data/fsdp,
+reduce-scatter/all-gather for fsdp params, all-reduce for tensor partials,
+ppermute rings for the context axis via ray_tpu.ops.ring_attention).
+
+Where the reference wires NCCL process groups per strategy
+(reference: python/ray/util/collective/collective.py:303), here the only
+"backend setup" is building the mesh; sharding annotations do the rest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import llama
+from ray_tpu.models.llama import LlamaConfig, MeshAxes
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Axis sizes; -1 means "absorb all remaining devices" (at most one)."""
+    data: int = 1
+    fsdp: int = -1
+    tensor: int = 1
+    context: int = 1
+    axes: MeshAxes = MeshAxes()
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {self.axes.data: self.data, self.axes.fsdp: self.fsdp,
+                 self.axes.tensor: self.tensor, self.axes.context: self.context}
+        unknown = [a for a, s in sizes.items() if s == -1]
+        known = 1
+        for s in sizes.values():
+            if s != -1:
+                known *= s
+        if len(unknown) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if unknown:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {known}")
+            sizes[unknown[0]] = n_devices // known
+        total = 1
+        for s in sizes.values():
+            total *= s
+        if total != n_devices:
+            raise ValueError(f"mesh {sizes} != {n_devices} devices")
+        return sizes
+
+
+def make_mesh(spec: MeshSpec = MeshSpec(),
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = spec.resolve(len(devices))
+    names = tuple(sizes.keys())
+    shape = tuple(sizes.values())
+    import numpy as np
+    return Mesh(np.asarray(devices).reshape(shape), names)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def default_optimizer(learning_rate: float = 3e-4,
+                      weight_decay: float = 0.1,
+                      warmup_steps: int = 100,
+                      total_steps: int = 10_000,
+                      grad_clip: float = 1.0) -> optax.GradientTransformation:
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def make_train_step(cfg: LlamaConfig, mesh: Mesh,
+                    axes: MeshAxes = MeshAxes(),
+                    optimizer: Optional[optax.GradientTransformation] = None,
+                    loss_fn: Optional[Callable] = None):
+    """Returns (init_fn(rng) -> TrainState, step_fn(state, batch) ->
+    (state, metrics)). Both jitted with GSPMD sharding: params per
+    llama.param_shardings, batch over (data+fsdp, context), opt state
+    sharded like params by propagation."""
+    opt = optimizer if optimizer is not None else default_optimizer()
+    _loss = loss_fn if loss_fn is not None else (
+        lambda p, b: llama.loss_fn(p, b, cfg, mesh, axes))
+    pspecs = llama.param_shardings(cfg, axes)
+    pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
+                          is_leaf=lambda x: isinstance(x, P))
+    batch_spec = NamedSharding(mesh, P(axes.batch, axes.context))
+
+    @jax.jit
+    def init_fn(rng) -> TrainState:
+        params = jax.lax.with_sharding_constraint(
+            llama.init_params(rng, cfg), pshard)
+        opt_state = opt.init(params)
+        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+    @jax.jit
+    def step_fn(state: TrainState, batch: dict):
+        batch = {k: jax.lax.with_sharding_constraint(v, batch_spec)
+                 for k, v in batch.items()}
+        loss, grads = jax.value_and_grad(_loss)(state.params, batch)
+        updates, opt_state = opt.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        return (TrainState(params, opt_state, state.step + 1),
+                {"loss": loss, "grad_norm": gnorm, "step": state.step + 1})
+
+    return init_fn, step_fn
+
+
+def make_eval_step(cfg: LlamaConfig, mesh: Mesh,
+                   axes: MeshAxes = MeshAxes()):
+    @jax.jit
+    def eval_fn(params, batch):
+        return llama.loss_fn(params, batch, cfg, mesh, axes)
+    return eval_fn

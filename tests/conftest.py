@@ -1,0 +1,31 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+
+Mirrors the reference's multi-node-without-a-cluster strategy
+(reference: python/ray/cluster_utils.py:137) — sharding and multi-chip code
+paths are exercised on virtual devices; real-TPU benchmarking happens in
+bench.py outside pytest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon tunnel overrides JAX_PLATFORMS; force via the config API too
+# (must happen before any backend is initialized).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from ray_tpu.parallel import MeshSpec, make_mesh
+    assert len(jax.devices()) == 8
+    return make_mesh(MeshSpec(data=1, fsdp=2, tensor=2, context=2))

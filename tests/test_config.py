@@ -1,0 +1,30 @@
+"""Config table: env overrides, explicit-beats-env, presets."""
+
+import os
+
+from ray_tpu.config import Config
+from ray_tpu.models import llama
+
+
+def test_config_import_and_defaults():
+    cfg = Config()
+    assert cfg.scheduler_policy == "hybrid"
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_HEAD_PORT", "7001")
+    assert Config().head_port == 7001
+    # explicit constructor arg beats environment
+    assert Config(head_port=8000).head_port == 8000
+
+
+def test_update_and_extra():
+    cfg = Config().update({"head_port": 9, "not_a_field": 1})
+    assert cfg.head_port == 9
+    assert cfg.extra["not_a_field"] == 1
+
+
+def test_llama_presets_accept_overrides():
+    assert llama.llama3_8b(max_seq_len=4096).max_seq_len == 4096
+    assert llama.llama2_13b(n_layers=2).n_layers == 2
+    assert llama.llama2_7b(dtype="float32").dtype == "float32"
