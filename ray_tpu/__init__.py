@@ -19,10 +19,18 @@ _API = (
 
 def __getattr__(name):
     if name in _API:
-        from ray_tpu import api
+        try:
+            from ray_tpu import api
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"ray_tpu.{name} is unavailable: {e}") from e
         return getattr(api, name)
     if name in ("util", "train", "data", "serve", "tune", "models", "ops",
-                "parallel", "api"):
+                "parallel", "api", "runtime"):
         import importlib
-        return importlib.import_module(f"ray_tpu.{name}")
+        try:
+            return importlib.import_module(f"ray_tpu.{name}")
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"ray_tpu.{name} is unavailable: {e}") from e
     raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

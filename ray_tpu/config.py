@@ -72,16 +72,18 @@ class Config:
 
     extra: dict = field(default_factory=dict)
 
-    def __post_init__(self):
-        # Env overrides apply only to fields left at their class default, so
-        # explicit constructor args beat the environment.
-        for f in fields(self):
+    @classmethod
+    def from_env(cls, **overrides) -> "Config":
+        """Defaults <- RAY_TPU_* environment <- explicit overrides.
+        Explicit kwargs always beat the environment, even when their value
+        equals the class default."""
+        kw = {}
+        for f in fields(cls):
             if f.name == "extra":
                 continue
-            if getattr(self, f.name) != f.default:
-                continue
-            typ = _FIELD_TYPES.get(f.name, str)
-            setattr(self, f.name, _env(f.name, getattr(self, f.name), typ))
+            kw[f.name] = _env(f.name, f.default, _FIELD_TYPES.get(f.name, str))
+        kw.update(overrides)
+        return cls(**kw)
 
     def update(self, overrides: dict[str, Any] | None) -> "Config":
         for k, v in (overrides or {}).items():
@@ -108,7 +110,7 @@ _global_config: Config | None = None
 def get_config() -> Config:
     global _global_config
     if _global_config is None:
-        _global_config = Config()
+        _global_config = Config.from_env()
     return _global_config
 
 

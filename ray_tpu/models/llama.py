@@ -181,11 +181,21 @@ def _attend(q, k, v, cfg: LlamaConfig, mesh: Optional[Mesh],
     cp = mesh.shape.get(axes.context, 1)
     bspec = P(axes.batch, axes.context, axes.tensor, None)
 
-    if cp > 1 or impl == "ring":
+    if impl == "ring" or (impl == "auto" and cp > 1):
         def f(q, k, v):
             return ring_attention(q, k, v, axis_name=axes.context)
         return jax.shard_map(f, mesh=mesh, in_specs=(bspec, bspec, bspec),
                              out_specs=bspec)(q, k, v)
+
+    if cp > 1:
+        # Explicit non-ring impl on a context-sharded mesh: run with global
+        # semantics (GSPMD gathers the sequence axis). Only the XLA reference
+        # path supports this — the Pallas kernel can't be auto-partitioned.
+        if impl != "reference":
+            raise ValueError(
+                f"attn_impl={impl!r} cannot run under a context-parallel "
+                f"mesh (context axis size {cp}); use 'ring' or 'auto'")
+        return _attention_op(q, k, v, causal=True, impl=impl)
 
     if impl == "auto":
         impl = "flash" if _on_tpu() and q.shape[1] >= 128 \

@@ -40,13 +40,17 @@ def mha_reference(q, k, v, *, causal: bool = True,
     scale = sm_scale if sm_scale is not None else d ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
+    keep = jnp.ones((b, 1, sq, sk), dtype=bool)
     if causal:
         mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
-        logits = jnp.where(mask[None, None], logits, -1e30)
+        keep = keep & mask[None, None]
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
-        logits = jnp.where(seg_mask[:, None], logits, -1e30)
+        keep = keep & seg_mask[:, None]
+    logits = jnp.where(keep, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
+    # Fully-masked rows produce 0, matching the flash-kernel convention.
+    probs = jnp.where(keep.any(axis=-1, keepdims=True), probs, 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
 
@@ -55,22 +59,23 @@ def mha_reference(q, k, v, *, causal: bool = True,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    return _fa.flash_attention_fwd(q, k, v, sm_scale=sm_scale, causal=causal,
+    o, _ = _fa.flash_attention_fwd(q, k, v, sm_scale=sm_scale, causal=causal,
                                    block_q=block_q, block_k=block_k,
                                    interpret=interpret)
+    return o
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    o = _fa.flash_attention_fwd(q, k, v, sm_scale=sm_scale, causal=causal,
-                                block_q=block_q, block_k=block_k,
-                                interpret=interpret)
-    return o, (q, k, v, o)
+    o, lse = _fa.flash_attention_fwd(q, k, v, sm_scale=sm_scale, causal=causal,
+                                     block_q=block_q, block_k=block_k,
+                                     interpret=interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, o = res
+    q, k, v, o, lse = res
     dq, dk, dv = _fa.flash_attention_bwd(
-        q, k, v, o, do, sm_scale=sm_scale, causal=causal,
+        q, k, v, o, do, lse, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret)
     return dq, dk, dv
 

@@ -35,20 +35,33 @@ def _pad_seq(x, block):
     return jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
 
 
-def _mask_s(s, qi, ki, block_q, block_k, kv_len, causal):
-    """Bounds + causal mask for a (block_q, block_k) score tile."""
+def _mask_s(s, qi, ki, block_q, block_k, kv_len, causal, offset):
+    """Bounds + causal mask for a (block_q, block_k) score tile.
+
+    ``offset = sk - sq`` aligns the causal diagonal with the END of the kv
+    sequence (query i attends keys j <= i + offset), matching mha_reference —
+    e.g. a decode step (sq=1) against a longer KV cache attends everything.
+    """
     rows = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     cols = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     keep = cols < kv_len
     if causal:
-        keep = jnp.logical_and(keep, rows >= cols)
+        keep = jnp.logical_and(keep, rows + offset >= cols)
     return jnp.where(keep, s, NEG_INF), keep
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_len):
+def _last_k_block(qi, block_q, block_k, num_kv_blocks, offset):
+    """Last kv block (inclusive) a causal q block attends to, clamped so the
+    finalize step always fires even for fully-masked q blocks."""
+    last = ((qi + 1) * block_q - 1 + offset) // block_k
+    return jnp.clip(last, 0, num_kv_blocks - 1)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_len,
+                offset):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -60,8 +73,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
     # Last kv block this q block attends to (inclusive).
     if causal:
-        last_k = jnp.minimum(num_kv_blocks - 1,
-                             ((qi + 1) * block_q - 1) // block_k)
+        last_k = _last_k_block(qi, block_q, block_k, num_kv_blocks, offset)
     else:
         last_k = num_kv_blocks - 1
 
@@ -73,7 +85,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s, keep = _mask_s(s * sm_scale, qi, ki, block_q, block_k,
-                          kv_len, causal)
+                          kv_len, causal, offset)
 
         m_prev = m_scr[...][:, :1]                  # (block_q, 1)
         l_prev = l_scr[...][:, :1]
@@ -92,27 +104,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(ki == last_k)
     def _finalize():
+        m = m_scr[...][:, :1]
         l = l_scr[...][:, :1]
         l = jnp.where(l == 0.0, 1.0, l)             # fully-masked rows
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), lse_ref[0].shape)
 
 
 def flash_attention_fwd(q, k, v, *, sm_scale, causal, block_q=128, block_k=128,
                         interpret=False):
-    """q,k,v: (BH, S, D) -> o: (BH, S, D)."""
+    """q,k,v: (BH, S, D) -> (o: (BH, S, D), lse: (BH, S, LANES) f32).
+
+    lse is the row logsumexp saved as a backward residual (lane-broadcast
+    layout; logically (BH, S))."""
     bh, sq, d = q.shape
     _, sk, _ = k.shape
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    offset = sk - sq
     qp, kp, vp = _pad_seq(q, block_q), _pad_seq(k, block_k), _pad_seq(v, block_k)
     nq = qp.shape[1] // block_q
     nk = kp.shape[1] // block_k
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_kv_blocks=nk, kv_len=sk)
+        block_q=block_q, block_k=block_k, num_kv_blocks=nk, kv_len=sk,
+        offset=offset)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -120,8 +139,14 @@ def flash_attention_fwd(q, k, v, *, sm_scale, causal, block_q=128, block_k=128,
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qp.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, qp.shape[1], LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),
             pltpu.VMEM((block_q, LANES), jnp.float32),
@@ -131,60 +156,20 @@ def flash_attention_fwd(q, k, v, *, sm_scale, causal, block_q=128, block_k=128,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :sq]
+    return out[:, :sq], lse[:, :sq]
 
 
 # ---------------------------------------------------------------------------
-# Backward. Kernels: (1) row logsumexp (flash-style recompute); (2) dk/dv with
-# grid over kv blocks, inner loop over q blocks; (3) dq with grid over q
-# blocks, inner loop over kv blocks. p is recomputed per tile from q,k and
-# lse; delta = rowsum(do * o).
+# Backward. lse comes from the forward kernel (saved residual — no recompute
+# pass). Kernels: (1) dk/dv with grid over kv blocks, inner loop over q
+# blocks; (2) dq with grid over q blocks, inner loop over kv blocks. p is
+# recomputed per tile from q,k and lse; delta = rowsum(do * o).
 # ---------------------------------------------------------------------------
-
-def _lse_kernel(q_ref, k_ref, lse_ref, m_scr, l_scr,
-                *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_len):
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
-
-    @pl.when(ki == 0)
-    def _init():
-        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[...] = jnp.zeros_like(l_scr)
-
-    if causal:
-        last_k = jnp.minimum(num_kv_blocks - 1,
-                             ((qi + 1) * block_q - 1) // block_k)
-    else:
-        last_k = num_kv_blocks - 1
-
-    @pl.when(ki <= last_k)
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
-        s, keep = _mask_s(s, qi, ki, block_q, block_k, kv_len, causal)
-        m_prev = m_scr[...][:, :1]
-        l_prev = l_scr[...][:, :1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.where(keep, jnp.exp(s - m_new), 0.0)
-        l_new = jnp.exp(m_prev - m_new) * l_prev + jnp.sum(
-            p, axis=-1, keepdims=True)
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
-
-    @pl.when(ki == last_k)
-    def _finalize():
-        m = m_scr[...][:, :1]
-        l = l_scr[...][:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)
-        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), lse_ref[0].shape)
-
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, sm_scale, causal, block_q, block_k, num_q_blocks, kv_len):
+                *, sm_scale, causal, block_q, block_k, num_q_blocks, kv_len,
+                offset):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -194,7 +179,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     if causal:
-        first_q = (ki * block_k) // block_q
+        # First q block whose rows attend this kv block: i + offset >= ki*bk.
+        first_q = jnp.maximum(0, ki * block_k - offset) // block_q
         should_run = qi >= first_q
     else:
         should_run = qi >= 0
@@ -209,7 +195,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0][:, :1]                 # (bq, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        s, keep = _mask_s(s, qi, ki, block_q, block_k, kv_len, causal)
+        s, keep = _mask_s(s, qi, ki, block_q, block_k, kv_len, causal, offset)
         p = jnp.where(keep, jnp.exp(s - lse), 0.0)  # (bq, bk)
         # dv += p^T do
         dv_scr[...] += jax.lax.dot_general(
@@ -229,7 +215,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dq_ref, dq_scr,
-               *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_len):
+               *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_len,
+               offset):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -238,8 +225,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     if causal:
-        last_k = jnp.minimum(num_kv_blocks - 1,
-                             ((qi + 1) * block_q - 1) // block_k)
+        last_k = _last_k_block(qi, block_q, block_k, num_kv_blocks, offset)
     else:
         last_k = num_kv_blocks - 1
 
@@ -253,7 +239,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        s, keep = _mask_s(s, qi, ki, block_q, block_k, kv_len, causal)
+        s, keep = _mask_s(s, qi, ki, block_q, block_k, kv_len, causal, offset)
         p = jnp.where(keep, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -266,38 +252,21 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def flash_attention_bwd(q, k, v, o, do, *, sm_scale, causal,
+def flash_attention_bwd(q, k, v, o, do, lse, *, sm_scale, causal,
                         block_q=128, block_k=128, interpret=False):
+    """lse: (BH, S, LANES) f32 from flash_attention_fwd."""
     bh, sq, d = q.shape
     _, sk, _ = k.shape
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    offset = sk - sq
     qp = _pad_seq(q, block_q)
     kp, vp = _pad_seq(k, block_k), _pad_seq(v, block_k)
     op, dop = _pad_seq(o, block_q), _pad_seq(do, block_q)
+    lse = _pad_seq(lse, block_q)
     sqp, skp = qp.shape[1], kp.shape[1]
     nq = sqp // block_q
     nk = skp // block_k
-
-    lse = pl.pallas_call(
-        functools.partial(_lse_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_kv_blocks=nk,
-                          kv_len=sk),
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sqp, LANES), jnp.float32),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-            pltpu.VMEM((block_q, LANES), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(qp, kp)
 
     delta = jnp.sum(dop.astype(jnp.float32) * op.astype(jnp.float32),
                     axis=-1)                                  # (bh, sqp)
@@ -306,7 +275,7 @@ def flash_attention_bwd(q, k, v, o, do, *, sm_scale, causal,
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
-                          kv_len=sk),
+                          kv_len=sk, offset=offset),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
@@ -336,7 +305,7 @@ def flash_attention_bwd(q, k, v, o, do, *, sm_scale, causal,
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_kv_blocks=nk,
-                          kv_len=sk),
+                          kv_len=sk, offset=offset),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),

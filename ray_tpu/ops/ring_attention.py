@@ -28,10 +28,14 @@ _NEG = -1e30
 def _chunk_attn(q, k, v, q_off, k_off, causal, scale):
     """One ring step: q local block vs one visiting kv chunk.
 
-    q: (b, sq, h, d); k, v: (b, sk, h, d); offsets are global sequence
-    positions of element 0. Returns (o_unnorm f32, m, l) with shapes
-    ((b, sq, h, d), (b, h, sq), (b, h, sq)).
+    q: (b, sq, h, d); k, v: (b, sk, kvh, d) — GQA heads are expanded HERE,
+    after the ring transfer, so only kvh heads ride the ICI ring. Offsets are
+    global sequence positions of element 0. Returns (o_unnorm f32, m, l) with
+    shapes ((b, sq, h, d), (b, h, sq), (b, h, sq)).
     """
+    from ray_tpu.ops.attention import _repeat_kv
+    k = _repeat_kv(k, q.shape[2])
+    v = _repeat_kv(v, q.shape[2])
     sq, sk = q.shape[1], k.shape[1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
@@ -51,11 +55,10 @@ def _chunk_attn(q, k, v, q_off, k_off, causal, scale):
 
 def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
                    sm_scale: Optional[float] = None) -> jax.Array:
-    """Exact attention with seq sharded over ``axis_name``; (b, s, h, d)."""
-    from ray_tpu.ops.attention import _repeat_kv
+    """Exact attention with seq sharded over ``axis_name``; (b, s, h, d).
+    GQA k/v keep their kvh heads while rotating (n_heads/kvh less ICI
+    traffic); expansion happens per-chunk inside _chunk_attn."""
     b, sq, h, d = q.shape
-    k = _repeat_kv(k, h)
-    v = _repeat_kv(v, h)
     scale = sm_scale if sm_scale is not None else d ** -0.5
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -102,8 +105,9 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
     base = jnp.transpose(zeros[..., 0], (0, 2, 1))      # (b, h, sq)
     m0 = base + _NEG
     l0 = base
-    k = k + zeros.astype(k.dtype) * 0  # unify kv vma with q's as well
-    v = v + zeros.astype(v.dtype) * 0
+    zscalar = jnp.sum(zeros) * 0.0  # scalar carrying q's vma
+    k = k + zscalar.astype(k.dtype)  # unify kv vma with q's as well
+    v = v + zscalar.astype(v.dtype)
     (o, m, l, _, _), _ = lax.scan(
         jax.checkpoint(step), (o0, m0, l0, k, v), jnp.arange(n))
     l = jnp.where(l == 0.0, 1.0, l)

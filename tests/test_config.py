@@ -13,9 +13,12 @@ def test_config_import_and_defaults():
 
 def test_env_override(monkeypatch):
     monkeypatch.setenv("RAY_TPU_HEAD_PORT", "7001")
-    assert Config().head_port == 7001
-    # explicit constructor arg beats environment
-    assert Config(head_port=8000).head_port == 8000
+    assert Config.from_env().head_port == 7001
+    # explicit arg beats environment — even when it equals the class default
+    assert Config.from_env(head_port=8000).head_port == 8000
+    assert Config.from_env(head_port=0).head_port == 0
+    # plain constructor ignores the environment entirely
+    assert Config().head_port == 0
 
 
 def test_update_and_extra():

@@ -52,6 +52,20 @@ def test_flash_grad_matches_reference():
         np.testing.assert_allclose(a, b_, atol=5e-4, rtol=5e-4)
 
 
+@pytest.mark.parametrize("sq,sk", [(1, 256), (64, 256), (256, 64)])
+def test_flash_cross_lengths(sq, sk):
+    """sq != sk aligns the causal diagonal with the END of kv (decode: a
+    single query against a long KV cache attends everything)."""
+    key = jax.random.PRNGKey(7)
+    k1, k2, k3 = jax.random.split(key, 3)
+    q = jax.random.normal(k1, (2, sq, 4, 64))
+    k = jax.random.normal(k2, (2, sk, 4, 64))
+    v = jax.random.normal(k3, (2, sk, 4, 64))
+    ref = A.mha_reference(q, k, v, causal=True)
+    out = A.flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_reference(causal):
     import jax
