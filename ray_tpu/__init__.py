@@ -14,6 +14,8 @@ _API = (
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
     "available_resources", "timeline", "ObjectRef", "ActorHandle",
+    "free", "get_async", "placement_group", "remove_placement_group",
+    "PlacementGroup",
 )
 
 

@@ -59,9 +59,10 @@ def mha_reference(q, k, v, *, causal: bool = True,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    # Primal (inference) path: skip the lse output entirely.
     o, _ = _fa.flash_attention_fwd(q, k, v, sm_scale=sm_scale, causal=causal,
                                    block_q=block_q, block_k=block_k,
-                                   interpret=interpret)
+                                   interpret=interpret, with_lse=False)
     return o
 
 

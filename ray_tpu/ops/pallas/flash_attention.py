@@ -59,9 +59,14 @@ def _last_k_block(qi, block_q, block_k, num_kv_blocks, offset):
     return jnp.clip(last, 0, num_kv_blocks - 1)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_len,
-                offset):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                sm_scale, causal, block_q, block_k, num_kv_blocks, kv_len,
+                offset, with_lse):
+    if with_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref = None
+        m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -108,15 +113,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = l_scr[...][:, :1]
         l = jnp.where(l == 0.0, 1.0, l)             # fully-masked rows
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), lse_ref[0].shape)
+        if with_lse:
+            lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), lse_ref[0].shape)
 
 
 def flash_attention_fwd(q, k, v, *, sm_scale, causal, block_q=128, block_k=128,
-                        interpret=False):
-    """q,k,v: (BH, S, D) -> (o: (BH, S, D), lse: (BH, S, LANES) f32).
+                        interpret=False, with_lse=True):
+    """q,k,v: (BH, S, D) -> (o: (BH, S, D), lse: (BH, S, LANES) f32 | None).
 
     lse is the row logsumexp saved as a backward residual (lane-broadcast
-    layout; logically (BH, S))."""
+    layout; logically (BH, S)). Inference callers pass with_lse=False to
+    skip the extra HBM write (pallas outputs are never DCE'd)."""
     bh, sq, d = q.shape
     _, sk, _ = k.shape
     block_q = min(block_q, sq)
@@ -129,9 +136,17 @@ def flash_attention_fwd(q, k, v, *, sm_scale, causal, block_q=128, block_k=128,
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, num_kv_blocks=nk, kv_len=sk,
-        offset=offset)
+        offset=offset, with_lse=with_lse)
 
-    out, lse = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0))]
+    out_shape = [jax.ShapeDtypeStruct(qp.shape, q.dtype)]
+    if with_lse:
+        out_specs.append(
+            pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((bh, qp.shape[1], LANES), jnp.float32))
+
+    res = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -139,14 +154,8 @@ def flash_attention_fwd(q, k, v, *, sm_scale, causal, block_q=128, block_k=128,
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(qp.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, qp.shape[1], LANES), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),
             pltpu.VMEM((block_q, LANES), jnp.float32),
@@ -156,7 +165,10 @@ def flash_attention_fwd(q, k, v, *, sm_scale, causal, block_q=128, block_k=128,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :sq], lse[:, :sq]
+    if with_lse:
+        out, lse = res
+        return out[:, :sq], lse[:, :sq]
+    return res[0][:, :sq], None
 
 
 # ---------------------------------------------------------------------------

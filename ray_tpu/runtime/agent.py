@@ -1,0 +1,556 @@
+"""Node agent: per-host worker pool, lease scheduler, object plane owner.
+
+The raylet analog (reference: src/ray/raylet/node_manager.h, worker_pool.h,
+scheduling/cluster_lease_manager.h, local_lease_manager.h,
+local_object_manager.h, object_manager/object_manager.h). One agent runs per
+host; it spawns worker processes, grants worker leases with
+HYBRID/SPREAD/affinity policies (spilling back to peer agents using the
+cluster view gossiped via heartbeats), reserves placement-group bundles in
+the 2-phase protocol, owns the node's shared-memory object store, and serves
+chunked object pulls to peer agents.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.config import Config
+from ray_tpu.runtime import rpc
+from ray_tpu.runtime.ids import (ActorID, NodeID, ObjectID,
+                                 PlacementGroupID, WorkerID)
+from ray_tpu.runtime.object_store import SharedObjectStore, _attach
+
+IDLE, LEASED, ACTOR, STARTING, DEAD = (
+    "idle", "leased", "actor", "starting", "dead")
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    proc: Optional[asyncio.subprocess.Process]
+    addr: Optional[Tuple[str, int]] = None
+    state: str = STARTING
+    actor_id: Optional[ActorID] = None
+    actor_resources: Optional[dict] = None
+    actor_pg: Optional[tuple] = None           # (pg_id, bundle_index)
+    lease_id: Optional[str] = None
+    ready: asyncio.Event = field(default_factory=asyncio.Event)
+
+
+@dataclass
+class _Lease:
+    lease_id: str
+    worker: WorkerHandle
+    resources: Dict[str, float]
+    pg_id: Optional[PlacementGroupID] = None
+    bundle_index: Optional[int] = None
+
+
+class NodeAgent:
+    def __init__(self, head_addr: Tuple[str, int],
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 config: Optional[Config] = None,
+                 session_id: str = "default0",
+                 node_id: Optional[NodeID] = None,
+                 env_extra: Optional[Dict[str, str]] = None):
+        self.config = config or Config.from_env()
+        self.env_extra = dict(env_extra or {})
+        self.head_addr = tuple(head_addr)
+        self.node_id = node_id or NodeID.generate()
+        self.session_id = session_id
+        self.labels = dict(labels or {})
+        if resources is None:
+            resources = {"CPU": float(os.cpu_count() or 1)}
+        self.resources_total = dict(resources)
+        self.available = dict(resources)
+        # pg_id -> bundle_index -> (resources, committed)
+        self.bundles: Dict[PlacementGroupID, Dict[int, Tuple[dict, bool]]] = {}
+        self.bundle_avail: Dict[Tuple[PlacementGroupID, int], dict] = {}
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        self.leases: Dict[str, _Lease] = {}
+        self._lease_seq = 0
+        self._wait_queue: List[Tuple[dict, asyncio.Future]] = []
+        self.cluster_view: Dict[NodeID, dict] = {}
+        self._view_version = 0
+        self._pulls: Dict[ObjectID, asyncio.Future] = {}
+        self.store = SharedObjectStore(
+            session_id,
+            capacity_bytes=self.config.shm_store_bytes,
+            spill_dir=self.config.object_spill_dir or None,
+            node_uid=self.node_id.hex())
+        self.pool = rpc.ConnectionPool()
+        self.server = rpc.RpcServer(
+            self._handlers(),
+            chaos=rpc.ChaosPlan(self.config.testing_rpc_failure))
+        self.addr: Optional[Tuple[str, int]] = None
+        self._hb_task: Optional[asyncio.Task] = None
+        self._stopping = False
+
+    def _handlers(self):
+        return {
+            "request_lease": self.request_lease,
+            "release_lease": self.release_lease,
+            "start_actor": self.start_actor,
+            "kill_actor_worker": self.kill_actor_worker,
+            "prepare_bundle": self.prepare_bundle,
+            "commit_bundle": self.commit_bundle,
+            "return_bundle": self.return_bundle,
+            "worker_ready": self.worker_ready,
+            "register_segment": self.register_segment,
+            "resolve_object": self.resolve_object,
+            "fetch_chunk": self.fetch_chunk,
+            "free_objects": self.free_objects,
+            "node_stats": self.node_stats,
+            "ping": self.ping,
+        }
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self.addr = await self.server.start(host, port)
+        r = await self.pool.call(
+            self.head_addr, "register_node", node_id=self.node_id,
+            addr=self.addr, resources_total=self.resources_total,
+            labels=self.labels)
+        assert r.get("ok"), r
+        self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+        for _ in range(self.config.num_workers_prestart):
+            asyncio.ensure_future(self._spawn_worker())
+        return self.addr
+
+    async def stop(self):
+        self._stopping = True
+        if self._hb_task:
+            self._hb_task.cancel()
+        for w in list(self.workers.values()):
+            await self._kill_worker(w)
+        await self.server.stop()
+        await self.pool.close()
+        self.store.shutdown()
+
+    async def ping(self):
+        return "pong"
+
+    async def node_stats(self):
+        return {"node_id": self.node_id,
+                "resources_total": self.resources_total,
+                "available": self.available,
+                "workers": len([w for w in self.workers.values()
+                                if w.state != DEAD]),
+                "store": self.store.stats()}
+
+    # --- heartbeats / cluster view ------------------------------------------
+
+    async def _heartbeat_loop(self):
+        period = self.config.health_check_period_s
+        while not self._stopping:
+            try:
+                self._view_version += 1
+                r = await self.pool.call(
+                    self.head_addr, "heartbeat", node_id=self.node_id,
+                    resources_available=self.available,
+                    version=self._view_version, timeout=10.0)
+                if r.get("view"):
+                    self.cluster_view = r["view"]
+            except Exception:
+                pass
+            await asyncio.sleep(period)
+
+    # --- worker pool ---------------------------------------------------------
+
+    async def _spawn_worker(self) -> Optional[WorkerHandle]:
+        wid = WorkerID.generate()
+        env = dict(os.environ)
+        env.update(self.env_extra)
+        env.update({
+            "RAY_TPU_AGENT_HOST": self.addr[0],
+            "RAY_TPU_AGENT_PORT": str(self.addr[1]),
+            "RAY_TPU_HEAD_HOST": self.head_addr[0],
+            "RAY_TPU_HEAD_PORT": str(self.head_addr[1]),
+            "RAY_TPU_WORKER_ID": wid.hex(),
+            "RAY_TPU_NODE_ID": self.node_id.hex(),
+            "RAY_TPU_SESSION": self.session_id,
+        })
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "ray_tpu.runtime.worker", env=env)
+        w = WorkerHandle(worker_id=wid, proc=proc)
+        self.workers[wid] = w
+        asyncio.ensure_future(self._reap_worker(w))
+        try:
+            await asyncio.wait_for(
+                w.ready.wait(), self.config.worker_start_timeout_s)
+        except asyncio.TimeoutError:
+            await self._kill_worker(w)
+            return None
+        return w
+
+    async def _reap_worker(self, w: WorkerHandle):
+        if w.proc is None:
+            return
+        await w.proc.wait()
+        dead_actor = w.actor_id
+        was = w.state
+        w.state = DEAD
+        self.workers.pop(w.worker_id, None)
+        if w.lease_id:
+            await self.release_lease(w.lease_id, worker_died=True)
+        if w.actor_resources is not None:
+            pg = w.actor_pg or (None, None)
+            self._release_res(w.actor_resources, pg[0], pg[1])
+            w.actor_resources = None
+            self._drain_queue()
+        if dead_actor is not None and not self._stopping:
+            try:
+                await self.pool.call(
+                    self.head_addr, "actor_failed", actor_id=dead_actor,
+                    reason=f"worker process exited (rc="
+                           f"{w.proc.returncode}, state={was})")
+            except Exception:
+                pass
+
+    async def _kill_worker(self, w: WorkerHandle):
+        w.state = DEAD
+        if w.proc is not None and w.proc.returncode is None:
+            try:
+                w.proc.terminate()
+            except ProcessLookupError:
+                pass
+
+    async def worker_ready(self, worker_id: WorkerID, addr):
+        w = self.workers.get(worker_id)
+        if w is None:
+            return {"ok": False}
+        w.addr = tuple(addr)
+        if w.state == STARTING:
+            w.state = IDLE
+        w.ready.set()
+        return {"ok": True}
+
+    def _pop_idle(self) -> Optional[WorkerHandle]:
+        for w in self.workers.values():
+            if w.state == IDLE and w.addr is not None:
+                return w
+        return None
+
+    async def _get_worker(self) -> Optional[WorkerHandle]:
+        w = self._pop_idle()
+        if w is not None:
+            return w
+        n_live = len([x for x in self.workers.values() if x.state != DEAD])
+        if n_live >= self.config.max_workers_per_node:
+            return None
+        return await self._spawn_worker()
+
+    # --- leases (task scheduling) --------------------------------------------
+
+    def _avail_for(self, pg_id, bundle_index) -> dict:
+        if pg_id is not None:
+            key = (pg_id, bundle_index)
+            return self.bundle_avail.get(key, {})
+        return self.available
+
+    def _try_acquire(self, resources: dict, pg_id, bundle_index) -> bool:
+        pool = self._avail_for(pg_id, bundle_index)
+        if not _fits(resources, pool):
+            return False
+        for k, v in resources.items():
+            pool[k] = pool.get(k, 0.0) - v
+        return True
+
+    def _release_res(self, resources: dict, pg_id, bundle_index):
+        pool = self._avail_for(pg_id, bundle_index)
+        for k, v in resources.items():
+            pool[k] = pool.get(k, 0.0) + v
+
+    _spread_counter = 0
+
+    def _spread_target(self, resources: dict) -> Optional[Tuple[str, int]]:
+        """Round-robin over capacity-feasible nodes (self included)."""
+        nodes = []
+        if _fits(resources, self.resources_total):
+            nodes.append((self.node_id.hex(), tuple(self.addr)))
+        for nid, info in self.cluster_view.items():
+            if nid == self.node_id or not info.get("alive"):
+                continue
+            if _fits(resources, info.get("total", {})):
+                nodes.append((nid.hex(), tuple(info["addr"])))
+        if not nodes:
+            return None
+        nodes.sort()
+        NodeAgent._spread_counter += 1
+        return nodes[NodeAgent._spread_counter % len(nodes)][1]
+
+    def _capacity_target(self, resources: dict) -> Optional[Tuple[str, int]]:
+        for nid, info in self.cluster_view.items():
+            if nid == self.node_id or not info.get("alive"):
+                continue
+            if _fits(resources, info.get("total", {})):
+                return tuple(info["addr"])
+        return None
+
+    def _spillback_target(self, resources: dict) -> Optional[Tuple[str, int]]:
+        """Pick a peer whose AVAILABLE resources fit, preferring the most
+        loaded feasible node under HYBRID (pack) or least loaded under
+        SPREAD (reference: hybrid_scheduling_policy.h)."""
+        cands = []
+        for nid, info in self.cluster_view.items():
+            if nid == self.node_id or not info.get("alive"):
+                continue
+            if _fits(resources, info.get("available", {})):
+                free = sum(info["available"].values())
+                cands.append((free, tuple(info["addr"])))
+        if not cands:
+            return None
+        if self.config.scheduler_policy == "spread":
+            return max(cands)[1]
+        return min(cands)[1]
+
+    async def request_lease(self, resources: dict, pg_id=None,
+                            bundle_index=None, policy: str = "default",
+                            allow_spillback: bool = True,
+                            timeout: Optional[float] = None):
+        """Grant a worker lease (reference: NodeManager::
+        HandleRequestWorkerLease -> ClusterLeaseManager). Reply is one of
+        {granted, spillback, error}."""
+        resources = dict(resources or {})
+        # SPREAD: rotate leases round-robin over all capacity-feasible nodes
+        # regardless of local room (reference: SPREAD policy in
+        # scheduling/policy/scheduling_options.h).
+        if pg_id is None and allow_spillback and policy == "spread":
+            target = self._spread_target(resources)
+            if target is not None and tuple(target) != tuple(self.addr):
+                return {"spillback": target}
+        local_ok = self._try_acquire(resources, pg_id, bundle_index)
+        if not local_ok:
+            if pg_id is None and allow_spillback \
+                    and not _fits(resources, self.resources_total):
+                # Never feasible here. Prefer a peer with room now; else a
+                # peer whose total capacity fits (request queues there);
+                # else the demand is truly infeasible cluster-wide.
+                target = self._spillback_target(resources)
+                if target is None:
+                    target = self._capacity_target(resources)
+                if target is not None:
+                    return {"spillback": target}
+                return {"error": f"infeasible resources {resources}"}
+            # queue until resources free up locally
+            fut = asyncio.get_running_loop().create_future()
+            self._wait_queue.append(
+                ({"resources": resources, "pg_id": pg_id,
+                  "bundle_index": bundle_index}, fut))
+            try:
+                await asyncio.wait_for(
+                    fut, timeout or self.config.lease_timeout_s)
+            except asyncio.TimeoutError:
+                return {"error": "lease timeout"}
+        w = await self._get_worker()
+        if w is None:
+            self._release_res(resources, pg_id, bundle_index)
+            self._drain_queue()
+            return {"error": "no worker available"}
+        self._lease_seq += 1
+        lease_id = f"{self.node_id.hex()[:8]}:{self._lease_seq}"
+        w.state = LEASED
+        w.lease_id = lease_id
+        self.leases[lease_id] = _Lease(
+            lease_id=lease_id, worker=w, resources=resources,
+            pg_id=pg_id, bundle_index=bundle_index)
+        return {"granted": {"lease_id": lease_id, "worker_addr": w.addr,
+                            "worker_id": w.worker_id}}
+
+    async def release_lease(self, lease_id: str, worker_died: bool = False):
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return {"ok": False}
+        self._release_res(lease.resources, lease.pg_id, lease.bundle_index)
+        w = lease.worker
+        if not worker_died and w.state == LEASED:
+            w.state = IDLE
+            w.lease_id = None
+        self._drain_queue()
+        return {"ok": True}
+
+    def _drain_queue(self):
+        still = []
+        for req, fut in self._wait_queue:
+            if fut.done():
+                continue
+            if self._try_acquire(req["resources"], req["pg_id"],
+                                 req["bundle_index"]):
+                fut.set_result(True)
+            else:
+                still.append((req, fut))
+        self._wait_queue = still
+
+    # --- actors ---------------------------------------------------------------
+
+    async def start_actor(self, actor_id: ActorID, creation_spec: bytes,
+                          resources: dict):
+        resources = dict(resources or {})
+        pg_id = None
+        bundle_index = None
+        # placement-group constraint rides inside resources as pseudo-keys
+        if "_pg" in resources:
+            pg_id = resources.pop("_pg")
+            bundle_index = resources.pop("_pg_bundle", None)
+        if not self._try_acquire(resources, pg_id, bundle_index):
+            # queue until capacity frees (the reference keeps actor creation
+            # pending in the GCS scheduler; here we park on the agent)
+            fut = asyncio.get_running_loop().create_future()
+            self._wait_queue.append(
+                ({"resources": resources, "pg_id": pg_id,
+                  "bundle_index": bundle_index}, fut))
+            try:
+                await asyncio.wait_for(fut, self.config.lease_timeout_s)
+            except asyncio.TimeoutError:
+                return {"ok": False,
+                        "error": f"insufficient resources for actor "
+                                 f"{resources} (timed out queued)"}
+        w = await self._get_worker()
+        if w is None:
+            self._release_res(resources, pg_id, bundle_index)
+            return {"ok": False, "error": "no worker available"}
+        w.state = ACTOR
+        w.actor_id = actor_id
+        w.actor_resources = dict(resources)
+        w.actor_pg = (pg_id, bundle_index) if pg_id is not None else None
+        try:
+            r = await self.pool.call(
+                w.addr, "host_actor", actor_id=actor_id,
+                creation_spec=creation_spec, timeout=120.0)
+            if not r.get("ok"):
+                raise RuntimeError(r.get("error", "host_actor failed"))
+        except Exception as e:  # noqa: BLE001
+            self._release_res(resources, pg_id, bundle_index)
+            await self._kill_worker(w)
+            return {"ok": False, "error": f"{e}"}
+        await self.pool.call(
+            self.head_addr, "actor_started", actor_id=actor_id,
+            addr=w.addr, node_id=self.node_id)
+        return {"ok": True, "addr": w.addr}
+
+    async def kill_actor_worker(self, actor_id: ActorID):
+        for w in list(self.workers.values()):
+            if w.actor_id == actor_id:
+                w.actor_id = None  # suppress actor_failed report
+                await self._kill_worker(w)  # _reap_worker frees resources
+                return {"ok": True}
+        return {"ok": False}
+
+    # --- placement group bundles ----------------------------------------------
+
+    async def prepare_bundle(self, pg_id: PlacementGroupID, bundle_index: int,
+                             resources: dict):
+        resources = dict(resources)
+        if not self._try_acquire(resources, None, None):
+            return {"ok": False, "error": "insufficient resources"}
+        self.bundles.setdefault(pg_id, {})[bundle_index] = (resources, False)
+        return {"ok": True}
+
+    async def commit_bundle(self, pg_id: PlacementGroupID, bundle_index: int):
+        ent = self.bundles.get(pg_id, {}).get(bundle_index)
+        if ent is None:
+            return {"ok": False}
+        resources, _ = ent
+        self.bundles[pg_id][bundle_index] = (resources, True)
+        self.bundle_avail[(pg_id, bundle_index)] = dict(resources)
+        return {"ok": True}
+
+    async def return_bundle(self, pg_id: PlacementGroupID, bundle_index: int):
+        ent = self.bundles.get(pg_id, {}).pop(bundle_index, None)
+        if ent is None:
+            return {"ok": False}
+        resources, _ = ent
+        self.bundle_avail.pop((pg_id, bundle_index), None)
+        self._release_res(resources, None, None)
+        self._drain_queue()
+        return {"ok": True}
+
+    # --- object plane -----------------------------------------------------------
+
+    async def register_segment(self, oid: ObjectID, size: int):
+        """A local process created+sealed a segment under the session naming
+        scheme; adopt it into the store and publish its location."""
+        self.store.adopt(oid, size)
+        await self.pool.call(self.head_addr, "add_object_location",
+                             oid=oid, node_id=self.node_id, size=size)
+        return {"ok": True}
+
+    async def resolve_object(self, oid: ObjectID, pull: bool = True):
+        """Local segname for oid, pulling from a remote node if needed
+        (reference: PullManager + ObjectManager chunked transfer)."""
+        seg = self.store.segment_name(oid)
+        if seg is not None:
+            return {"segname": seg, "size": self.store.size_of(oid)}
+        if not pull:
+            return {"segname": None}
+        # Dedup concurrent pulls of the same object (reference:
+        # pull_manager.h tracks active pulls per object).
+        inflight = self._pulls.get(oid)
+        if inflight is None:
+            inflight = asyncio.ensure_future(self._pull_from_any(oid))
+            self._pulls[oid] = inflight
+            inflight.add_done_callback(
+                lambda _f: self._pulls.pop(oid, None))
+        ok = await asyncio.shield(inflight)
+        if not ok:
+            return {"segname": None}
+        return {"segname": self.store.segment_name(oid),
+                "size": self.store.size_of(oid)}
+
+    async def _pull_from_any(self, oid: ObjectID) -> bool:
+        locs = await self.pool.call(self.head_addr, "get_object_locations",
+                                    oid=oid)
+        for loc in locs:
+            if loc["node_id"] == self.node_id:
+                continue
+            try:
+                await self._pull(oid, tuple(loc["addr"]), loc["size"])
+                return True
+            except Exception:
+                continue
+        return False
+
+    async def _pull(self, oid: ObjectID, addr: Tuple[str, int], size: int):
+        chunk = self.config.object_transfer_chunk_bytes
+        mv = self.store.create(oid, size)
+        try:
+            off = 0
+            while off < size:
+                n = min(chunk, size - off)
+                data = await self.pool.call(
+                    addr, "fetch_chunk", oid=oid, offset=off, size=n)
+                if data is None:
+                    raise IOError(f"chunk fetch failed for {oid}")
+                mv[off:off + len(data)] = data
+                off += len(data)
+        except Exception:
+            self.store.delete(oid)
+            raise
+        self.store.seal(oid)
+        await self.pool.call(self.head_addr, "add_object_location",
+                             oid=oid, node_id=self.node_id, size=size)
+
+    async def fetch_chunk(self, oid: ObjectID, offset: int, size: int):
+        mv = self.store.get(oid)
+        if mv is None:
+            return None
+        return bytes(mv[offset:offset + size])
+
+    async def free_objects(self, oids: List[ObjectID]):
+        for oid in oids:
+            self.store.delete(oid)
+            try:
+                await self.pool.call(self.head_addr, "remove_object_location",
+                                     oid=oid, node_id=self.node_id)
+            except Exception:
+                pass
+        return {"ok": True}
+
+
+def _fits(demand: Dict[str, float], avail: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())

@@ -1,0 +1,628 @@
+"""Control service: cluster-global state on the head node.
+
+The GCS analog (reference: src/ray/gcs/gcs_server.h, gcs_node_manager.h,
+gcs/actor/gcs_actor_manager.h, gcs_placement_group_manager.h,
+gcs_kv_manager.h, gcs_health_check_manager.h, pubsub/publisher.h). Holds:
+node membership + health, the actor directory (with restart FSM), the
+object-location directory, a KV store, the job table, placement groups
+(2-phase reserve across agents), and a long-poll pubsub used to broadcast
+node/actor events.
+
+Storage is in-memory (the reference's default; its Redis persistence is a
+pluggable StoreClient — same seam exists here via `self._tables`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.config import Config
+from ray_tpu.runtime import rpc
+from ray_tpu.runtime.ids import (ActorID, JobID, NodeID, ObjectID,
+                                 PlacementGroupID)
+
+# Actor lifecycle states (reference: gcs/actor/gcs_actor_manager.h FSM).
+PENDING, ALIVE, RESTARTING, DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    addr: Tuple[str, int]              # agent RPC address
+    resources_total: Dict[str, float]
+    resources_available: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    version: int = 0                   # resource-view version (syncer)
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: Optional[str]
+    state: str = PENDING
+    addr: Optional[Tuple[str, int]] = None     # hosting worker RPC addr
+    node_id: Optional[NodeID] = None
+    max_restarts: int = 0
+    num_restarts: int = 0
+    class_name: str = ""
+    resources: Dict[str, float] = field(default_factory=dict)
+    creation_spec: Optional[bytes] = None      # re-spawn payload for restart
+    death_cause: Optional[str] = None
+    namespace: str = "default"
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupID
+    bundles: List[Dict[str, float]]
+    strategy: str
+    state: str = "PENDING"             # PENDING | CREATED | REMOVED
+    bundle_nodes: List[Optional[NodeID]] = field(default_factory=list)
+    name: Optional[str] = None
+
+
+class Pubsub:
+    """Per-channel event logs consumed by long-poll (reference:
+    pubsub/publisher.h long-poll protocol)."""
+
+    def __init__(self, maxlen: int = 65536):
+        self._events: Dict[str, List[Tuple[int, Any]]] = {}
+        self._next: Dict[str, int] = {}
+        self._cond = asyncio.Condition()
+        self._maxlen = maxlen
+
+    async def publish(self, channel: str, event: Any) -> None:
+        async with self._cond:
+            seq = self._next.get(channel, 0)
+            self._next[channel] = seq + 1
+            log = self._events.setdefault(channel, [])
+            log.append((seq, event))
+            if len(log) > self._maxlen:
+                del log[: len(log) // 2]
+            self._cond.notify_all()
+
+    async def poll(self, channel: str, cursor: int,
+                   timeout: float = 30.0) -> Tuple[int, List[Any]]:
+        deadline = time.monotonic() + timeout
+        async with self._cond:
+            while True:
+                log = self._events.get(channel, [])
+                fresh = [e for seq, e in log if seq >= cursor]
+                if fresh:
+                    return self._next.get(channel, 0), fresh
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return self._next.get(channel, 0), []
+                try:
+                    await asyncio.wait_for(self._cond.wait(), remaining)
+                except asyncio.TimeoutError:
+                    return self._next.get(channel, 0), []
+
+
+class ControlService:
+    def __init__(self, config: Optional[Config] = None):
+        self.config = config or Config.from_env()
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.jobs: Dict[JobID, dict] = {}
+        self.pgs: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        # object directory: oid -> {node_id: size}
+        self.object_locations: Dict[ObjectID, Dict[NodeID, int]] = {}
+        self.pubsub = Pubsub()
+        self.pool = rpc.ConnectionPool()
+        self.server = rpc.RpcServer(
+            self._handlers(),
+            chaos=rpc.ChaosPlan(self.config.testing_rpc_failure))
+        self.addr: Optional[Tuple[str, int]] = None
+        self._health_task: Optional[asyncio.Task] = None
+
+    def _handlers(self):
+        return {
+            "register_node": self.register_node,
+            "heartbeat": self.heartbeat,
+            "drain_node": self.drain_node,
+            "get_nodes": self.get_nodes,
+            "kv_put": self.kv_put, "kv_get": self.kv_get,
+            "kv_del": self.kv_del, "kv_keys": self.kv_keys,
+            "register_actor": self.register_actor,
+            "actor_started": self.actor_started,
+            "actor_failed": self.actor_failed,
+            "kill_actor": self.kill_actor,
+            "get_actor": self.get_actor,
+            "wait_actor_alive": self.wait_actor_alive,
+            "get_named_actor": self.get_named_actor,
+            "list_actors": self.list_actors,
+            "register_job": self.register_job,
+            "finish_job": self.finish_job,
+            "list_jobs": self.list_jobs,
+            "create_pg": self.create_pg,
+            "remove_pg": self.remove_pg,
+            "get_pg": self.get_pg,
+            "list_pgs": self.list_pgs,
+            "add_object_location": self.add_object_location,
+            "remove_object_location": self.remove_object_location,
+            "get_object_locations": self.get_object_locations,
+            "poll_events": self.poll_events,
+            "cluster_view": self.cluster_view,
+            "ping": self.ping,
+        }
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self.addr = await self.server.start(host, port)
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        return self.addr
+
+    async def stop(self):
+        if self._health_task:
+            self._health_task.cancel()
+        await self.server.stop()
+        await self.pool.close()
+
+    async def ping(self):
+        return "pong"
+
+    # --- nodes / health ----------------------------------------------------
+
+    async def register_node(self, node_id: NodeID, addr, resources_total,
+                            labels=None):
+        self.nodes[node_id] = NodeInfo(
+            node_id=node_id, addr=tuple(addr),
+            resources_total=dict(resources_total),
+            resources_available=dict(resources_total),
+            labels=dict(labels or {}))
+        await self.pubsub.publish(
+            "nodes", {"event": "node_added", "node_id": node_id,
+                      "addr": tuple(addr)})
+        return {"ok": True}
+
+    async def heartbeat(self, node_id: NodeID, resources_available=None,
+                        version: int = 0):
+        """Liveness + resource-view sync in one beat (reference splits these
+        across GcsHealthCheckManager and ray_syncer; one RPC suffices at
+        TPU-pod node counts). Reply carries the full cluster resource view
+        so every agent can make spillback decisions locally."""
+        n = self.nodes.get(node_id)
+        if n is None:
+            return {"ok": False, "unknown": True}
+        n.last_heartbeat = time.monotonic()
+        if not n.alive:
+            n.alive = True  # node came back before we GC'd it
+        if resources_available is not None:
+            n.resources_available = dict(resources_available)
+            n.version = version
+        return {"ok": True, "view": self._view()}
+
+    def _view(self):
+        return {
+            n.node_id: {
+                "addr": n.addr, "alive": n.alive,
+                "total": n.resources_total,
+                "available": n.resources_available,
+                "labels": n.labels,
+            } for n in self.nodes.values() if n.alive
+        }
+
+    async def cluster_view(self):
+        return self._view()
+
+    async def get_nodes(self):
+        return [
+            {"node_id": n.node_id, "addr": n.addr, "alive": n.alive,
+             "resources_total": n.resources_total,
+             "resources_available": n.resources_available,
+             "labels": n.labels}
+            for n in self.nodes.values()
+        ]
+
+    async def drain_node(self, node_id: NodeID):
+        await self._mark_node_dead(node_id, "drained")
+        return {"ok": True}
+
+    async def _health_loop(self):
+        period = self.config.health_check_period_s
+        threshold = period * self.config.health_check_failure_threshold
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for n in list(self.nodes.values()):
+                if n.alive and now - n.last_heartbeat > threshold:
+                    await self._mark_node_dead(n.node_id, "heartbeat timeout")
+
+    async def _mark_node_dead(self, node_id: NodeID, reason: str):
+        n = self.nodes.get(node_id)
+        if n is None or not n.alive:
+            return
+        n.alive = False
+        await self.pubsub.publish(
+            "nodes", {"event": "node_dead", "node_id": node_id,
+                      "reason": reason})
+        # Objects on the dead node are gone.
+        for oid, locs in list(self.object_locations.items()):
+            locs.pop(node_id, None)
+            if not locs:
+                del self.object_locations[oid]
+        # Actors hosted there die (and maybe restart).
+        for a in list(self.actors.values()):
+            if a.node_id == node_id and a.state in (ALIVE, PENDING,
+                                                    RESTARTING):
+                await self._on_actor_death(a, f"node {node_id} died: {reason}")
+
+    # --- kv ----------------------------------------------------------------
+
+    async def kv_put(self, key: str, value: bytes, overwrite: bool = True):
+        if not overwrite and key in self.kv:
+            return {"ok": False, "exists": True}
+        self.kv[key] = value
+        return {"ok": True}
+
+    async def kv_get(self, key: str):
+        return self.kv.get(key)
+
+    async def kv_del(self, key: str):
+        return {"deleted": self.kv.pop(key, None) is not None}
+
+    async def kv_keys(self, prefix: str = ""):
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    # --- actors ------------------------------------------------------------
+
+    async def register_actor(self, actor_id: ActorID, name, class_name,
+                             resources, max_restarts: int,
+                             creation_spec: bytes, namespace: str = "default",
+                             scheduling: Optional[dict] = None):
+        if name:
+            key = (namespace, name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing and existing.state != DEAD:
+                    return {"ok": False,
+                            "error": f"actor name {name!r} taken"}
+            self.named_actors[key] = actor_id
+        info = ActorInfo(actor_id=actor_id, name=name, class_name=class_name,
+                         resources=dict(resources),
+                         max_restarts=max_restarts,
+                         creation_spec=creation_spec, namespace=namespace)
+        self.actors[actor_id] = info
+        node = await self._schedule_actor(info, scheduling or {})
+        if node is None:
+            info.state = DEAD
+            info.death_cause = "no feasible node"
+            return {"ok": False, "error": "no feasible node for actor"}
+        return {"ok": True, "node_id": node.node_id}
+
+    async def _schedule_actor(self, info: ActorInfo,
+                              scheduling: dict) -> Optional[NodeInfo]:
+        """Pick a node and ask its agent to start the actor (reference:
+        gcs/actor/gcs_actor_scheduler.h — lease-based; here the agent owns
+        its own worker pool so one RPC does lease+spawn)."""
+        node = self._pick_node(info.resources, scheduling)
+        if node is None:
+            return None
+        info.node_id = node.node_id
+        asyncio.ensure_future(self._request_start(info, node))
+        return node
+
+    def _pick_node(self, resources: Dict[str, float],
+                   scheduling: dict) -> Optional[NodeInfo]:
+        cands = [n for n in self.nodes.values() if n.alive]
+        nid = scheduling.get("node_id")
+        if nid is not None:
+            cands = [n for n in cands if n.node_id == nid]
+        labels = scheduling.get("labels") or {}
+        for k, v in labels.items():
+            cands = [n for n in cands if n.labels.get(k) == v]
+        feasible = [n for n in cands
+                    if _fits(resources, n.resources_available)]
+        if not feasible:
+            # fall back to total-capacity feasibility (queue on the agent)
+            feasible = [n for n in cands
+                        if _fits(resources, n.resources_total)]
+        if not feasible:
+            return None
+        # most-available-first spread for actors
+        return max(feasible, key=lambda n: sum(
+            n.resources_available.get(k, 0) - v
+            for k, v in resources.items()) if resources else
+            sum(n.resources_available.values()))
+
+    async def _request_start(self, info: ActorInfo, node: NodeInfo):
+        try:
+            r = await self.pool.call(
+                node.addr, "start_actor", timeout=120.0,
+                actor_id=info.actor_id, creation_spec=info.creation_spec,
+                resources=info.resources)
+            if not r.get("ok"):
+                await self._on_actor_death(
+                    info, r.get("error", "agent failed to start actor"))
+        except Exception as e:  # noqa: BLE001
+            await self._on_actor_death(info, f"start_actor rpc failed: {e}")
+
+    async def actor_started(self, actor_id: ActorID, addr, node_id: NodeID):
+        a = self.actors.get(actor_id)
+        if a is None:
+            return {"ok": False}
+        a.state = ALIVE
+        a.addr = tuple(addr)
+        a.node_id = node_id
+        await self.pubsub.publish(
+            f"actor:{actor_id.hex()}",
+            {"event": "alive", "addr": a.addr})
+        await self.pubsub.publish(
+            "actors", {"event": "alive", "actor_id": actor_id})
+        return {"ok": True}
+
+    async def actor_failed(self, actor_id: ActorID, reason: str):
+        a = self.actors.get(actor_id)
+        if a is None:
+            return {"ok": False}
+        await self._on_actor_death(a, reason)
+        return {"ok": True}
+
+    async def _on_actor_death(self, a: ActorInfo, reason: str):
+        if a.state == DEAD:
+            return
+        if a.num_restarts < a.max_restarts:
+            a.num_restarts += 1
+            a.state = RESTARTING
+            a.addr = None
+            await self.pubsub.publish(
+                f"actor:{a.actor_id.hex()}",
+                {"event": "restarting", "restarts": a.num_restarts})
+            node = await self._schedule_actor(a, {})
+            if node is not None:
+                return
+            reason = f"{reason}; restart found no feasible node"
+        a.state = DEAD
+        a.death_cause = reason
+        a.addr = None
+        await self.pubsub.publish(
+            f"actor:{a.actor_id.hex()}", {"event": "dead", "reason": reason})
+        await self.pubsub.publish(
+            "actors", {"event": "dead", "actor_id": a.actor_id,
+                       "reason": reason})
+
+    async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        a = self.actors.get(actor_id)
+        if a is None:
+            return {"ok": False}
+        if no_restart:
+            a.max_restarts = a.num_restarts  # exhaust budget
+        node = self.nodes.get(a.node_id) if a.node_id else None
+        if a.addr is not None and node is not None:
+            try:
+                await self.pool.call(node.addr, "kill_actor_worker",
+                                     actor_id=actor_id)
+            except Exception:
+                pass
+        await self._on_actor_death(a, "killed via kill_actor")
+        return {"ok": True}
+
+    async def get_actor(self, actor_id: ActorID):
+        a = self.actors.get(actor_id)
+        if a is None:
+            return None
+        return {"actor_id": a.actor_id, "state": a.state, "addr": a.addr,
+                "name": a.name, "class_name": a.class_name,
+                "node_id": a.node_id, "num_restarts": a.num_restarts,
+                "death_cause": a.death_cause}
+
+    async def wait_actor_alive(self, actor_id: ActorID,
+                               wait_timeout: float = 60.0):
+        """Park until the actor is ALIVE (or DEAD). Used by handles to
+        resolve the actor's direct-call address."""
+        deadline = time.monotonic() + wait_timeout
+        cursor = 0
+        chan = f"actor:{actor_id.hex()}"
+        while True:
+            a = self.actors.get(actor_id)
+            if a is None:
+                return {"state": "UNKNOWN"}
+            if a.state == ALIVE:
+                return {"state": ALIVE, "addr": a.addr,
+                        "num_restarts": a.num_restarts}
+            if a.state == DEAD:
+                return {"state": DEAD, "reason": a.death_cause}
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"state": a.state, "timeout": True}
+            cursor, _ = await self.pubsub.poll(
+                chan, cursor, timeout=min(remaining, 5.0))
+
+    async def get_named_actor(self, name: str, namespace: str = "default"):
+        aid = self.named_actors.get((namespace, name))
+        if aid is None:
+            return None
+        return await self.get_actor(aid)
+
+    async def list_actors(self):
+        return [await self.get_actor(aid) for aid in list(self.actors)]
+
+    # --- jobs --------------------------------------------------------------
+
+    async def register_job(self, job_id: JobID, metadata=None):
+        self.jobs[job_id] = {"job_id": job_id, "state": "RUNNING",
+                             "start_time": time.time(),
+                             "metadata": metadata or {}}
+        return {"ok": True}
+
+    async def finish_job(self, job_id: JobID, state: str = "SUCCEEDED"):
+        j = self.jobs.get(job_id)
+        if j:
+            j["state"] = state
+            j["end_time"] = time.time()
+        return {"ok": True}
+
+    async def list_jobs(self):
+        return list(self.jobs.values())
+
+    # --- placement groups ---------------------------------------------------
+
+    async def create_pg(self, pg_id: PlacementGroupID, bundles, strategy,
+                        name=None):
+        """Two-phase gang reserve (reference:
+        gcs/gcs_placement_group_scheduler.h Prepare/Commit protocol;
+        bundle policies raylet/scheduling/policy/bundle_scheduling_policy.h).
+        """
+        info = PlacementGroupInfo(
+            pg_id=pg_id, bundles=[dict(b) for b in bundles],
+            strategy=strategy, name=name,
+            bundle_nodes=[None] * len(bundles))
+        self.pgs[pg_id] = info
+        placement = self._place_bundles(info)
+        if placement is None:
+            info.state = "INFEASIBLE"
+            return {"ok": False, "error": "infeasible placement group"}
+        # Phase 1: prepare on every node (all-or-nothing).
+        prepared = []
+        ok = True
+        for idx, node in enumerate(placement):
+            try:
+                r = await self.pool.call(
+                    node.addr, "prepare_bundle", pg_id=pg_id,
+                    bundle_index=idx, resources=info.bundles[idx])
+                if r.get("ok"):
+                    prepared.append((idx, node))
+                else:
+                    ok = False
+                    break
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for idx, node in prepared:
+                try:
+                    await self.pool.call(node.addr, "return_bundle",
+                                         pg_id=pg_id, bundle_index=idx)
+                except Exception:
+                    pass
+            info.state = "INFEASIBLE"
+            return {"ok": False, "error": "bundle reservation failed"}
+        # Phase 2: commit.
+        for idx, node in prepared:
+            await self.pool.call(node.addr, "commit_bundle", pg_id=pg_id,
+                                 bundle_index=idx)
+            info.bundle_nodes[idx] = node.node_id
+        info.state = "CREATED"
+        await self.pubsub.publish("pgs", {"event": "created", "pg_id": pg_id})
+        return {"ok": True,
+                "bundle_nodes": info.bundle_nodes}
+
+    def _place_bundles(self, info: PlacementGroupInfo
+                       ) -> Optional[List[NodeInfo]]:
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return None
+        avail = {n.node_id: dict(n.resources_available) for n in alive}
+        strategy = info.strategy.upper()
+        out: List[NodeInfo] = []
+
+        def take(node: NodeInfo, bundle) -> bool:
+            a = avail[node.node_id]
+            if not _fits(bundle, a):
+                return False
+            for k, v in bundle.items():
+                a[k] = a.get(k, 0) - v
+            return True
+
+        if strategy in ("PACK", "STRICT_PACK"):
+            order = sorted(alive, key=lambda n: -sum(
+                n.resources_available.values()))
+            for b in info.bundles:
+                placed = False
+                pool = out[:1] if (strategy == "STRICT_PACK" and out) else order
+                for n in pool:
+                    if take(n, b):
+                        out.append(n)
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            if strategy == "STRICT_PACK" and len({n.node_id for n in out}) > 1:
+                return None
+            return out
+        if strategy in ("SPREAD", "STRICT_SPREAD"):
+            used: set = set()
+            for b in info.bundles:
+                cands = sorted(alive, key=lambda n: (
+                    n.node_id in used, -sum(avail[n.node_id].values())))
+                placed = False
+                for n in cands:
+                    if strategy == "STRICT_SPREAD" and n.node_id in used:
+                        continue
+                    if take(n, b):
+                        out.append(n)
+                        used.add(n.node_id)
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return out
+        raise ValueError(f"unknown strategy {info.strategy}")
+
+    async def remove_pg(self, pg_id: PlacementGroupID):
+        info = self.pgs.get(pg_id)
+        if info is None:
+            return {"ok": False}
+        for idx, nid in enumerate(info.bundle_nodes):
+            if nid is None:
+                continue
+            node = self.nodes.get(nid)
+            if node is None:
+                continue
+            try:
+                await self.pool.call(node.addr, "return_bundle",
+                                     pg_id=pg_id, bundle_index=idx)
+            except Exception:
+                pass
+        info.state = "REMOVED"
+        return {"ok": True}
+
+    async def get_pg(self, pg_id: PlacementGroupID):
+        info = self.pgs.get(pg_id)
+        if info is None:
+            return None
+        return {"pg_id": info.pg_id, "state": info.state,
+                "bundles": info.bundles, "strategy": info.strategy,
+                "bundle_nodes": info.bundle_nodes, "name": info.name}
+
+    async def list_pgs(self):
+        return [await self.get_pg(p) for p in list(self.pgs)]
+
+    # --- object directory ----------------------------------------------------
+
+    async def add_object_location(self, oid: ObjectID, node_id: NodeID,
+                                  size: int):
+        self.object_locations.setdefault(oid, {})[node_id] = size
+        return {"ok": True}
+
+    async def remove_object_location(self, oid: ObjectID, node_id: NodeID):
+        locs = self.object_locations.get(oid)
+        if locs:
+            locs.pop(node_id, None)
+            if not locs:
+                del self.object_locations[oid]
+        return {"ok": True}
+
+    async def get_object_locations(self, oid: ObjectID):
+        locs = self.object_locations.get(oid, {})
+        return [{"node_id": nid, "addr": self.nodes[nid].addr, "size": sz}
+                for nid, sz in locs.items()
+                if nid in self.nodes and self.nodes[nid].alive]
+
+    # --- pubsub ---------------------------------------------------------------
+
+    async def poll_events(self, channel: str, cursor: int = 0,
+                          poll_timeout: float = 30.0):
+        nxt, events = await self.pubsub.poll(channel, cursor, poll_timeout)
+        return {"cursor": nxt, "events": events}
+
+
+def _fits(demand: Dict[str, float], avail: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())

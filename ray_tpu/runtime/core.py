@@ -1,0 +1,693 @@
+"""Per-process core client: object model, task submission, actor calls.
+
+The CoreWorker analog (reference: src/ray/core_worker/core_worker.h,
+task_submission/normal_task_submitter.h, actor_task_submitter.h,
+store_provider/memory_store/memory_store.h). Every participating process —
+the driver and each worker — owns one CoreContext: an RPC server (it serves
+object fetches to borrowers; workers add task-execution handlers), an
+in-process memory store for small objects and pending results, a lease pool
+that acquires/caches worker leases from node agents (with spillback), and
+direct push of tasks/actor-calls to leased workers (no agent on the hot
+path — reference: PushNormalTask at normal_task_submitter.cc:518).
+
+Ownership model: the submitting process owns task results and puts; borrowers
+resolve objects from the owner (inline) or via the node agents' shared-memory
+stores (large objects) — reference: reference_counter.h ownership design,
+scoped here to owner-resident metadata.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.config import Config
+from ray_tpu.runtime import rpc
+from ray_tpu.runtime.ids import (ActorID, NodeID, ObjectID, TaskID, WorkerID)
+from ray_tpu.runtime.object_store import SharedStoreReader
+from ray_tpu.runtime.serialization import (FunctionCache, Serialized,
+                                           dumps_oob, loads_oob)
+
+PIPELINE_DEPTH = 2          # in-flight tasks per leased worker
+MAX_SPILLBACK_HOPS = 4
+LEASE_IDLE_RETURN_S = 2.0
+
+
+# --- public value types -----------------------------------------------------
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """User task/actor-method raised; carries the remote traceback."""
+
+    def __init__(self, message: str, cause: Optional[BaseException] = None):
+        super().__init__(message)
+        self.cause = cause
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+@dataclass(frozen=True)
+class ObjectRef:
+    """Handle to a (possibly pending) object. Owner is the process that
+    created it (reference: ObjectRef + ownership in core_worker.h)."""
+    oid: ObjectID
+    owner_addr: Tuple[str, int]
+    size_hint: int = 0
+
+    def hex(self) -> str:
+        return self.oid.hex()
+
+    def __repr__(self):
+        return f"ObjectRef({self.oid.hex()[:12]})"
+
+    # Allow `await ref` inside async actors/drivers.
+    def __await__(self):
+        from ray_tpu import api
+        return api.get_async(self).__await__()
+
+
+# --- memory store -----------------------------------------------------------
+
+PENDING, READY, IN_SHM, ERROR = "pending", "ready", "in_shm", "error"
+
+
+@dataclass
+class _Entry:
+    status: str = PENDING
+    frame: Optional[bytes] = None          # Serialized frame (READY)
+    shm_size: int = 0                      # IN_SHM
+    error_frame: Optional[bytes] = None    # ERROR: serialized exception
+    event: asyncio.Event = field(default_factory=asyncio.Event)
+    executing_on: Optional[Tuple[str, int]] = None  # for cancel
+
+
+class MemoryStore:
+    """Owner-resident object states + waiters (reference:
+    core_worker/store_provider/memory_store/memory_store.h)."""
+
+    def __init__(self):
+        self._entries: Dict[ObjectID, _Entry] = {}
+
+    def create_pending(self, oid: ObjectID) -> _Entry:
+        e = self._entries.get(oid)
+        if e is None:
+            e = _Entry()
+            self._entries[oid] = e
+        return e
+
+    def get_entry(self, oid: ObjectID) -> Optional[_Entry]:
+        return self._entries.get(oid)
+
+    def resolve(self, oid: ObjectID, *, frame=None, shm_size=None,
+                error_frame=None):
+        e = self.create_pending(oid)
+        if error_frame is not None:
+            e.status, e.error_frame = ERROR, error_frame
+        elif shm_size is not None:
+            e.status, e.shm_size = IN_SHM, shm_size
+        else:
+            e.status, e.frame = READY, frame
+        e.event.set()
+
+    async def wait_ready(self, oid: ObjectID,
+                         timeout: Optional[float]) -> _Entry:
+        e = self.create_pending(oid)
+        if not e.event.is_set():
+            if timeout is None:
+                await e.event.wait()
+            else:
+                await asyncio.wait_for(e.event.wait(), timeout)
+        return e
+
+    def delete(self, oid: ObjectID):
+        self._entries.pop(oid, None)
+
+    def __contains__(self, oid: ObjectID):
+        e = self._entries.get(oid)
+        return e is not None and e.status != PENDING
+
+
+# --- lease pool -------------------------------------------------------------
+
+@dataclass
+class _LeasedWorker:
+    lease_id: str
+    agent_addr: Tuple[str, int]
+    worker_addr: Tuple[str, int]
+    worker_id: WorkerID
+    inflight: int = 0
+    last_used: float = field(default_factory=time.monotonic)
+    dead: bool = False
+
+
+class LeasePool:
+    """Submitter-side cache of leased workers keyed by resource shape
+    (reference: normal_task_submitter.h lease caching/pipelining)."""
+
+    def __init__(self, ctx: "CoreContext"):
+        self.ctx = ctx
+        self._by_shape: Dict[tuple, List[_LeasedWorker]] = {}
+        self._pending_requests: Dict[tuple, int] = {}
+        self._cond = asyncio.Condition()
+        self._reaper: Optional[asyncio.Task] = None
+
+    @staticmethod
+    def shape_key(resources: dict, pg, policy: str = "default") -> tuple:
+        pg_part = (pg[0], pg[1]) if pg else None
+        return (tuple(sorted(resources.items())), pg_part, policy)
+
+    async def acquire(self, resources: dict,
+                      pg: Optional[tuple] = None,
+                      policy: str = "default") -> _LeasedWorker:
+        if self._reaper is None:
+            self._reaper = asyncio.ensure_future(self._reap_loop())
+        key = self.shape_key(resources, pg, policy)
+        if policy == "spread":
+            # True spreading: one fresh lease per task, rotated by the
+            # agents' round-robin — no reuse that would pin one node.
+            lw = await self._lease_now(resources, pg, policy)
+            lw.inflight = 1
+            async with self._cond:
+                self._by_shape.setdefault(key, []).append(lw)
+            return lw
+        async with self._cond:
+            while True:
+                err = self.ctx.consume_scheduling_error(key)
+                if err is not None:
+                    raise err
+                pool = self._by_shape.setdefault(key, [])
+                pool[:] = [lw for lw in pool if not lw.dead]
+                free = [lw for lw in pool if lw.inflight < PIPELINE_DEPTH]
+                if free:
+                    lw = min(free, key=lambda x: x.inflight)
+                    lw.inflight += 1
+                    lw.last_used = time.monotonic()
+                    return lw
+                if self._pending_requests.get(key, 0) == 0:
+                    self._pending_requests[key] = 1
+                    asyncio.ensure_future(
+                        self._request_lease(key, resources, pg, policy))
+                await self._cond.wait()
+
+    async def _lease_now(self, resources, pg, policy) -> _LeasedWorker:
+        addr = self.ctx.agent_addr
+        pg_id = pg[0] if pg else None
+        bundle_index = pg[1] if pg else None
+        for hop in range(MAX_SPILLBACK_HOPS):
+            r = await self.ctx.pool.call(
+                addr, "request_lease", resources=resources,
+                pg_id=pg_id, bundle_index=bundle_index, policy=policy,
+                allow_spillback=(hop == 0),
+                timeout=self.ctx.config.lease_timeout_s + 30.0)
+            if "spillback" in r:
+                addr = tuple(r["spillback"])
+                continue
+            if "granted" in r:
+                g = r["granted"]
+                return _LeasedWorker(
+                    lease_id=g["lease_id"], agent_addr=addr,
+                    worker_addr=tuple(g["worker_addr"]),
+                    worker_id=g["worker_id"])
+            raise RayTpuError(r.get("error", "lease refused"))
+        raise RayTpuError("spillback loop exceeded hop limit")
+
+    async def _request_lease(self, key, resources, pg, policy):
+        try:
+            lw = await self._lease_now(resources, pg, policy)
+            async with self._cond:
+                self._by_shape.setdefault(key, []).append(lw)
+        except Exception as e:  # noqa: BLE001 — wake waiters with failure
+            self.ctx.record_scheduling_error(key, e)
+        finally:
+            async with self._cond:
+                self._pending_requests[key] = 0
+                self._cond.notify_all()
+
+    async def release_slot(self, lw: _LeasedWorker, dead: bool = False):
+        async with self._cond:
+            lw.inflight -= 1
+            lw.last_used = time.monotonic()
+            if dead:
+                lw.dead = True
+                try:
+                    await self.ctx.pool.call(
+                        lw.agent_addr, "release_lease",
+                        lease_id=lw.lease_id, worker_died=True)
+                except Exception:
+                    pass
+            self._cond.notify_all()
+
+    async def _reap_loop(self):
+        while True:
+            await asyncio.sleep(LEASE_IDLE_RETURN_S / 2)
+            now = time.monotonic()
+            async with self._cond:
+                for key, pool in self._by_shape.items():
+                    keep = []
+                    for lw in pool:
+                        if (not lw.dead and lw.inflight == 0
+                                and now - lw.last_used > LEASE_IDLE_RETURN_S):
+                            lw.dead = True
+                            asyncio.ensure_future(self.ctx.pool.call(
+                                lw.agent_addr, "release_lease",
+                                lease_id=lw.lease_id))
+                        elif not lw.dead:
+                            keep.append(lw)
+                    pool[:] = keep
+
+    async def shutdown(self):
+        if self._reaper:
+            self._reaper.cancel()
+        for pool in self._by_shape.values():
+            for lw in pool:
+                if not lw.dead:
+                    try:
+                        await self.ctx.pool.call(
+                            lw.agent_addr, "release_lease",
+                            lease_id=lw.lease_id, timeout=2.0)
+                    except Exception:
+                        pass
+        self._by_shape.clear()
+
+
+# --- core context -----------------------------------------------------------
+
+class CoreContext:
+    """One per process (driver or worker). All methods are async and run on
+    the process's event loop."""
+
+    def __init__(self, head_addr, agent_addr, node_id: NodeID,
+                 session_id: str, config: Optional[Config] = None,
+                 is_driver: bool = True):
+        self.config = config or Config.from_env()
+        self.head_addr = tuple(head_addr)
+        self.agent_addr = tuple(agent_addr)
+        self.node_id = node_id
+        self.session_id = session_id
+        self.is_driver = is_driver
+        self.store = MemoryStore()
+        self.pool = rpc.ConnectionPool(
+            retry_attempts=self.config.rpc_retry_max_attempts,
+            retry_backoff_s=self.config.rpc_retry_backoff_s)
+        self.server = rpc.RpcServer({
+            "fetch_object": self._handle_fetch_object,
+            "ping": self._handle_ping,
+        })
+        self.addr: Optional[Tuple[str, int]] = None
+        self.leases = LeasePool(self)
+        self.fn_cache = FunctionCache()
+        self._shipped_digests: Dict[Tuple[str, int], set] = {}
+        self.shm_reader = SharedStoreReader()
+        self._sched_errors: Dict[tuple, Exception] = {}
+        self._actor_addr_cache: Dict[ActorID, Tuple[str, int]] = {}
+
+    async def start(self, host: str = "127.0.0.1"):
+        self.addr = await self.server.start(host, 0)
+        return self.addr
+
+    async def stop(self):
+        await self.leases.shutdown()
+        await self.server.stop()
+        await self.pool.close()
+        self.shm_reader.close()
+
+    async def _handle_ping(self):
+        return "pong"
+
+    def record_scheduling_error(self, key, err: Exception):
+        self._sched_errors[key] = err
+
+    def consume_scheduling_error(self, key) -> Optional[Exception]:
+        return self._sched_errors.pop(key, None)
+
+    # --- object plane: put/get/wait ---------------------------------------
+
+    def _segname(self, oid: ObjectID) -> str:
+        return (f"rt{self.session_id[:6]}{self.node_id.hex()[:6]}"
+                f"_{oid.hex()}")
+
+    async def put_shm(self, oid: ObjectID, ser: Serialized) -> int:
+        """Write a Serialized frame into a node-local shared segment and
+        register it with the agent (which adopts lifetime)."""
+        data = ser.to_bytes()
+        shm = shared_memory.SharedMemory(
+            create=True, size=max(len(data), 1), name=self._segname(oid))
+        shm.buf[:len(data)] = data
+        size = len(data)
+        shm.close()
+        await self.pool.call(self.agent_addr, "register_segment",
+                             oid=oid, size=size)
+        return size
+
+    async def put(self, value: Any) -> ObjectRef:
+        from ray_tpu.runtime.serialization import serialize
+        oid = ObjectID.generate()
+        ser = serialize(value)
+        if ser.total_bytes <= self.config.inline_object_max_bytes:
+            self.store.resolve(oid, frame=ser.to_bytes())
+            return ObjectRef(oid, self.addr, ser.total_bytes)
+        size = await self.put_shm(oid, ser)
+        self.store.resolve(oid, shm_size=size)
+        return ObjectRef(oid, self.addr, size)
+
+    async def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        try:
+            values = await asyncio.gather(
+                *[self._get_one(r, timeout) for r in refs])
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(f"get() timed out after {timeout}s")
+        return values[0] if single else values
+
+    async def _get_one(self, ref: ObjectRef, timeout: Optional[float]):
+        e = self.store.get_entry(ref.oid)
+        if e is not None and e.status != PENDING:
+            return await self._load_entry(ref, e)
+        if self._is_owner(ref):
+            e = await self.store.wait_ready(ref.oid, timeout)
+            return await self._load_entry(ref, e)
+        # Borrower: ask the owner (parks until ready owner-side).
+        r = await self.pool.call(
+            ref.owner_addr, "fetch_object", oid=ref.oid,
+            timeout=(timeout + 5.0) if timeout is not None else 3610.0,
+            wait_timeout=timeout)
+        kind = r.get("kind")
+        if kind == "inline":
+            return self._loads_value(r["frame"])
+        if kind == "error":
+            raise self._loads_error(r["frame"])
+        if kind == "shm":
+            return await self._read_shm(ref.oid)
+        if kind == "timeout":
+            raise GetTimeoutError(f"object {ref.oid} not ready")
+        raise ObjectLostError(f"{ref.oid}: owner replied {r}")
+
+    def _is_owner(self, ref: ObjectRef) -> bool:
+        return tuple(ref.owner_addr) == self.addr
+
+    async def _load_entry(self, ref: ObjectRef, e: _Entry):
+        if e.status == READY:
+            return self._loads_value(e.frame)
+        if e.status == ERROR:
+            raise self._loads_error(e.error_frame)
+        if e.status == IN_SHM:
+            return await self._read_shm(ref.oid)
+        raise ObjectLostError(f"{ref.oid} in unexpected state {e.status}")
+
+    def _loads_value(self, frame: bytes):
+        return loads_oob(frame)
+
+    def _loads_error(self, frame: bytes) -> BaseException:
+        payload = loads_oob(frame)
+        if isinstance(payload, BaseException):
+            return payload
+        return TaskError(str(payload))
+
+    async def _read_shm(self, oid: ObjectID):
+        r = await self.pool.call(self.agent_addr, "resolve_object", oid=oid,
+                                 timeout=120.0)
+        seg = r.get("segname")
+        if seg is None:
+            raise ObjectLostError(f"{oid} not found in any object store")
+        mv = self.shm_reader.read(seg, r["size"])
+        return loads_oob(mv)
+
+    async def _handle_fetch_object(self, oid: ObjectID,
+                                   wait_timeout: Optional[float] = None):
+        try:
+            e = await self.store.wait_ready(
+                oid, wait_timeout if wait_timeout is not None else 3600.0)
+        except asyncio.TimeoutError:
+            return {"kind": "timeout"}
+        if e.status == READY:
+            return {"kind": "inline", "frame": e.frame}
+        if e.status == ERROR:
+            return {"kind": "error", "frame": e.error_frame}
+        if e.status == IN_SHM:
+            return {"kind": "shm", "size": e.shm_size}
+        return {"kind": "lost"}
+
+    async def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+                   timeout: Optional[float] = None,
+                   poll_s: float = 0.01):
+        deadline = (time.monotonic() + timeout) if timeout is not None else None
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        while True:
+            still = []
+            for ref in pending:
+                if await self._is_ready(ref):
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            await asyncio.sleep(poll_s)
+            poll_s = min(poll_s * 1.5, 0.2)
+        return ready, pending
+
+    async def _is_ready(self, ref: ObjectRef) -> bool:
+        e = self.store.get_entry(ref.oid)
+        if e is not None and e.status != PENDING:
+            return True
+        if self._is_owner(ref):
+            return False
+        try:
+            r = await self.pool.call(ref.owner_addr, "fetch_object",
+                                     oid=ref.oid, wait_timeout=0.001,
+                                     timeout=5.0)
+            if r.get("kind") in ("inline", "error", "shm"):
+                # cache inline results so get() later is local
+                if r["kind"] == "inline":
+                    self.store.resolve(ref.oid, frame=r["frame"])
+                elif r["kind"] == "error":
+                    self.store.resolve(ref.oid, error_frame=r["frame"])
+                else:
+                    self.store.resolve(ref.oid, shm_size=r["size"])
+                return True
+        except rpc.RpcError:
+            pass
+        return False
+
+    # --- task submission ---------------------------------------------------
+
+    async def submit_task(self, fn: Callable, args: tuple, kwargs: dict,
+                          *, num_returns: int = 1,
+                          resources: Optional[dict] = None,
+                          max_retries: Optional[int] = None,
+                          pg: Optional[tuple] = None,
+                          policy: str = "default") -> List[ObjectRef]:
+        resources = dict(resources or {"CPU": 1.0})
+        retries = (max_retries if max_retries is not None
+                   else self.config.default_max_task_retries)
+        task_id = TaskID.generate()
+        oids = [ObjectID.generate() for _ in range(num_returns)]
+        for oid in oids:
+            self.store.create_pending(oid)
+        refs = [ObjectRef(oid, self.addr) for oid in oids]
+        digest = self.fn_cache.digest_for(fn)
+        args_frame = dumps_oob((args, kwargs))
+        asyncio.ensure_future(self._drive_task(
+            task_id, digest, args_frame, oids, resources,
+            retries, pg, policy))
+        return refs
+
+    async def _drive_task(self, task_id, digest, args_frame,
+                          oids, resources, retries, pg, policy):
+        attempt = 0
+        while True:
+            lw = None
+            try:
+                lw = await self.leases.acquire(resources, pg, policy)
+                shipped = self._shipped_digests.setdefault(
+                    lw.worker_addr, set())
+                payload = (None if digest in shipped
+                           else self.fn_cache.payload_for(digest))
+                try:
+                    r = await self.pool.call(
+                        lw.worker_addr, "exec_task",
+                        task_id=task_id, fn_digest=digest,
+                        fn_payload=payload, args_frame=args_frame,
+                        return_oids=oids, owner_addr=self.addr,
+                        timeout=None)
+                except rpc.RemoteError as re:
+                    if "unknown function digest" in str(re):
+                        r = await self.pool.call(
+                            lw.worker_addr, "exec_task",
+                            task_id=task_id, fn_digest=digest,
+                            fn_payload=self.fn_cache.payload_for(digest),
+                            args_frame=args_frame,
+                            return_oids=oids, owner_addr=self.addr,
+                            timeout=None)
+                    else:
+                        raise
+                shipped.add(digest)
+                await self.leases.release_slot(lw)
+                self._apply_result(oids, r)
+                return
+            except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
+                if lw is not None:
+                    await self.leases.release_slot(lw, dead=True)
+                if isinstance(e, rpc.RemoteError):
+                    # handler-level failure that isn't a crash: surface it
+                    self._fail_all(oids, TaskError(str(e)))
+                    return
+                attempt += 1
+                if attempt > retries:
+                    self._fail_all(
+                        oids, WorkerCrashedError(
+                            f"task {task_id} failed after {attempt} "
+                            f"attempts: {e}"))
+                    return
+            except RayTpuError as e:
+                self._fail_all(oids, e)
+                return
+
+    def _apply_result(self, oids: List[ObjectID], r: dict):
+        results = r["results"]  # list aligned with oids
+        for oid, res in zip(oids, results):
+            kind = res["kind"]
+            if kind == "inline":
+                self.store.resolve(oid, frame=res["frame"])
+            elif kind == "shm":
+                self.store.resolve(oid, shm_size=res["size"])
+            elif kind == "error":
+                self.store.resolve(oid, error_frame=res["frame"])
+
+    def _fail_all(self, oids, err: Exception):
+        frame = dumps_oob(err)
+        for oid in oids:
+            self.store.resolve(oid, error_frame=frame)
+
+    # --- actors -------------------------------------------------------------
+
+    async def create_actor(self, cls, args, kwargs, *, name=None,
+                           namespace: str = "default",
+                           resources: Optional[dict] = None,
+                           max_restarts: int = 0,
+                           max_concurrency: int = 1,
+                           pg: Optional[tuple] = None,
+                           scheduling: Optional[dict] = None,
+                           lifetime: Optional[str] = None) -> "ActorID":
+        import cloudpickle
+        actor_id = ActorID.generate()
+        resources = dict(resources if resources is not None else {"CPU": 1.0})
+        if pg is not None:
+            resources["_pg"] = pg[0]
+            resources["_pg_bundle"] = pg[1]
+        creation_spec = cloudpickle.dumps({
+            "cls": cls, "args": args, "kwargs": kwargs,
+            "max_concurrency": max_concurrency,
+            "actor_id": actor_id,
+        }, protocol=5)
+        r = await self.pool.call(
+            self.head_addr, "register_actor", actor_id=actor_id,
+            name=name, class_name=getattr(cls, "__name__", str(cls)),
+            resources=resources, max_restarts=max_restarts,
+            creation_spec=creation_spec, namespace=namespace,
+            scheduling=scheduling)
+        if not r.get("ok"):
+            raise ActorError(r.get("error", "actor registration failed"))
+        return actor_id
+
+    async def resolve_actor_addr(self, actor_id: ActorID,
+                                 timeout: float = 60.0) -> Tuple[str, int]:
+        addr = self._actor_addr_cache.get(actor_id)
+        if addr is not None:
+            return addr
+        r = await self.pool.call(self.head_addr, "wait_actor_alive",
+                                 actor_id=actor_id, wait_timeout=timeout,
+                                 timeout=timeout + 5.0)
+        if r.get("state") == "ALIVE":
+            addr = tuple(r["addr"])
+            self._actor_addr_cache[actor_id] = addr
+            return addr
+        if r.get("state") == "DEAD":
+            raise ActorDiedError(
+                f"actor {actor_id} is dead: {r.get('reason')}")
+        raise ActorError(f"actor {actor_id} not alive: {r}")
+
+    async def submit_actor_call(self, actor_id: ActorID, method: str,
+                                args: tuple, kwargs: dict,
+                                num_returns: int = 1,
+                                max_task_retries: int = 0) -> List[ObjectRef]:
+        oids = [ObjectID.generate() for _ in range(num_returns)]
+        for oid in oids:
+            self.store.create_pending(oid)
+        refs = [ObjectRef(oid, self.addr) for oid in oids]
+        args_frame = dumps_oob((args, kwargs))
+        asyncio.ensure_future(self._drive_actor_call(
+            actor_id, method, args_frame, oids, max_task_retries))
+        return refs
+
+    async def _drive_actor_call(self, actor_id, method, args_frame, oids,
+                                retries):
+        attempt = 0
+        while True:
+            try:
+                addr = await self.resolve_actor_addr(actor_id)
+                r = await self.pool.call(
+                    addr, "actor_call", actor_id=actor_id, method=method,
+                    args_frame=args_frame, return_oids=oids,
+                    owner_addr=self.addr, timeout=None)
+                self._apply_result(oids, r)
+                return
+            except (rpc.ConnectionLost, OSError) as e:
+                self._actor_addr_cache.pop(actor_id, None)
+                attempt += 1
+                if attempt > retries:
+                    self._fail_all(oids, ActorDiedError(
+                        f"actor {actor_id} connection lost: {e}"))
+                    return
+                await asyncio.sleep(0.2 * attempt)
+            except rpc.RemoteError as e:
+                self._fail_all(oids, TaskError(str(e)))
+                return
+            except ActorError as e:
+                self._fail_all(oids, e)
+                return
+
+    async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._actor_addr_cache.pop(actor_id, None)
+        await self.pool.call(self.head_addr, "kill_actor",
+                             actor_id=actor_id, no_restart=no_restart)
+
+    # --- misc ----------------------------------------------------------------
+
+    async def free(self, refs: Sequence[ObjectRef]):
+        oids = [r.oid for r in refs]
+        for oid in oids:
+            self.store.delete(oid)
+        try:
+            await self.pool.call(self.agent_addr, "free_objects", oids=oids)
+        except Exception:
+            pass

@@ -1,0 +1,261 @@
+"""Object plane: per-node shared-memory store + per-process memory store.
+
+The plasma analog (reference: src/ray/object_manager/plasma/store.h,
+object_store.h, eviction_policy.h). Each sealed object is one named POSIX
+shared-memory segment holding a Serialized frame, so any process on the node
+maps it and deserializes zero-copy (numpy/jax host buffers view the mapping
+directly). LRU eviction spills sealed objects to disk and restores them on
+demand (reference: raylet/local_object_manager.h spill/restore).
+
+Small objects never come here — they live in the owner's in-process
+MemoryStore and ride RPC replies inline (reference:
+core_worker/store_provider/memory_store/memory_store.h).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from multiprocessing import resource_tracker, shared_memory
+from typing import Dict, Optional
+
+from ray_tpu.runtime.ids import ObjectID
+
+
+def _disable_shm_tracking() -> None:
+    """Segment lifetime belongs to the node agent (explicit unlink), not to
+    CPython's per-process resource tracker — which would unlink segments
+    when the *creating* process exits and spam KeyErrors for attachments.
+    Same ownership model as plasma (reference: plasma/store.h)."""
+    if getattr(resource_tracker, "_ray_tpu_patched", False):
+        return
+    orig_reg, orig_unreg = resource_tracker.register, resource_tracker.unregister
+
+    def register(name, rtype):
+        if rtype != "shared_memory":
+            orig_reg(name, rtype)
+
+    def unregister(name, rtype):
+        if rtype != "shared_memory":
+            orig_unreg(name, rtype)
+
+    resource_tracker.register = register
+    resource_tracker.unregister = unregister
+    resource_tracker._ray_tpu_patched = True
+
+
+_disable_shm_tracking()
+
+
+def _attach(name: str) -> shared_memory.SharedMemory:
+    return shared_memory.SharedMemory(name=name)
+
+
+@dataclass
+class _Entry:
+    shm: Optional[shared_memory.SharedMemory]
+    size: int
+    sealed: bool = False
+    pins: int = 0
+    spilled_path: Optional[str] = None
+    created_at: float = field(default_factory=time.monotonic)
+
+
+class ObjectStoreFull(Exception):
+    pass
+
+
+class SharedObjectStore:
+    """The node-local store. One instance lives in the node agent (the
+    creator/owner of all segments); workers attach read-only by name."""
+
+    def __init__(self, session_id: str, capacity_bytes: int,
+                 spill_dir: Optional[str] = None, node_uid: str = ""):
+        self.session_id = session_id
+        # node_uid disambiguates stores when several "nodes" share one
+        # machine (the cluster_utils simulation): /dev/shm is host-global.
+        self.node_uid = node_uid
+        self.capacity = capacity_bytes
+        self.spill_dir = spill_dir
+        self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
+        self._used = 0
+
+    def _segname(self, oid: ObjectID) -> str:
+        return f"rt{self.session_id[:6]}{self.node_uid[:6]}_{oid.hex()}"
+
+    # --- write path ---
+    def create(self, oid: ObjectID, nbytes: int) -> memoryview:
+        if oid in self._entries:
+            e = self._entries[oid]
+            if e.sealed:
+                raise FileExistsError(f"{oid} already sealed")
+            raise FileExistsError(f"{oid} being created")
+        self._ensure_space(nbytes)
+        shm = shared_memory.SharedMemory(
+            create=True, size=max(nbytes, 1), name=self._segname(oid))
+        self._entries[oid] = _Entry(shm=shm, size=nbytes)
+        self._used += nbytes
+        return shm.buf[:nbytes]
+
+    def adopt(self, oid: ObjectID, size: int) -> None:
+        """Take ownership of a segment another local process created+sealed
+        under the session naming scheme (workers write results in place and
+        hand lifetime management to the agent)."""
+        if oid in self._entries:
+            return
+        self._ensure_space(size)
+        shm = _attach(self._segname(oid))
+        self._entries[oid] = _Entry(shm=shm, size=size, sealed=True)
+        self._used += size
+
+    def seal(self, oid: ObjectID) -> None:
+        self._entries[oid].sealed = True
+        self._entries.move_to_end(oid)
+
+    def put_bytes(self, oid: ObjectID, data) -> None:
+        mv = self.create(oid, len(data))
+        mv[:] = data
+        self.seal(oid)
+
+    # --- read path ---
+    def contains(self, oid: ObjectID) -> bool:
+        return oid in self._entries
+
+    def is_sealed(self, oid: ObjectID) -> bool:
+        e = self._entries.get(oid)
+        return bool(e and e.sealed)
+
+    def get(self, oid: ObjectID) -> Optional[memoryview]:
+        e = self._entries.get(oid)
+        if e is None or not e.sealed:
+            return None
+        if e.shm is None:  # spilled — restore
+            self._restore(oid, e)
+        self._entries.move_to_end(oid)
+        return e.shm.buf[:e.size]
+
+    def segment_name(self, oid: ObjectID) -> Optional[str]:
+        """For cross-process access: workers attach by name."""
+        e = self._entries.get(oid)
+        if e is None or not e.sealed:
+            return None
+        if e.shm is None:
+            self._restore(oid, e)
+        return self._segname(oid)
+
+    def size_of(self, oid: ObjectID) -> Optional[int]:
+        e = self._entries.get(oid)
+        return e.size if e else None
+
+    # --- lifetime ---
+    def pin(self, oid: ObjectID) -> None:
+        e = self._entries.get(oid)
+        if e:
+            e.pins += 1
+
+    def unpin(self, oid: ObjectID) -> None:
+        e = self._entries.get(oid)
+        if e and e.pins > 0:
+            e.pins -= 1
+
+    def delete(self, oid: ObjectID) -> None:
+        e = self._entries.pop(oid, None)
+        if e is None:
+            return
+        if e.shm is not None:
+            self._used -= e.size
+            try:
+                e.shm.close()
+                e.shm.unlink()
+            except Exception:
+                pass
+        if e.spilled_path:
+            try:
+                os.unlink(e.spilled_path)
+            except OSError:
+                pass
+
+    def shutdown(self) -> None:
+        for oid in list(self._entries):
+            self.delete(oid)
+
+    @property
+    def used_bytes(self) -> int:
+        return self._used
+
+    def stats(self) -> dict:
+        return {"objects": len(self._entries), "used_bytes": self._used,
+                "capacity_bytes": self.capacity}
+
+    # --- eviction / spill ---
+    def _ensure_space(self, nbytes: int) -> None:
+        if nbytes > self.capacity:
+            raise ObjectStoreFull(
+                f"object of {nbytes} B exceeds capacity {self.capacity} B")
+        # LRU over sealed, unpinned, in-memory entries.
+        while self._used + nbytes > self.capacity:
+            victim = next(
+                (oid for oid, e in self._entries.items()
+                 if e.sealed and e.pins == 0 and e.shm is not None), None)
+            if victim is None:
+                raise ObjectStoreFull(
+                    f"need {nbytes} B, {self.capacity - self._used} free, "
+                    f"nothing evictable")
+            self._evict(victim)
+
+    def _evict(self, oid: ObjectID) -> None:
+        e = self._entries[oid]
+        if self.spill_dir:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(self.spill_dir, oid.hex())
+            with open(path, "wb") as f:
+                f.write(e.shm.buf[:e.size])
+            e.spilled_path = path
+        self._used -= e.size
+        try:
+            e.shm.close()
+            e.shm.unlink()
+        except Exception:
+            pass
+        e.shm = None
+        if not e.spilled_path:
+            del self._entries[oid]
+
+    def _restore(self, oid: ObjectID, e: _Entry) -> None:
+        if not e.spilled_path:
+            raise KeyError(f"{oid} evicted without spill copy")
+        self._ensure_space(e.size)
+        shm = shared_memory.SharedMemory(
+            create=True, size=max(e.size, 1), name=self._segname(oid))
+        with open(e.spilled_path, "rb") as f:
+            f.readinto(shm.buf[:e.size])
+        e.shm = shm
+        self._used += e.size
+
+
+class SharedStoreReader:
+    """Read-only attach-by-name view used by worker processes."""
+
+    def __init__(self):
+        self._open: Dict[str, shared_memory.SharedMemory] = {}
+
+    def read(self, segname: str, size: int) -> memoryview:
+        shm = self._open.get(segname)
+        if shm is None:
+            shm = _attach(segname)
+            self._open[segname] = shm
+        return shm.buf[:size]
+
+    def release(self, segname: str) -> None:
+        shm = self._open.pop(segname, None)
+        if shm is not None:
+            try:
+                shm.close()
+            except Exception:
+                pass
+
+    def close(self):
+        for name in list(self._open):
+            self.release(name)

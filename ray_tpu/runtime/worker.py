@@ -1,0 +1,201 @@
+"""Worker process: executes tasks and hosts actors.
+
+The worker-side of the reference's core worker (reference:
+core_worker/task_execution/task_receiver.h, concurrency_group_manager.h;
+python callback at python/ray/_raylet.pyx:2061 execute_task_with_
+cancellation_handler). A worker embeds the same CoreContext as the driver
+(it can submit subtasks, put/get objects) and adds execution handlers:
+``exec_task`` for stateless tasks, ``host_actor``/``actor_call`` for actors
+with per-actor ordered execution (or a thread pool when max_concurrency>1),
+and async-actor support (coroutine methods run on the event loop).
+
+Results follow the reference's small/large split: small results ride the
+RPC reply inline into the owner's memory store; large results are written
+to the node's shared-memory store and fetched by location.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import inspect
+import os
+import pickle
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.config import Config
+from ray_tpu.runtime.core import CoreContext, ObjectRef, TaskError
+from ray_tpu.runtime.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.runtime.serialization import dumps_oob, loads_oob, serialize
+
+
+class _HostedActor:
+    def __init__(self, instance, max_concurrency: int):
+        self.instance = instance
+        self.max_concurrency = max_concurrency
+        self.lock = asyncio.Lock() if max_concurrency == 1 else None
+        self.executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_concurrency)
+
+
+class WorkerExecutor:
+    def __init__(self, ctx: CoreContext):
+        self.ctx = ctx
+        self.actors: Dict[ActorID, _HostedActor] = {}
+        self.task_pool = concurrent.futures.ThreadPoolExecutor(max_workers=4)
+        self.running: Dict[TaskID, asyncio.Future] = {}
+        self.cancelled: set = set()
+        ctx.server.add_handler("exec_task", self.exec_task)
+        ctx.server.add_handler("host_actor", self.host_actor)
+        ctx.server.add_handler("actor_call", self.actor_call)
+        ctx.server.add_handler("cancel_task", self.cancel_task)
+        ctx.server.add_handler("shutdown_worker", self.shutdown_worker)
+
+    # --- common result packaging -----------------------------------------
+
+    async def _package(self, value, oids: List[ObjectID]) -> dict:
+        if len(oids) > 1:
+            if not isinstance(value, (tuple, list)) or len(value) != len(oids):
+                err = TaskError(
+                    f"task declared num_returns={len(oids)} but returned "
+                    f"{type(value).__name__}")
+                frame = dumps_oob(err)
+                return {"results": [
+                    {"kind": "error", "frame": frame} for _ in oids]}
+            values = list(value)
+        else:
+            values = [value]
+        out = []
+        for oid, v in zip(oids, values):
+            ser = serialize(v)
+            if ser.total_bytes <= self.ctx.config.inline_object_max_bytes:
+                out.append({"kind": "inline", "frame": ser.to_bytes()})
+            else:
+                size = await self.ctx.put_shm(oid, ser)
+                out.append({"kind": "shm", "size": size})
+        return {"results": out}
+
+    def _package_error(self, exc: BaseException, oids) -> dict:
+        import traceback
+        tb = "".join(traceback.format_exception(exc))
+        try:
+            frame = dumps_oob(TaskError(tb, cause=exc))
+        except Exception:
+            frame = dumps_oob(TaskError(tb))
+        return {"results": [{"kind": "error", "frame": frame}
+                            for _ in oids]}
+
+    async def _resolve_args(self, args_frame: bytes):
+        args, kwargs = loads_oob(args_frame)
+        # Top-level ObjectRef args are resolved to values (reference
+        # semantics: nested refs are passed through untouched).
+        async def rv(v):
+            return await self.ctx.get(v) if isinstance(v, ObjectRef) else v
+        args = [await rv(a) for a in args]
+        kwargs = {k: await rv(v) for k, v in kwargs.items()}
+        return args, kwargs
+
+    async def _run_callable(self, fn, args, kwargs, pool=None):
+        if inspect.iscoroutinefunction(fn):
+            return await fn(*args, **kwargs)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            pool or self.task_pool, lambda: fn(*args, **kwargs))
+
+    # --- stateless tasks ----------------------------------------------------
+
+    async def exec_task(self, task_id: TaskID, fn_digest: bytes,
+                        fn_payload: Optional[bytes], args_frame: bytes,
+                        return_oids: List[ObjectID], owner_addr):
+        if task_id in self.cancelled:
+            self.cancelled.discard(task_id)
+            return self._package_error(
+                TaskError("task cancelled"), return_oids)
+        fn = self.ctx.fn_cache.resolve(fn_digest, fn_payload)
+        try:
+            args, kwargs = await self._resolve_args(args_frame)
+            value = await self._run_callable(fn, args, kwargs)
+            return await self._package(value, return_oids)
+        except BaseException as e:  # noqa: BLE001
+            return self._package_error(e, return_oids)
+
+    async def cancel_task(self, task_id: TaskID):
+        self.cancelled.add(task_id)
+        return {"ok": True}
+
+    # --- actors -------------------------------------------------------------
+
+    async def host_actor(self, actor_id: ActorID, creation_spec: bytes):
+        try:
+            spec = pickle.loads(creation_spec)
+            cls = spec["cls"]
+            args, kwargs = spec["args"], spec["kwargs"]
+            instance = await self._run_callable(
+                cls, list(args), dict(kwargs))
+            self.actors[actor_id] = _HostedActor(
+                instance, spec.get("max_concurrency", 1))
+            return {"ok": True}
+        except BaseException as e:  # noqa: BLE001
+            import traceback
+            return {"ok": False,
+                    "error": "".join(traceback.format_exception(e))}
+
+    async def actor_call(self, actor_id: ActorID, method: str,
+                         args_frame: bytes, return_oids: List[ObjectID],
+                         owner_addr):
+        hosted = self.actors.get(actor_id)
+        if hosted is None:
+            return self._package_error(
+                TaskError(f"actor {actor_id} not hosted here"), return_oids)
+        try:
+            args, kwargs = await self._resolve_args(args_frame)
+            fn = getattr(hosted.instance, method)
+            if hosted.lock is not None and not \
+                    inspect.iscoroutinefunction(fn):
+                async with hosted.lock:
+                    value = await self._run_callable(
+                        fn, args, kwargs, hosted.executor)
+            else:
+                value = await self._run_callable(
+                    fn, args, kwargs, hosted.executor)
+            return await self._package(value, return_oids)
+        except BaseException as e:  # noqa: BLE001
+            return self._package_error(e, return_oids)
+
+    async def shutdown_worker(self):
+        asyncio.get_running_loop().call_later(0.05, sys.exit, 0)
+        return {"ok": True}
+
+
+async def _amain():
+    head = (os.environ["RAY_TPU_HEAD_HOST"],
+            int(os.environ["RAY_TPU_HEAD_PORT"]))
+    agent = (os.environ["RAY_TPU_AGENT_HOST"],
+             int(os.environ["RAY_TPU_AGENT_PORT"]))
+    wid = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+    node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
+    session = os.environ["RAY_TPU_SESSION"]
+
+    ctx = CoreContext(head, agent, node_id, session, is_driver=False)
+    WorkerExecutor(ctx)
+    await ctx.start()
+
+    # Make the worker-side public API work inside tasks (subtask submission,
+    # ray_tpu.get/put from user code).
+    from ray_tpu import api
+    api._attach_existing(ctx)
+
+    await ctx.pool.call(agent, "worker_ready", worker_id=wid, addr=ctx.addr)
+    await asyncio.Event().wait()  # serve forever; agent kills us
+
+
+def main():
+    try:
+        asyncio.run(_amain())
+    except (KeyboardInterrupt, SystemExit):
+        pass
+
+
+if __name__ == "__main__":
+    main()

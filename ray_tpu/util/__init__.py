@@ -1,0 +1,1 @@
+"""Utilities: events/timeline, actor pool, queue, collectives, tpu helpers."""

@@ -1,0 +1,33 @@
+"""In-process event/timeline buffer.
+
+Lightweight analog of the reference's task-event pipeline (reference:
+core_worker/task_event_buffer.h -> gcs/gcs_task_manager.h -> ray.timeline at
+_private/state.py:1010): components append structured events; `dump()`
+returns chrome-trace-style records.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, List
+
+_BUF: Deque[dict] = deque(maxlen=65536)
+_LOCK = threading.Lock()
+
+
+def record(category: str, name: str, **fields) -> None:
+    ev = {"cat": category, "name": name, "ts": time.time(), **fields}
+    with _LOCK:
+        _BUF.append(ev)
+
+
+def dump() -> List[dict]:
+    with _LOCK:
+        return list(_BUF)
+
+
+def clear() -> None:
+    with _LOCK:
+        _BUF.clear()

@@ -66,6 +66,27 @@ def test_flash_cross_lengths(sq, sk):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("sq,sk", [(64, 256), (256, 64)])
+def test_flash_cross_lengths_grad(sq, sk):
+    """The offset-dependent block bounds in _dkv/_dq kernels (first_q /
+    last_k) must produce correct grads at sq != sk."""
+    key = jax.random.PRNGKey(8)
+    k1, k2, k3 = jax.random.split(key, 3)
+    q = jax.random.normal(k1, (1, sq, 2, 32))
+    k = jax.random.normal(k2, (1, sk, 2, 32))
+    v = jax.random.normal(k3, (1, sk, 2, 32))
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(f(q, k, v) ** 2)
+
+    g_ref = jax.grad(loss(lambda *a: A.mha_reference(*a, causal=True)),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss(lambda *a: A.flash_attention(
+        *a, causal=True, interpret=True)), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ref, g_fl):
+        np.testing.assert_allclose(a, b_, atol=5e-4, rtol=5e-4)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_reference(causal):
     import jax
