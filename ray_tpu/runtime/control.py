@@ -54,6 +54,7 @@ class ActorInfo:
     creation_spec: Optional[bytes] = None      # re-spawn payload for restart
     death_cause: Optional[str] = None
     namespace: str = "default"
+    pg: Optional[tuple] = None                 # (pg_id, bundle_index)
 
 
 @dataclass
@@ -276,7 +277,8 @@ class ControlService:
     async def register_actor(self, actor_id: ActorID, name, class_name,
                              resources, max_restarts: int,
                              creation_spec: bytes, namespace: str = "default",
-                             scheduling: Optional[dict] = None):
+                             scheduling: Optional[dict] = None,
+                             pg: Optional[tuple] = None):
         if name:
             key = (namespace, name)
             if key in self.named_actors:
@@ -288,7 +290,8 @@ class ControlService:
         info = ActorInfo(actor_id=actor_id, name=name, class_name=class_name,
                          resources=dict(resources),
                          max_restarts=max_restarts,
-                         creation_spec=creation_spec, namespace=namespace)
+                         creation_spec=creation_spec, namespace=namespace,
+                         pg=tuple(pg) if pg else None)
         self.actors[actor_id] = info
         node = await self._schedule_actor(info, scheduling or {})
         if node is None:
@@ -302,7 +305,18 @@ class ControlService:
         """Pick a node and ask its agent to start the actor (reference:
         gcs/actor/gcs_actor_scheduler.h — lease-based; here the agent owns
         its own worker pool so one RPC does lease+spawn)."""
-        node = self._pick_node(info.resources, scheduling)
+        if info.pg is not None:
+            # PG-constrained: the bundle's node is the only candidate.
+            pg_info = self.pgs.get(info.pg[0])
+            idx = info.pg[1]
+            if pg_info is None or pg_info.state != "CREATED" or \
+                    idx >= len(pg_info.bundle_nodes):
+                return None
+            node = self.nodes.get(pg_info.bundle_nodes[idx])
+            if node is None or not node.alive:
+                return None
+        else:
+            node = self._pick_node(info.resources, scheduling)
         if node is None:
             return None
         info.node_id = node.node_id
@@ -334,10 +348,15 @@ class ControlService:
 
     async def _request_start(self, info: ActorInfo, node: NodeInfo):
         try:
+            resources = dict(info.resources)
+            if info.pg is not None:
+                # agent-side pseudo-keys select the bundle's reservation
+                resources["_pg"] = info.pg[0]
+                resources["_pg_bundle"] = info.pg[1]
             r = await self.pool.call(
                 node.addr, "start_actor", timeout=120.0,
                 actor_id=info.actor_id, creation_spec=info.creation_spec,
-                resources=info.resources)
+                resources=resources)
             if not r.get("ok"):
                 await self._on_actor_death(
                     info, r.get("error", "agent failed to start actor"))
@@ -475,44 +494,84 @@ class ControlService:
             strategy=strategy, name=name,
             bundle_nodes=[None] * len(bundles))
         self.pgs[pg_id] = info
-        placement = self._place_bundles(info)
-        if placement is None:
-            info.state = "INFEASIBLE"
-            return {"ok": False, "error": "infeasible placement group"}
-        # Phase 1: prepare on every node (all-or-nothing).
-        prepared = []
-        ok = True
-        for idx, node in enumerate(placement):
-            try:
-                r = await self.pool.call(
-                    node.addr, "prepare_bundle", pg_id=pg_id,
-                    bundle_index=idx, resources=info.bundles[idx])
-                if r.get("ok"):
-                    prepared.append((idx, node))
-                else:
+        # Stay PENDING while the cluster is busy: resource views refresh on
+        # heartbeats, so placement that is infeasible *now* may fit in a
+        # moment (reference: PGs queue in GcsPlacementGroupManager). Fail
+        # fast only when no combination of TOTAL node capacities can ever
+        # host the bundles. A prepare-phase race (two PGs placed on the
+        # same stale view) also retries within the deadline. Concurrent
+        # remove_pg aborts the wait.
+        deadline = time.monotonic() + 30.0
+        while True:
+            if info.state == "REMOVED":
+                return {"ok": False, "error": "placement group removed"}
+            placement = self._place_bundles(info)
+            if placement is None:
+                if not self._feasible_by_total(info):
+                    info.state = "INFEASIBLE"
+                    return {"ok": False,
+                            "error": "infeasible placement group "
+                                     "(exceeds total cluster capacity)"}
+                if time.monotonic() >= deadline:
+                    info.state = "INFEASIBLE"
+                    return {"ok": False,
+                            "error": "placement group timed out pending"}
+                await asyncio.sleep(0.25)
+                continue
+            # Phase 1: prepare on every node (all-or-nothing).
+            prepared = []
+            ok = True
+            for idx, node in enumerate(placement):
+                try:
+                    r = await self.pool.call(
+                        node.addr, "prepare_bundle", pg_id=pg_id,
+                        bundle_index=idx, resources=info.bundles[idx])
+                    if r.get("ok"):
+                        prepared.append((idx, node))
+                    else:
+                        ok = False
+                        break
+                except Exception:
                     ok = False
                     break
-            except Exception:
-                ok = False
-                break
-        if not ok:
+            if ok and info.state == "REMOVED":
+                ok = False  # removed while preparing: roll back
+            if not ok:
+                for idx, node in prepared:
+                    try:
+                        await self.pool.call(node.addr, "return_bundle",
+                                             pg_id=pg_id, bundle_index=idx)
+                    except Exception:
+                        pass
+                if info.state == "REMOVED":
+                    return {"ok": False, "error": "placement group removed"}
+                if time.monotonic() >= deadline:
+                    info.state = "INFEASIBLE"
+                    return {"ok": False,
+                            "error": "bundle reservation failed"}
+                await asyncio.sleep(0.25)
+                continue
+            # Phase 2: commit.
             for idx, node in prepared:
-                try:
-                    await self.pool.call(node.addr, "return_bundle",
-                                         pg_id=pg_id, bundle_index=idx)
-                except Exception:
-                    pass
-            info.state = "INFEASIBLE"
-            return {"ok": False, "error": "bundle reservation failed"}
-        # Phase 2: commit.
-        for idx, node in prepared:
-            await self.pool.call(node.addr, "commit_bundle", pg_id=pg_id,
-                                 bundle_index=idx)
-            info.bundle_nodes[idx] = node.node_id
-        info.state = "CREATED"
-        await self.pubsub.publish("pgs", {"event": "created", "pg_id": pg_id})
-        return {"ok": True,
-                "bundle_nodes": info.bundle_nodes}
+                await self.pool.call(node.addr, "commit_bundle", pg_id=pg_id,
+                                     bundle_index=idx)
+                info.bundle_nodes[idx] = node.node_id
+            info.state = "CREATED"
+            await self.pubsub.publish("pgs",
+                                      {"event": "created", "pg_id": pg_id})
+            return {"ok": True, "bundle_nodes": info.bundle_nodes}
+
+    def _feasible_by_total(self, info: PlacementGroupInfo) -> bool:
+        """Could the bundles EVER fit, given total capacities?"""
+        saved = [dict(n.resources_available) for n in self.nodes.values()]
+        nodes = list(self.nodes.values())
+        try:
+            for n in nodes:
+                n.resources_available = dict(n.resources_total)
+            return self._place_bundles(info) is not None
+        finally:
+            for n, s in zip(nodes, saved):
+                n.resources_available = s
 
     def _place_bundles(self, info: PlacementGroupInfo
                        ) -> Optional[List[NodeInfo]]:

@@ -602,8 +602,7 @@ class CoreContext:
         actor_id = ActorID.generate()
         resources = dict(resources if resources is not None else {"CPU": 1.0})
         if pg is not None:
-            resources["_pg"] = pg[0]
-            resources["_pg_bundle"] = pg[1]
+            pg = (pg[0], pg[1] if pg[1] is not None else 0)
         creation_spec = cloudpickle.dumps({
             "cls": cls, "args": args, "kwargs": kwargs,
             "max_concurrency": max_concurrency,
@@ -614,7 +613,7 @@ class CoreContext:
             name=name, class_name=getattr(cls, "__name__", str(cls)),
             resources=resources, max_restarts=max_restarts,
             creation_spec=creation_spec, namespace=namespace,
-            scheduling=scheduling)
+            scheduling=scheduling, pg=pg)
         if not r.get("ok"):
             raise ActorError(r.get("error", "actor registration failed"))
         return actor_id

@@ -1,0 +1,20 @@
+"""Distributed training: SPMD worker groups on gang-scheduled slices.
+
+The Train-v2 analog (reference: python/ray/train/v2/ — TrainController at
+v2/_internal/execution/controller/controller.py:105, WorkerGroup at
+worker_group/worker_group.py:113, JaxTrainer at v2/jax/jax_trainer.py:20).
+The JAX/TPU path is PRIMARY here, not a backend plugin: the worker group is
+one SPMD program over a jax.distributed mesh; DP/FSDP/TP/CP live inside the
+train_fn as mesh axes (ray_tpu.parallel), not as framework protocols.
+"""
+
+from ray_tpu.train.api import (Checkpoint, CheckpointConfig, FailureConfig,
+                               Result, RunConfig, ScalingConfig,
+                               get_context, report)
+from ray_tpu.train.trainer import JaxTrainer, TorchTrainer
+
+__all__ = [
+    "Checkpoint", "CheckpointConfig", "FailureConfig", "Result",
+    "RunConfig", "ScalingConfig", "get_context", "report",
+    "JaxTrainer", "TorchTrainer",
+]

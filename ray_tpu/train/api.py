@@ -1,0 +1,192 @@
+"""Train user-facing API: configs, per-worker context, report().
+
+Reference surface: ScalingConfig (train/v2/api/config.py:31), RunConfig/
+FailureConfig/CheckpointConfig (v2/api/config.py), ray.train.report
+(v2/api/train_fn_utils.py:23), Checkpoint (train/_checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+
+@dataclass
+class ScalingConfig:
+    """num_workers may be an int or (min, max) for elastic scaling
+    (reference: v2/api/config.py:78)."""
+    num_workers: Union[int, Tuple[int, int]] = 1
+    use_tpu: bool = False
+    topology: Optional[str] = None          # e.g. "v5e-32" (pod type)
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker:
+            return dict(self.resources_per_worker)
+        if self.use_tpu:
+            from ray_tpu.util import tpu as tpu_util
+            cph = (tpu_util.chips_per_host(self.topology)
+                   if self.topology else
+                   max(1, tpu_util.num_tpu_chips_on_host()))
+            return {"TPU": float(cph)}
+        return {"CPU": 1.0}
+
+    @property
+    def min_workers(self) -> int:
+        if isinstance(self.num_workers, tuple):
+            return self.num_workers[0]
+        return self.num_workers
+
+    @property
+    def max_workers(self) -> int:
+        if isinstance(self.num_workers, tuple):
+            return self.num_workers[1]
+        return self.num_workers
+
+    @property
+    def elastic(self) -> bool:
+        return isinstance(self.num_workers, tuple)
+
+
+@dataclass
+class FailureConfig:
+    """Retry budget for worker-group failures (reference:
+    v2/_internal/execution/failure_handling/default.py:24)."""
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+
+
+@dataclass
+class Checkpoint:
+    """A directory handle on shared storage (reference:
+    train/_checkpoint.py; storage at train/_internal/storage.py)."""
+    path: str
+    metrics: Dict[str, Any] = field(default_factory=dict)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path=os.path.abspath(path))
+
+
+@dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    metrics_history: List[Dict[str, Any]]
+    error: Optional[BaseException] = None
+
+
+class TrainContext:
+    """Per-worker context, created by the worker actor before train_fn runs
+    (reference: v2 TrainContext / train.get_context)."""
+
+    def __init__(self, rank: int, world_size: int, local_rank: int,
+                 node_rank: int, resume_checkpoint: Optional[Checkpoint],
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 storage_path: Optional[str] = None):
+        self.rank = rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.node_rank = node_rank
+        self._resume = resume_checkpoint
+        self._reports: "queue.Queue" = queue.Queue()
+        self._seq = 0
+        self._dataset_shards = dataset_shards or {}
+        self._storage_path = storage_path
+
+    # -- user API --
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self._resume
+
+    def get_dataset_shard(self, name: str = "train"):
+        shard = self._dataset_shards.get(name)
+        if shard is None:
+            raise KeyError(f"no dataset shard named {name!r}")
+        return shard
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        self._seq += 1
+        if checkpoint is not None and self.rank == 0 \
+                and self._storage_path:
+            # Durable BEFORE report() returns: a crash right after report
+            # must not lose the checkpoint (reference: report() persists to
+            # storage synchronously — train/_internal/storage.py).
+            import json
+            os.makedirs(self._storage_path, exist_ok=True)
+            tmp = os.path.join(self._storage_path, ".latest.tmp")
+            with open(tmp, "w") as f:
+                json.dump({"path": checkpoint.path,
+                           "metrics": dict(metrics)}, f)
+            os.replace(tmp, os.path.join(self._storage_path,
+                                         "_latest_checkpoint.json"))
+        self._reports.put({"seq": self._seq, "metrics": dict(metrics),
+                           "checkpoint": checkpoint})
+
+    # -- controller side --
+    def drain_reports(self) -> List[dict]:
+        out = []
+        while True:
+            try:
+                out.append(self._reports.get_nowait())
+            except queue.Empty:
+                return out
+
+
+_context = threading.local()
+
+
+def set_context(ctx: Optional[TrainContext]) -> None:
+    _context.value = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_context, "value", None)
+    if ctx is None:
+        raise RuntimeError("ray_tpu.train.get_context() outside a train_fn")
+    return ctx
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (+ optional checkpoint) from inside train_fn
+    (reference: v2/api/train_fn_utils.py:23)."""
+    get_context().report(metrics, checkpoint)
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_context().get_dataset_shard(name)

@@ -1,0 +1,63 @@
+"""Checkpoint bookkeeping: top-K retention + latest tracking.
+
+Reference: v2/_internal/execution/checkpoint/checkpoint_manager.py:93 —
+tracks reported checkpoints, retains top-K by a score attribute, exposes
+the latest for resume.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.api import Checkpoint, CheckpointConfig
+
+
+class CheckpointManager:
+    def __init__(self, storage_path: Optional[str],
+                 config: CheckpointConfig):
+        self.storage_path = storage_path
+        self.config = config
+        self._tracked: List[Checkpoint] = []
+        self.latest: Optional[Checkpoint] = None
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Dict[str, Any]) -> None:
+        checkpoint.metrics = dict(metrics)
+        self.latest = checkpoint
+        self._tracked.append(checkpoint)
+        self._enforce_retention()
+
+    def _score(self, ckpt: Checkpoint) -> float:
+        attr = self.config.checkpoint_score_attribute
+        if attr is None:
+            return 0.0
+        v = ckpt.metrics.get(attr)
+        return float(v) if v is not None else float("-inf")
+
+    def best(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        if self.config.checkpoint_score_attribute is None:
+            return self.latest
+        reverse = self.config.checkpoint_score_order == "max"
+        return sorted(self._tracked, key=self._score, reverse=reverse)[0]
+
+    def _enforce_retention(self) -> None:
+        keep = self.config.num_to_keep
+        if keep is None or len(self._tracked) <= keep:
+            return
+        reverse = self.config.checkpoint_score_order == "max"
+        if self.config.checkpoint_score_attribute is None:
+            victims = self._tracked[:-keep]  # oldest first
+        else:
+            ordered = sorted(self._tracked, key=self._score, reverse=reverse)
+            victims = ordered[keep:]
+        for v in victims:
+            if v is self.latest:
+                continue
+            self._tracked.remove(v)
+            if v.path and os.path.isdir(v.path) and self.storage_path and \
+                    v.path.startswith(os.path.abspath(self.storage_path)):
+                shutil.rmtree(v.path, ignore_errors=True)

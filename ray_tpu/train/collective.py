@@ -1,0 +1,94 @@
+"""Control-plane collectives for train_fn user code: barrier + broadcast.
+
+Reference: train/collective/collectives.py:16,59 — these are CONTROL
+collectives (rendezvous, config exchange) riding the actor plane. Tensor
+collectives belong to XLA over ICI inside jit (ray_tpu.parallel), never
+here.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.train.api import get_context
+
+
+class _Rendezvous:
+    """Named actor holding per-epoch barrier/broadcast state."""
+
+    def __init__(self):
+        self._barriers: dict = {}
+        self._values: dict = {}
+
+    def arrive(self, key: str, rank: int, world: int) -> bool:
+        s = self._barriers.setdefault(key, set())
+        s.add(rank)
+        return len(s) >= world
+
+    def arrived(self, key: str, world: int) -> bool:
+        return len(self._barriers.get(key, ())) >= world
+
+    def put_value(self, key: str, value: Any) -> bool:
+        self._values[key] = value
+        return True
+
+    def get_value(self, key: str):
+        return ("ok", self._values[key]) if key in self._values \
+            else ("pending", None)
+
+
+def _rendezvous_handle():
+    name = "__train_rendezvous"
+    try:
+        return ray_tpu.get_actor(name)
+    except ValueError:
+        pass
+    try:
+        return ray_tpu.remote(_Rendezvous).options(
+            name=name, lifetime="detached").remote()
+    except Exception:
+        return ray_tpu.get_actor(name)
+
+
+_epochs: dict = {}
+
+
+def barrier(tag: str = "default", timeout: float = 120.0) -> None:
+    """Block until every worker in the group reaches the same barrier
+    (reference: collectives.py:59)."""
+    ctx = get_context()
+    epoch = _epochs.get(("b", tag), 0)
+    _epochs[("b", tag)] = epoch + 1
+    key = f"barrier:{tag}:{epoch}"
+    h = _rendezvous_handle()
+    ray_tpu.get(h.arrive.remote(key, ctx.get_world_rank(),
+                                ctx.get_world_size()), timeout=timeout)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if ray_tpu.get(h.arrived.remote(key, ctx.get_world_size()),
+                       timeout=timeout):
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"barrier {tag!r} timed out")
+
+
+def broadcast_from_rank_zero(data: Any = None, tag: str = "default",
+                             timeout: float = 120.0) -> Any:
+    """Rank 0's value to everyone (reference: collectives.py:16)."""
+    ctx = get_context()
+    epoch = _epochs.get(("bc", tag), 0)
+    _epochs[("bc", tag)] = epoch + 1
+    key = f"bcast:{tag}:{epoch}"
+    h = _rendezvous_handle()
+    if ctx.get_world_rank() == 0:
+        ray_tpu.get(h.put_value.remote(key, data), timeout=timeout)
+        return data
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status, value = ray_tpu.get(h.get_value.remote(key), timeout=timeout)
+        if status == "ok":
+            return value
+        time.sleep(0.02)
+    raise TimeoutError(f"broadcast {tag!r} timed out")

@@ -1,0 +1,232 @@
+"""Train controller: worker-group lifecycle state machine.
+
+Reference: v2/_internal/execution/controller/controller.py:105
+(TrainController.run), worker_group/worker_group.py:113 (create on a
+placement group, rank-sorted), scaling_policy/{fixed,elastic}.py,
+failure_handling/default.py:24. The loop: decide group size → gang-reserve
+→ spawn rank-ordered workers → distributed bootstrap → run train_fn →
+poll → on failure consult the policy (restart whole group from the latest
+checkpoint, resize if elastic) → finish.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu import api
+from ray_tpu.train.api import (Checkpoint, FailureConfig, Result, RunConfig,
+                               ScalingConfig)
+from ray_tpu.train.checkpoint import CheckpointManager
+from ray_tpu.train.worker import TrainWorker
+from ray_tpu.util import tpu as tpu_util
+
+
+class TrainGroupError(RuntimeError):
+    pass
+
+
+class TrainController:
+    def __init__(self, train_fn: Callable,
+                 scaling: ScalingConfig,
+                 run_config: RunConfig,
+                 train_loop_config: Optional[dict] = None,
+                 datasets: Optional[dict] = None):
+        self.train_fn_payload = cloudpickle.dumps(train_fn, protocol=5)
+        self.scaling = scaling
+        self.run_config = run_config
+        self.train_loop_config = train_loop_config
+        self.datasets = datasets or {}
+        self.ckpt_manager = CheckpointManager(
+            run_config.storage_path, run_config.checkpoint_config)
+        self.metrics_history: List[Dict[str, Any]] = []
+        self._workers: List = []
+        self._pg = None
+
+    # --- scaling policy (reference: scaling_policy/fixed.py, elastic.py) ---
+
+    def _decide_num_workers(self) -> int:
+        want = self.scaling.max_workers
+        if not self.scaling.elastic:
+            return want
+        res = self.scaling.worker_resources()
+        key = "TPU" if "TPU" in res else "CPU"
+        per = res.get(key, 1.0)
+        total = ray_tpu.available_resources().get(key, 0.0)
+        feasible = int(total // per) if per else want
+        n = max(self.scaling.min_workers, min(want, feasible))
+        return n
+
+    # --- group lifecycle ---
+
+    def _create_group(self, num_workers: int):
+        res = self.scaling.worker_resources()
+        bundles = [dict(res) for _ in range(num_workers)]
+        strategy = ("STRICT_SPREAD" if self.scaling.use_tpu
+                    else self.scaling.placement_strategy)
+        self._pg = api.placement_group(bundles, strategy=strategy)
+        if not self._pg.ready(timeout=120):
+            raise TrainGroupError(
+                f"placement group for {num_workers} workers "
+                f"({res} each) not schedulable")
+        WorkerActor = ray_tpu.remote(TrainWorker)
+        self._workers = [
+            WorkerActor.options(
+                resources={k: v for k, v in res.items()},
+                placement_group=self._pg,
+                placement_group_bundle_index=i,
+                max_concurrency=4,
+            ).remote(rank=i, world_size=num_workers)
+            for i in range(num_workers)
+        ]
+        # Rank-by-topology: reference sorts workers by TPU pod / node id
+        # (worker_group.py:790,866) so ranks are ICI-contiguous.
+        infos = ray_tpu.get(
+            [w.get_address.remote() for w in self._workers], timeout=120)
+        order = sorted(range(num_workers),
+                       key=lambda i: (infos[i]["node_id"], infos[i]["pid"]))
+        self._workers = [self._workers[i] for i in order]
+        self._infos = [infos[i] for i in order]
+        return infos
+
+    def _bootstrap_distributed(self, num_workers: int):
+        """Set the jax.distributed coordination env on every worker
+        (reference: _JaxBackend.on_start, v2/jax/config.py:96-124; multi-
+        slice MEGASCALE at util/tpu.py:199)."""
+        coord = self._infos[0]
+        coord_addr = f"{coord['host']}:{coord['port']}"
+        sets = []
+        for rank, w in enumerate(self._workers):
+            env = {
+                "JAX_COORDINATOR_ADDRESS": coord_addr,
+                "JAX_NUM_PROCESSES": str(num_workers),
+                "JAX_PROCESS_ID": str(rank),
+            }
+            if self.scaling.use_tpu and self.scaling.topology:
+                env["TPU_ACCELERATOR_TYPE"] = self.scaling.topology
+            sets.append(w.setup_env.remote(env))
+        ray_tpu.get(sets, timeout=60)
+
+    def _recover_latest_checkpoint(self):
+        """Restart path: recover the durably-persisted latest checkpoint
+        pointer (written by report() rank 0 before a crash)."""
+        import json
+        import os
+        sp = self.run_config.storage_path
+        if not sp:
+            return
+        p = os.path.join(sp, "_latest_checkpoint.json")
+        if not os.path.exists(p):
+            return
+        try:
+            with open(p) as f:
+                data = json.load(f)
+            known = {c.path for c in self.ckpt_manager._tracked}
+            if data["path"] not in known:
+                self.ckpt_manager.register(
+                    Checkpoint(path=data["path"]), data.get("metrics", {}))
+        except Exception:
+            pass
+
+    def _start_train(self):
+        self._recover_latest_checkpoint()
+        shards = self._split_datasets(len(self._workers))
+        refs = []
+        for i, w in enumerate(self._workers):
+            refs.append(w.start_train_fn.remote(
+                self.train_fn_payload, self.train_loop_config,
+                self.ckpt_manager.latest, shards[i],
+                self.run_config.storage_path))
+        ray_tpu.get(refs, timeout=120)
+
+    def _split_datasets(self, n: int) -> List[Optional[dict]]:
+        if not self.datasets:
+            return [None] * n
+        per_worker: List[dict] = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "streaming_split"):
+                shards = ds.streaming_split(n)
+                for i in range(n):
+                    per_worker[i][name] = shards[i]
+            else:
+                for i in range(n):
+                    per_worker[i][name] = ds
+        return per_worker
+
+    def _teardown_group(self):
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self._workers = []
+        if self._pg is not None:
+            try:
+                api.remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None
+
+    # --- main loop ---
+
+    def run(self) -> Result:
+        failures = 0
+        max_failures = self.run_config.failure_config.max_failures
+        while True:
+            try:
+                n = self._decide_num_workers()
+                self._create_group(n)
+                self._bootstrap_distributed(n)
+                self._start_train()
+                self._poll_until_done()
+                return Result(
+                    metrics=(self.metrics_history[-1]
+                             if self.metrics_history else {}),
+                    checkpoint=self.ckpt_manager.best(),
+                    metrics_history=list(self.metrics_history))
+            except (api.ActorDiedError, api.WorkerCrashedError, api.TaskError,
+                    TrainGroupError) as e:
+                failures += 1
+                self._teardown_group()
+                if failures > max_failures:
+                    return Result(
+                        metrics=(self.metrics_history[-1]
+                                 if self.metrics_history else {}),
+                        checkpoint=self.ckpt_manager.best(),
+                        metrics_history=list(self.metrics_history),
+                        error=e)
+                # restart (possibly resized) from the latest checkpoint
+                continue
+            finally:
+                if self._workers:
+                    self._teardown_group()
+
+    def _poll_until_done(self, poll_s: float = 0.2):
+        pending = set(range(len(self._workers)))
+        while pending:
+            polls = ray_tpu.get(
+                [self._workers[i].poll.remote() for i in sorted(pending)],
+                timeout=60)
+            for p in polls:
+                for rep in p["reports"]:
+                    self._handle_report(p["rank"], rep)
+                if p["error"]:
+                    raise api.TaskError(
+                        f"train_fn failed on rank {p['rank']}:\n"
+                        f"{p['error']}")
+                if p["done"]:
+                    pending.discard(p["rank"])
+            if pending:
+                time.sleep(poll_s)
+
+    def _handle_report(self, rank: int, rep: dict):
+        # Rank 0's metrics are canonical (SPMD: all ranks see the same
+        # reduced values); checkpoints may come from any rank.
+        if rank == 0:
+            self.metrics_history.append(rep["metrics"])
+        ckpt = rep.get("checkpoint")
+        if ckpt is not None and rank == 0:
+            self.ckpt_manager.register(ckpt, rep["metrics"])

@@ -1,0 +1,76 @@
+"""Trainers: JaxTrainer (primary) and TorchTrainer (CPU/compat).
+
+Reference: JaxTrainer at train/v2/jax/jax_trainer.py:20 (SPMD JAX on TPU
+slices via jax.distributed), TorchTrainer at train/v2/torch/torch_trainer.py.
+Here JAX is the native path — the trainer wires scaling config, gang
+scheduling, distributed bootstrap, the report/checkpoint plane, and Data
+shards into the controller loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.api import Result, RunConfig, ScalingConfig
+from ray_tpu.train.controller import TrainController
+
+
+class BaseTrainer:
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets
+
+    def fit(self) -> Result:
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        controller = TrainController(
+            self.train_loop_per_worker,
+            scaling=self.scaling_config,
+            run_config=self.run_config,
+            train_loop_config=self.train_loop_config,
+            datasets=self.datasets)
+        return controller.run()
+
+
+class JaxTrainer(BaseTrainer):
+    """SPMD JAX training over a gang-scheduled worker group. One worker per
+    host; inside train_fn, build the mesh with ray_tpu.parallel and let
+    GSPMD own the collectives (reference: jax_trainer.py:20; SURVEY.md
+    §3.4 is the full call-stack map this implements)."""
+
+
+class TorchTrainer(BaseTrainer):
+    """torch DDP-style data parallel on CPU workers: the worker group sets
+    MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE so user code can call
+    torch.distributed.init_process_group with the gloo backend
+    (reference: train/torch/config.py)."""
+
+    def fit(self) -> Result:
+        fn = self.train_loop_per_worker
+
+        def wrapped(config=None):
+            import os
+            from ray_tpu.train.api import get_context
+            ctx = get_context()
+            os.environ.setdefault(
+                "MASTER_ADDR",
+                os.environ.get("JAX_COORDINATOR_ADDRESS", "127.0.0.1:29500")
+                .split(":")[0])
+            os.environ.setdefault(
+                "MASTER_PORT",
+                os.environ.get("JAX_COORDINATOR_ADDRESS", "127.0.0.1:29500")
+                .split(":")[1])
+            os.environ["RANK"] = str(ctx.get_world_rank())
+            os.environ["WORLD_SIZE"] = str(ctx.get_world_size())
+            return fn(config) if config is not None else fn()
+
+        self.train_loop_per_worker = wrapped
+        return super().fit()

@@ -1,0 +1,110 @@
+"""Train worker actor: hosts the user's train_fn on one host of the group.
+
+Reference: v2/_internal/execution/worker_group/worker.py + thread_runner.py
+— the train_fn runs on a thread inside the actor so the actor stays
+responsive to poll/report/health calls (our actor runs methods with
+max_concurrency > 1 for the same reason).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu.train.api import Checkpoint, TrainContext, set_context
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TrainWorker:
+    """One per host in the worker group (SPMD: one process per host, all
+    chips on the host belong to it — the JAX process model)."""
+
+    def __init__(self, rank: int, world_size: int, local_rank: int = 0,
+                 node_rank: Optional[int] = None):
+        self.rank = rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.node_rank = node_rank if node_rank is not None else rank
+        self.ctx: Optional[TrainContext] = None
+        self._thread: Optional[threading.Thread] = None
+        self._result: Any = None
+        self._error: Optional[str] = None
+        self._done = threading.Event()
+
+    def get_address(self) -> Dict[str, Any]:
+        return {"host": socket.gethostbyname(socket.gethostname()),
+                "port": _free_port(), "pid": os.getpid(),
+                "node_id": os.environ.get("RAY_TPU_NODE_ID", "")}
+
+    def setup_env(self, env: Dict[str, str]) -> bool:
+        """Distributed bootstrap env, set BEFORE any jax import in train_fn
+        (reference: _JaxBackend.on_start at v2/jax/config.py:96-107 runs
+        jax.distributed.initialize on every worker; here the env route lets
+        jax pick it up lazily: JAX_COORDINATOR_ADDRESS etc.)."""
+        os.environ.update(env)
+        return True
+
+    def init_jax_distributed(self) -> bool:
+        """Explicit jax.distributed.initialize (multi-host path). Only
+        called when the group really spans hosts with local devices."""
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+            num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+            process_id=int(os.environ["JAX_PROCESS_ID"]))
+        return True
+
+    def start_train_fn(self, fn_payload: bytes,
+                       train_loop_config: Optional[dict],
+                       resume_checkpoint: Optional[Checkpoint],
+                       dataset_shards: Optional[dict] = None,
+                       storage_path: Optional[str] = None) -> bool:
+        fn = cloudpickle.loads(fn_payload)
+        self.ctx = TrainContext(
+            rank=self.rank, world_size=self.world_size,
+            local_rank=self.local_rank, node_rank=self.node_rank,
+            resume_checkpoint=resume_checkpoint,
+            dataset_shards=dataset_shards,
+            storage_path=storage_path)
+
+        def run():
+            set_context(self.ctx)
+            try:
+                if train_loop_config is not None:
+                    self._result = fn(train_loop_config)
+                else:
+                    self._result = fn()
+            except BaseException as e:  # noqa: BLE001
+                self._error = "".join(traceback.format_exception(e))
+            finally:
+                self._done.set()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self) -> Dict[str, Any]:
+        """Drain new reports + running state (reference:
+        worker_group.py:609 poll_status)."""
+        reports = self.ctx.drain_reports() if self.ctx else []
+        return {"done": self._done.is_set(), "error": self._error,
+                "reports": reports, "rank": self.rank}
+
+    def join(self) -> Dict[str, Any]:
+        self._done.wait()
+        return self.poll()
+
+    def shutdown(self) -> bool:
+        return True

@@ -1,0 +1,174 @@
+"""Train: controller, worker group, report/checkpoint, failure handling.
+
+Reference strategy: the v2 controller tests run against an in-process
+cluster (reference: python/ray/train/v2/tests/). Workers here are real
+subprocesses; train_fns are CPU-light (this host has 1 core + the real
+TPU is exercised by bench.py, not pytest).
+"""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.config import Config
+from ray_tpu.train.api import (Checkpoint, CheckpointConfig, FailureConfig,
+                               RunConfig, ScalingConfig)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    cfg = Config.from_env(num_workers_prestart=0, max_workers_per_node=8,
+                          default_max_task_retries=0)
+    ray_tpu.init(num_cpus=6, config=cfg)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_single_worker_report(cluster):
+    def train_fn():
+        ctx = train.get_context()
+        assert ctx.get_world_size() == 1
+        for step in range(3):
+            train.report({"step": step, "loss": 1.0 / (step + 1)})
+
+    t = train.JaxTrainer(train_fn,
+                         scaling_config=ScalingConfig(num_workers=1))
+    res = t.fit()
+    assert res.error is None
+    assert len(res.metrics_history) == 3
+    assert res.metrics["step"] == 2
+
+
+def test_multi_worker_ranks_and_env(cluster):
+    def train_fn():
+        ctx = train.get_context()
+        train.report({
+            "rank": ctx.get_world_rank(),
+            "world": ctx.get_world_size(),
+            "coord": os.environ.get("JAX_COORDINATOR_ADDRESS", ""),
+            "nproc": os.environ.get("JAX_NUM_PROCESSES", ""),
+            "pid_rank": os.environ.get("JAX_PROCESS_ID", ""),
+        })
+
+    t = train.JaxTrainer(train_fn,
+                         scaling_config=ScalingConfig(num_workers=2))
+    res = t.fit()
+    assert res.error is None
+    m = res.metrics  # rank 0's report
+    assert m["rank"] == 0 and m["world"] == 2
+    assert m["coord"] and m["nproc"] == "2" and m["pid_rank"] == "0"
+
+
+def test_train_loop_config_passed(cluster):
+    def train_fn(config):
+        train.report({"lr": config["lr"]})
+
+    res = train.JaxTrainer(
+        train_fn, train_loop_config={"lr": 0.125},
+        scaling_config=ScalingConfig(num_workers=1)).fit()
+    assert res.metrics["lr"] == 0.125
+
+
+def test_checkpoint_tracking(cluster):
+    with tempfile.TemporaryDirectory() as tmp:
+        def train_fn():
+            for step in range(3):
+                d = os.path.join(tmp, f"ck_{step}")
+                os.makedirs(d, exist_ok=True)
+                with open(os.path.join(d, "state.txt"), "w") as f:
+                    f.write(str(step))
+                train.report({"step": step, "score": float(step)},
+                             checkpoint=Checkpoint.from_directory(d))
+
+        res = train.JaxTrainer(
+            train_fn,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                storage_path=tmp,
+                checkpoint_config=CheckpointConfig(
+                    num_to_keep=2, checkpoint_score_attribute="score"))
+        ).fit()
+        assert res.error is None
+        assert res.checkpoint is not None
+        assert res.checkpoint.metrics["score"] == 2.0
+        with open(os.path.join(res.checkpoint.path, "state.txt")) as f:
+            assert f.read() == "2"
+
+
+def test_failure_policy_restart_and_resume(cluster):
+    with tempfile.TemporaryDirectory() as tmp:
+        marker = os.path.join(tmp, "crashed_once")
+
+        def train_fn():
+            ctx = train.get_context()
+            resume = ctx.get_checkpoint()
+            start = 0
+            if resume is not None:
+                with open(os.path.join(resume.path, "step.txt")) as f:
+                    start = int(f.read()) + 1
+            for step in range(start, 3):
+                d = os.path.join(tmp, f"ck_{step}")
+                os.makedirs(d, exist_ok=True)
+                with open(os.path.join(d, "step.txt"), "w") as f:
+                    f.write(str(step))
+                train.report({"step": step, "resumed_from": start},
+                             checkpoint=Checkpoint.from_directory(d))
+                if step == 1 and not os.path.exists(marker):
+                    open(marker, "w").close()
+                    os._exit(1)  # simulate host failure
+
+        res = train.JaxTrainer(
+            train_fn,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                storage_path=tmp,
+                failure_config=FailureConfig(max_failures=1))).fit()
+        assert res.error is None
+        # resumed at step 2 (checkpoint for step 1 was reported pre-crash)
+        assert res.metrics["step"] == 2
+        assert res.metrics["resumed_from"] == 2
+
+
+def test_failure_budget_exhausted(cluster):
+    def train_fn():
+        raise RuntimeError("always broken")
+
+    res = train.JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=0))).fit()
+    assert res.error is not None
+    assert "always broken" in str(res.error)
+
+
+def test_elastic_scaling_downsizes(cluster):
+    # ask for (1, 16) workers; cluster only fits ~6 CPUs -> downsized
+    def train_fn():
+        ctx = train.get_context()
+        train.report({"world": ctx.get_world_size()})
+
+    res = train.JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=(1, 16))).fit()
+    assert res.error is None
+    assert 1 <= res.metrics["world"] <= 6
+
+
+def test_collectives_barrier_broadcast(cluster):
+    def train_fn():
+        from ray_tpu.train import collective
+        ctx = train.get_context()
+        v = collective.broadcast_from_rank_zero(
+            {"model_id": 42} if ctx.get_world_rank() == 0 else None)
+        collective.barrier()
+        train.report({"got": v["model_id"], "rank": ctx.get_world_rank()})
+
+    res = train.JaxTrainer(
+        train_fn, scaling_config=ScalingConfig(num_workers=2)).fit()
+    assert res.error is None
+    assert res.metrics["got"] == 42

@@ -1,0 +1,76 @@
+"""TPU slice scheduling: SlicePlacementGroup + JaxTrainer on simulated hosts.
+
+Hardware mocking strategy follows the reference (reference:
+python/ray/tests/accelerators/test_tpu.py:13-35 — TPU scheduling tests run
+with zero real TPUs): nodes advertise TPU resources + topology labels; the
+gang-reservation and rank-ordering logic is what's under test.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.config import Config
+from ray_tpu.train.api import ScalingConfig
+from ray_tpu.util import tpu as tpu_util
+
+
+@pytest.fixture(scope="module")
+def tpu_cluster():
+    cfg = Config.from_env(num_workers_prestart=0, max_workers_per_node=3,
+                          health_check_period_s=0.3)
+    c = Cluster(cfg)
+    # simulate a v5e-16 slice: 2 hosts x 8 chips
+    for i in range(2):
+        c.add_node(num_cpus=2, resources={"TPU": 8.0},
+                   labels={"tpu-pod-type": "v5e-16", "tpu-worker-id": str(i)})
+    ray_tpu.init(address=c.address, num_cpus=0, config=cfg)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_pod_math():
+    assert tpu_util.pod_hosts("v5e-32") == 4
+    assert tpu_util.chips_per_host("v5e-32") == 8
+    assert tpu_util.pod_hosts("v4-16") == 4
+    assert tpu_util.get_megascale_env_vars("10.0.0.1", 2, 1)[
+        "MEGASCALE_NUM_SLICES"] == "2"
+
+
+def test_slice_placement_group(tpu_cluster):
+    spg = tpu_util.slice_placement_group(pod_type="v5e-16")
+    assert spg.num_hosts == 2 and spg.chips_per_host == 8
+    assert spg.ready(timeout=30)
+    # both bundles on different hosts (STRICT_SPREAD)
+    from ray_tpu import api
+    ctx = api._g.ctx
+    info = api._run(ctx.pool.call(ctx.head_addr, "get_pg", pg_id=spg.pg.id))
+    assert len(set(n.hex() for n in info["bundle_nodes"])) == 2
+    api.remove_placement_group(spg.pg)
+
+
+def test_jax_trainer_on_tpu_slice(tpu_cluster):
+    """use_tpu=True: STRICT_SPREAD gang over hosts, one worker per host,
+    full host chip-count per bundle, jax env bootstrap."""
+    def train_fn():
+        import os
+        ctx = train.get_context()
+        train.report({
+            "rank": ctx.get_world_rank(),
+            "world": ctx.get_world_size(),
+            "node": os.environ.get("RAY_TPU_NODE_ID", ""),
+            "coord": os.environ.get("JAX_COORDINATOR_ADDRESS", ""),
+            "acc_type": os.environ.get("TPU_ACCELERATOR_TYPE", ""),
+        })
+
+    res = train.JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(
+            num_workers=2, use_tpu=True, topology="v5e-16")).fit()
+    assert res.error is None
+    m = res.metrics
+    assert m["world"] == 2
+    assert m["acc_type"] == "v5e-16"
+    assert m["coord"]
