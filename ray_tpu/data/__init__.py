@@ -1,0 +1,17 @@
+"""Streaming datasets (reference capability: python/ray/data — Dataset at
+data/dataset.py:189, read_api.py, streaming executor). Lazy plans over
+columnar numpy blocks, generator-streamed with optional task fan-out;
+iter_jax_batches stages batches to TPU with prefetch."""
+
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.datasource import (from_blocks, from_items, from_numpy,
+                                     from_pandas, range, read_csv,
+                                     read_json, read_numpy, read_parquet,
+                                     read_text)
+from ray_tpu.data.iterator import DataIterator
+
+__all__ = [
+    "Dataset", "DataIterator", "from_blocks", "from_items", "from_numpy",
+    "from_pandas", "range", "read_csv", "read_json", "read_numpy",
+    "read_parquet", "read_text",
+]

@@ -1,0 +1,90 @@
+"""Blocks: the unit of data movement — columnar numpy tables.
+
+Reference: python/ray/data/block.py (blocks are Arrow tables there). Here a
+block is a dict[str, np.ndarray] — numpy-native so batches flow zero-copy
+into jax.device_put / torch.from_numpy; Arrow interop at the parquet
+boundary only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+Block = Dict[str, np.ndarray]
+
+
+def block_from_rows(rows: List[dict]) -> Block:
+    if not rows:
+        return {}
+    cols: Dict[str, list] = {k: [] for k in rows[0]}
+    for r in rows:
+        for k in cols:
+            cols[k].append(r.get(k))
+    return {k: _to_array(v) for k, v in cols.items()}
+
+
+def _to_array(values: list) -> np.ndarray:
+    arr = np.asarray(values)
+    return arr
+
+
+def block_from_items(items: List[Any]) -> Block:
+    """Non-dict items get the reference's implicit 'item' column
+    (reference: from_items wraps scalars the same way)."""
+    if items and isinstance(items[0], dict):
+        return block_from_rows(items)
+    return {"item": _to_array(items)}
+
+
+def block_num_rows(b: Block) -> int:
+    for v in b.values():
+        return len(v)
+    return 0
+
+
+def block_slice(b: Block, start: int, end: int) -> Block:
+    return {k: v[start:end] for k, v in b.items()}
+
+
+def block_take(b: Block, idx: np.ndarray) -> Block:
+    return {k: v[idx] for k, v in b.items()}
+
+
+def block_concat(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if block_num_rows(b)]
+    if not blocks:
+        return {}
+    keys = blocks[0].keys()
+    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+def block_rows(b: Block) -> Iterable[dict]:
+    n = block_num_rows(b)
+    keys = list(b.keys())
+    for i in range(n):
+        yield {k: b[k][i] for k in keys}
+
+
+def block_to_pandas(b: Block):
+    import pandas as pd
+    return pd.DataFrame({k: list(v) if v.ndim > 1 else v
+                         for k, v in b.items()})
+
+
+def block_from_arrow(table) -> Block:
+    out = {}
+    for name in table.column_names:
+        col = table.column(name)
+        try:
+            out[name] = col.to_numpy(zero_copy_only=False)
+        except Exception:
+            out[name] = np.asarray(col.to_pylist(), dtype=object)
+    return out
+
+
+def block_to_arrow(b: Block):
+    import pyarrow as pa
+    return pa.table({k: pa.array(list(v) if v.ndim > 1 else v)
+                     for k, v in b.items()})

@@ -1,0 +1,553 @@
+"""Dataset: lazy logical plan → streaming block execution.
+
+Reference: python/ray/data/dataset.py:189 (Dataset), the logical plan +
+rule-based optimizer (data/_internal/logical/), physical operators
+(data/_internal/execution/operators/) and the StreamingExecutor
+(streaming_executor.py:76). Here the plan is a chain of operators executed
+as a generator pipeline — block-at-a-time streaming with implicit
+backpressure (a consumer pulls, producers run) — with per-stage fan-out to
+runtime tasks for CPU-heavy map_batches (reference: ActorPoolMapOperator /
+TaskPoolMapOperator).
+
+Shuffle-like ops (sort/groupby/random_shuffle/repartition) are pipeline
+breakers that materialize, matching the reference's all-to-all operators.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Tuple, Union)
+
+import numpy as np
+
+from ray_tpu.data.block import (Block, block_concat, block_from_items,
+                                block_from_rows, block_num_rows, block_rows,
+                                block_slice, block_take, block_to_pandas)
+
+BatchFormat = str  # "numpy" (dict of arrays) | "pandas" | "rows"
+
+
+# --- logical operators -------------------------------------------------------
+
+@dataclass
+class _Op:
+    name: str
+    kind: str                      # source|map|filter|flat|all2all|...
+    fn: Optional[Callable] = None
+    args: dict = field(default_factory=dict)
+
+
+class Dataset:
+    """Lazy, immutable; every transform returns a new Dataset with one more
+    operator on the plan (reference: dataset.py Dataset._plan)."""
+
+    def __init__(self, ops: List[_Op]):
+        self._ops = ops
+
+    # ---- plan construction ----
+    def _with(self, op: _Op) -> "Dataset":
+        return Dataset(self._ops + [op])
+
+    def map(self, fn: Callable[[dict], dict]) -> "Dataset":
+        return self._with(_Op("map", "map_rows", fn))
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = 4096,
+                    batch_format: BatchFormat = "numpy",
+                    concurrency: Optional[int] = None) -> "Dataset":
+        return self._with(_Op("map_batches", "map_batches", fn,
+                              {"batch_size": batch_size,
+                               "batch_format": batch_format,
+                               "concurrency": concurrency}))
+
+    def flat_map(self, fn: Callable[[dict], List[dict]]) -> "Dataset":
+        return self._with(_Op("flat_map", "flat_map", fn))
+
+    def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
+        return self._with(_Op("filter", "filter", fn))
+
+    def add_column(self, name: str, fn: Callable[[Block], np.ndarray]
+                   ) -> "Dataset":
+        def add(batch):
+            batch = dict(batch)
+            batch[name] = fn(batch)
+            return batch
+        return self.map_batches(add, batch_size=None)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def drop(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+        return self.map_batches(drop, batch_size=None)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def select(batch):
+            return {k: batch[k] for k in cols}
+        return self.map_batches(select, batch_size=None)
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(_Op("limit", "limit", None, {"n": n}))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(_Op("repartition", "all2all", None,
+                              {"mode": "repartition", "n": num_blocks}))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with(_Op("random_shuffle", "all2all", None,
+                              {"mode": "shuffle", "seed": seed}))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with(_Op("sort", "all2all", None,
+                              {"mode": "sort", "key": key,
+                               "descending": descending}))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with(_Op("union", "union", None,
+                              {"others": [o._ops for o in others]}))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with(_Op("zip", "zip", None, {"other": other._ops}))
+
+    # ---- execution ----
+    def iter_blocks(self) -> Iterator[Block]:
+        yield from _execute(self._ops)
+
+    def materialize(self) -> "Dataset":
+        blocks = [b for b in self.iter_blocks() if block_num_rows(b)]
+        return Dataset([_Op("from_blocks", "source", None,
+                            {"blocks": blocks})])
+
+    # ---- consumption ----
+    def count(self) -> int:
+        return sum(block_num_rows(b) for b in self.iter_blocks())
+
+    def take(self, n: int = 20) -> List[dict]:
+        out: List[dict] = []
+        for b in self.iter_blocks():
+            for r in block_rows(b):
+                out.append(r)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> List[dict]:
+        return [r for b in self.iter_blocks() for r in block_rows(b)]
+
+    def take_batch(self, batch_size: int = 20,
+                   batch_format: BatchFormat = "numpy"):
+        it = self.iterator().iter_batches(batch_size=batch_size,
+                                          batch_format=batch_format)
+        return next(iter(it))
+
+    def show(self, n: int = 20) -> None:
+        for r in self.take(n):
+            print(r)
+
+    def schema(self) -> Dict[str, str]:
+        for b in self.iter_blocks():
+            if block_num_rows(b):
+                return {k: str(v.dtype) for k, v in b.items()}
+        return {}
+
+    def columns(self) -> List[str]:
+        return list(self.schema().keys())
+
+    def to_pandas(self):
+        blocks = list(self.iter_blocks())
+        return block_to_pandas(block_concat(blocks) if blocks else {})
+
+    def sum(self, on: str) -> float:
+        return float(sum(float(np.sum(b[on]))
+                         for b in self.iter_blocks() if block_num_rows(b)))
+
+    def min(self, on: str):
+        vals = [np.min(b[on]) for b in self.iter_blocks()
+                if block_num_rows(b)]
+        return np.min(vals) if vals else None
+
+    def max(self, on: str):
+        vals = [np.max(b[on]) for b in self.iter_blocks()
+                if block_num_rows(b)]
+        return np.max(vals) if vals else None
+
+    def mean(self, on: str) -> Optional[float]:
+        total, count = 0.0, 0
+        for b in self.iter_blocks():
+            n = block_num_rows(b)
+            if n:
+                total += float(np.sum(b[on]))
+                count += n
+        return total / count if count else None
+
+    def iter_rows(self) -> Iterator[dict]:
+        for b in self.iter_blocks():
+            yield from block_rows(b)
+
+    def iterator(self) -> "DataIterator":
+        from ray_tpu.data.iterator import DataIterator
+        return DataIterator(self.iter_blocks)
+
+    def iter_batches(self, **kw):
+        return self.iterator().iter_batches(**kw)
+
+    def iter_torch_batches(self, **kw):
+        return self.iterator().iter_torch_batches(**kw)
+
+    def iter_jax_batches(self, **kw):
+        return self.iterator().iter_jax_batches(**kw)
+
+    # ---- split for distributed training ----
+    def streaming_split(self, n: int, *, equal: bool = True
+                        ) -> List["DataIterator"]:
+        """n per-worker iterators (reference: dataset.py:2037
+        streaming_split feeding one trainer each via
+        stream_split_iterator.py). Blocks are materialized into the object
+        store and dealt round-robin; each shard iterator pulls its blocks
+        through the object plane on its own host."""
+        import ray_tpu
+        from ray_tpu.data.iterator import DataIterator
+        shard_refs: List[List] = [[] for _ in range(n)]
+        if equal:
+            # Exact row-balanced shards: merge then slice (blocks larger
+            # than a shard must be split by rows, not dealt whole).
+            blocks = [b for b in self.iter_blocks() if block_num_rows(b)]
+            merged = block_concat(blocks) if blocks else {}
+            total = block_num_rows(merged)
+            per, extra = divmod(total, n)
+            start = 0
+            for j in range(n):
+                end = start + per + (1 if j < extra else 0)
+                if end > start:
+                    shard_refs[j].append(
+                        ray_tpu.put(block_slice(merged, start, end)))
+                start = end
+        else:
+            for i, b in enumerate(self.iter_blocks()):
+                if block_num_rows(b):
+                    shard_refs[i % n].append(ray_tpu.put(b))
+
+        def make_iter(refs):
+            def gen():
+                import ray_tpu as rt
+                for r in refs:
+                    yield rt.get(r)
+            return DataIterator(gen)
+        return [make_iter(refs) for refs in shard_refs]
+
+    def split(self, n: int) -> List["Dataset"]:
+        blocks = list(self.iter_blocks())
+        rows = block_concat(blocks) if blocks else {}
+        total = block_num_rows(rows)
+        per = total // n
+        out = []
+        for i in range(n):
+            start = i * per
+            end = total if i == n - 1 else (i + 1) * per
+            out.append(Dataset([_Op("from_blocks", "source", None,
+                                    {"blocks": [block_slice(rows, start,
+                                                            end)]})]))
+        return out
+
+    # ---- writes ----
+    def write_parquet(self, path: str) -> None:
+        from ray_tpu.data.datasource import write_parquet
+        write_parquet(self, path)
+
+    def write_csv(self, path: str) -> None:
+        from ray_tpu.data.datasource import write_csv
+        write_csv(self, path)
+
+    def write_json(self, path: str) -> None:
+        from ray_tpu.data.datasource import write_json
+        write_json(self, path)
+
+    def __repr__(self):
+        names = "->".join(op.name for op in self._ops)
+        return f"Dataset({names})"
+
+
+class GroupedData:
+    """Hash aggregation (reference: grouped_data.py + hash-aggregate
+    physical operator)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, cols: Dict[str, Tuple[str, Callable]]) -> Dataset:
+        groups: Dict[Any, List[dict]] = {}
+        for row in self._ds.iter_rows():
+            groups.setdefault(row[self._key], []).append(row)
+        out_rows = []
+        for k, rows in groups.items():
+            out = {self._key: k}
+            for out_name, (col, fn) in cols.items():
+                out[out_name] = fn([r[col] for r in rows])
+            out_rows.append(out)
+        return Dataset([_Op("from_blocks", "source", None,
+                            {"blocks": [block_from_rows(out_rows)]})])
+
+    def count(self) -> Dataset:
+        ds = self._ds
+        key = self._key
+        groups: Dict[Any, int] = {}
+        for row in ds.iter_rows():
+            groups[row[key]] = groups.get(row[key], 0) + 1
+        rows = [{key: k, "count()": v} for k, v in groups.items()]
+        return Dataset([_Op("from_blocks", "source", None,
+                            {"blocks": [block_from_rows(rows)]})])
+
+    def sum(self, on: str) -> Dataset:
+        return self._agg({f"sum({on})": (on, lambda v: float(np.sum(v)))})
+
+    def mean(self, on: str) -> Dataset:
+        return self._agg({f"mean({on})": (on, lambda v: float(np.mean(v)))})
+
+    def min(self, on: str) -> Dataset:
+        return self._agg({f"min({on})": (on, lambda v: np.min(v))})
+
+    def max(self, on: str) -> Dataset:
+        return self._agg({f"max({on})": (on, lambda v: np.max(v))})
+
+    def std(self, on: str) -> Dataset:
+        return self._agg({f"std({on})": (on, lambda v: float(np.std(v)))})
+
+
+# --- execution engine --------------------------------------------------------
+
+def _execute(ops: List[_Op]) -> Iterator[Block]:
+    """Build the generator pipeline bottom-up. Each stage pulls from the
+    previous — streaming with inherent backpressure (the reference gets the
+    same property from StreamingExecutor's bounded buffers)."""
+    stream: Iterator[Block] = iter(())
+    for op in ops:
+        stream = _apply(stream, op)
+    return stream
+
+
+def _apply(stream: Iterator[Block], op: _Op) -> Iterator[Block]:
+    if op.kind == "source":
+        return _source(op)
+    if op.kind == "map_rows":
+        return (_map_rows(b, op.fn) for b in stream)
+    if op.kind == "flat_map":
+        return (_flat_map_rows(b, op.fn) for b in stream)
+    if op.kind == "filter":
+        return (_filter_rows(b, op.fn) for b in stream)
+    if op.kind == "map_batches":
+        return _map_batches_stream(stream, op)
+    if op.kind == "limit":
+        return _limit_stream(stream, op.args["n"])
+    if op.kind == "all2all":
+        return _all2all(stream, op)
+    if op.kind == "union":
+        def union_gen():
+            yield from stream
+            for other_ops in op.args["others"]:
+                yield from _execute(other_ops)
+        return union_gen()
+    if op.kind == "zip":
+        return _zip_stream(stream, _execute(op.args["other"]))
+    raise ValueError(f"unknown op kind {op.kind}")
+
+
+def _source(op: _Op) -> Iterator[Block]:
+    args = op.args
+    if "blocks" in args:
+        yield from args["blocks"]
+        return
+    if "block_fns" in args:
+        for fn in args["block_fns"]:
+            out = fn()
+            if isinstance(out, dict):
+                yield out
+            else:
+                yield from out
+        return
+    raise ValueError("source op missing blocks")
+
+
+def _map_rows(b: Block, fn) -> Block:
+    return block_from_rows([fn(r) for r in block_rows(b)])
+
+
+def _flat_map_rows(b: Block, fn) -> Block:
+    out: List[dict] = []
+    for r in block_rows(b):
+        out.extend(fn(r))
+    return block_from_rows(out)
+
+
+def _filter_rows(b: Block, fn) -> Block:
+    keep = np.asarray([bool(fn(r)) for r in block_rows(b)])
+    if not keep.any():
+        return {}
+    return block_take(b, np.nonzero(keep)[0])
+
+
+def _rebatch(stream: Iterator[Block],
+             batch_size: Optional[int]) -> Iterator[Block]:
+    if batch_size is None:
+        yield from stream
+        return
+    buf: List[Block] = []
+    rows = 0
+    for b in stream:
+        n = block_num_rows(b)
+        if not n:
+            continue
+        buf.append(b)
+        rows += n
+        while rows >= batch_size:
+            merged = block_concat(buf)
+            yield block_slice(merged, 0, batch_size)
+            rest = block_slice(merged, batch_size, block_num_rows(merged))
+            buf = [rest] if block_num_rows(rest) else []
+            rows = block_num_rows(rest)
+    if rows:
+        yield block_concat(buf)
+
+
+def _convert_in(b: Block, fmt: str):
+    if fmt == "pandas":
+        return block_to_pandas(b)
+    if fmt == "rows":
+        return list(block_rows(b))
+    return b
+
+
+def _convert_out(out) -> Block:
+    if isinstance(out, dict):
+        return {k: np.asarray(v) for k, v in out.items()}
+    if isinstance(out, list):
+        return block_from_rows(out)
+    try:
+        import pandas as pd
+        if isinstance(out, pd.DataFrame):
+            return {c: out[c].to_numpy() for c in out.columns}
+    except ImportError:
+        pass
+    raise TypeError(f"map_batches fn returned {type(out)}")
+
+
+def _map_batches_stream(stream: Iterator[Block], op: _Op) -> Iterator[Block]:
+    args = op.args
+    fmt = args.get("batch_format", "numpy")
+    concurrency = args.get("concurrency")
+    batches = _rebatch(stream, args.get("batch_size"))
+    fn = op.fn
+
+    if concurrency and concurrency > 1 and _runtime_up():
+        yield from _parallel_map(batches, fn, fmt, concurrency)
+        return
+    for b in batches:
+        yield _convert_out(fn(_convert_in(b, fmt)))
+
+
+def _runtime_up() -> bool:
+    try:
+        import ray_tpu
+        return ray_tpu.is_initialized()
+    except Exception:
+        return False
+
+
+def _parallel_map(batches: Iterator[Block], fn, fmt: str,
+                  concurrency: int) -> Iterator[Block]:
+    """Fan batches out to runtime tasks, keep at most `concurrency` in
+    flight, yield in order (reference: TaskPoolMapOperator with its
+    resource-budgeted in-flight window)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _run_batch(fn_, b, fmt_):
+        return _convert_out(fn_(_convert_in(b, fmt_)))
+
+    window: List = []
+    for b in batches:
+        window.append(_run_batch.remote(fn, b, fmt))
+        if len(window) >= concurrency:
+            yield ray_tpu.get(window.pop(0), timeout=600)
+    for ref in window:
+        yield ray_tpu.get(ref, timeout=600)
+
+
+def _limit_stream(stream: Iterator[Block], n: int) -> Iterator[Block]:
+    left = n
+    for b in stream:
+        rows = block_num_rows(b)
+        if rows <= left:
+            yield b
+            left -= rows
+        else:
+            yield block_slice(b, 0, left)
+            left = 0
+        if left <= 0:
+            return
+
+
+def _all2all(stream: Iterator[Block], op: _Op) -> Iterator[Block]:
+    mode = op.args["mode"]
+    blocks = [b for b in stream if block_num_rows(b)]
+    if not blocks:
+        return
+    merged = block_concat(blocks)
+    total = block_num_rows(merged)
+    if mode == "shuffle":
+        rng = np.random.default_rng(op.args.get("seed"))
+        idx = rng.permutation(total)
+        merged = block_take(merged, idx)
+        n_out = max(1, len(blocks))
+    elif mode == "sort":
+        key = op.args["key"]
+        idx = np.argsort(merged[key], kind="stable")
+        if op.args.get("descending"):
+            idx = idx[::-1]
+        merged = block_take(merged, idx)
+        n_out = max(1, len(blocks))
+    elif mode == "repartition":
+        n_out = op.args["n"]
+    else:
+        raise ValueError(mode)
+    per = max(1, total // n_out)
+    for i in range(n_out):
+        start = i * per
+        end = total if i == n_out - 1 else (i + 1) * per
+        if start >= total:
+            break
+        yield block_slice(merged, start, end)
+
+
+def _zip_stream(a: Iterator[Block], b: Iterator[Block]) -> Iterator[Block]:
+    abuf: List[Block] = []
+    bbuf: List[Block] = []
+
+    def pull(it, buf, need):
+        have = sum(block_num_rows(x) for x in buf)
+        while have < need:
+            try:
+                blk = next(it)
+            except StopIteration:
+                break
+            buf.append(blk)
+            have += block_num_rows(blk)
+
+    while True:
+        pull(a, abuf, 1)
+        pull(b, bbuf, 1)
+        na = sum(block_num_rows(x) for x in abuf)
+        nb = sum(block_num_rows(x) for x in bbuf)
+        n = min(na, nb)
+        if n == 0:
+            return
+        ma, mb = block_concat(abuf), block_concat(bbuf)
+        out = {}
+        out.update(block_slice(ma, 0, n))
+        for k, v in block_slice(mb, 0, n).items():
+            out[k if k not in out else f"{k}_1"] = v
+        yield out
+        abuf = [block_slice(ma, n, na)] if na > n else []
+        bbuf = [block_slice(mb, n, nb)] if nb > n else []

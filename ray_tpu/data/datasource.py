@@ -1,0 +1,163 @@
+"""Datasources: creation + IO (reference: python/ray/data/read_api.py,
+data/datasource/). Files become one source block-fn per file/fragment so
+reads stream lazily into the pipeline."""
+
+from __future__ import annotations
+
+import glob as _glob
+import json as _json
+import os
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.data.block import (Block, block_from_arrow, block_from_items,
+                                block_from_rows, block_num_rows,
+                                block_slice, block_to_arrow)
+from ray_tpu.data.dataset import Dataset, _Op
+
+
+def _source_ds(name: str, **args) -> Dataset:
+    return Dataset([_Op(name, "source", None, args)])
+
+
+def from_blocks(blocks: List[Block]) -> Dataset:
+    return _source_ds("from_blocks", blocks=blocks)
+
+
+def from_items(items: Sequence[Any], *,
+               block_size: int = 4096) -> Dataset:
+    import builtins
+    items = list(items)
+    blocks = [block_from_items(items[i:i + block_size])
+              for i in builtins.range(0, max(len(items), 1), block_size)]
+    return _source_ds("from_items", blocks=blocks)
+
+
+def range(n: int, *, block_size: int = 65536) -> Dataset:  # noqa: A001
+    import builtins
+    fns = []
+    for start in builtins.range(0, n, block_size):
+        end = min(start + block_size, n)
+        fns.append(lambda s=start, e=end: {"id": np.arange(s, e)})
+    return _source_ds("range", block_fns=fns)
+
+
+def from_numpy(arr: np.ndarray, column: str = "data") -> Dataset:
+    return _source_ds("from_numpy", blocks=[{column: arr}])
+
+
+def from_pandas(df) -> Dataset:
+    return _source_ds("from_pandas",
+                      blocks=[{c: df[c].to_numpy() for c in df.columns}])
+
+
+def _expand(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    """(reference: read_api.py:943 read_parquet)."""
+    import pyarrow.parquet as pq
+    files = _expand(paths)
+
+    def make(path):
+        def fn():
+            table = pq.read_table(path, columns=columns)
+            return block_from_arrow(table)
+        return fn
+    return _source_ds("read_parquet", block_fns=[make(p) for p in files])
+
+
+def read_csv(paths, **read_kwargs) -> Dataset:
+    import pyarrow.csv as pacsv
+    files = _expand(paths)
+
+    def make(path):
+        def fn():
+            return block_from_arrow(pacsv.read_csv(path))
+        return fn
+    return _source_ds("read_csv", block_fns=[make(p) for p in files])
+
+
+def read_json(paths) -> Dataset:
+    files = _expand(paths)
+
+    def make(path):
+        def fn():
+            rows = []
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(_json.loads(line))
+            return block_from_rows(rows)
+        return fn
+    return _source_ds("read_json", block_fns=[make(p) for p in files])
+
+
+def read_text(paths) -> Dataset:
+    files = _expand(paths)
+
+    def make(path):
+        def fn():
+            with open(path) as f:
+                lines = [ln.rstrip("\n") for ln in f]
+            return {"text": np.asarray(lines, dtype=object)}
+        return fn
+    return _source_ds("read_text", block_fns=[make(p) for p in files])
+
+
+def read_numpy(paths) -> Dataset:
+    files = _expand(paths)
+
+    def make(path):
+        def fn():
+            return {"data": np.load(path)}
+        return fn
+    return _source_ds("read_numpy", block_fns=[make(p) for p in files])
+
+
+# --- writes -----------------------------------------------------------------
+
+def write_parquet(ds: Dataset, path: str) -> None:
+    import pyarrow.parquet as pq
+    os.makedirs(path, exist_ok=True)
+    for i, b in enumerate(ds.iter_blocks()):
+        if block_num_rows(b):
+            pq.write_table(block_to_arrow(b),
+                           os.path.join(path, f"part-{i:05d}.parquet"))
+
+
+def write_csv(ds: Dataset, path: str) -> None:
+    import pyarrow.csv as pacsv
+    os.makedirs(path, exist_ok=True)
+    for i, b in enumerate(ds.iter_blocks()):
+        if block_num_rows(b):
+            pacsv.write_csv(block_to_arrow(b),
+                            os.path.join(path, f"part-{i:05d}.csv"))
+
+
+def write_json(ds: Dataset, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    from ray_tpu.data.block import block_rows
+    for i, b in enumerate(ds.iter_blocks()):
+        if block_num_rows(b):
+            with open(os.path.join(path, f"part-{i:05d}.jsonl"), "w") as f:
+                for r in block_rows(b):
+                    f.write(_json.dumps(
+                        {k: (v.tolist() if isinstance(v, np.ndarray)
+                             else v.item() if isinstance(v, np.generic)
+                             else v) for k, v in r.items()}) + "\n")

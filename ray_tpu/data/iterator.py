@@ -1,0 +1,85 @@
+"""DataIterator: batch iteration with framework conversion + device staging.
+
+Reference: python/ray/data/iterator.py (iter_batches, iter_torch_batches at
+:309). TPU-first addition: iter_jax_batches stages host numpy batches onto
+devices with jax.device_put — optionally double-buffered so host→HBM copy
+overlaps the previous step's compute (the usual input-pipeline trick the
+scaling book prescribes).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, block_num_rows, block_to_pandas
+from ray_tpu.data.dataset import _rebatch
+
+
+class DataIterator:
+    def __init__(self, block_gen: Callable[[], Iterator[Block]]):
+        self._block_gen = block_gen
+
+    def iter_blocks(self) -> Iterator[Block]:
+        return self._block_gen()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False):
+        for b in _rebatch(self._block_gen(), batch_size):
+            if drop_last and block_num_rows(b) < batch_size:
+                continue
+            if batch_format == "pandas":
+                yield block_to_pandas(b)
+            elif batch_format == "rows":
+                from ray_tpu.data.block import block_rows
+                yield list(block_rows(b))
+            else:
+                yield b
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False,
+                           dtypes: Optional[dict] = None):
+        import torch
+        for b in self.iter_batches(batch_size=batch_size,
+                                   drop_last=drop_last):
+            out = {}
+            for k, v in b.items():
+                t = torch.from_numpy(np.ascontiguousarray(v))
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                out[k] = t
+            yield out
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         drop_last: bool = True,
+                         sharding=None,
+                         prefetch: int = 1):
+        """Device-resident batches. With prefetch>=1, the NEXT batch's
+        device_put is issued before the current one is yielded, so the
+        host->device copy overlaps downstream compute."""
+        import jax
+
+        def put(b):
+            if sharding is not None:
+                return {k: jax.device_put(v, sharding)
+                        for k, v in b.items()}
+            return {k: jax.device_put(v) for k, v in b.items()}
+
+        it = self.iter_batches(batch_size=batch_size, drop_last=drop_last)
+        buf = []
+        for b in it:
+            buf.append(put(b))
+            if len(buf) > max(prefetch, 0):
+                yield buf.pop(0)
+        yield from buf
+
+    def materialize(self):
+        from ray_tpu.data.dataset import Dataset, _Op
+        blocks = [b for b in self._block_gen() if block_num_rows(b)]
+        return Dataset([_Op("from_blocks", "source", None,
+                            {"blocks": blocks})])
+
+    def count(self) -> int:
+        return sum(block_num_rows(b) for b in self._block_gen())

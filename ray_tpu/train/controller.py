@@ -83,13 +83,16 @@ class TrainController:
             for i in range(num_workers)
         ]
         # Rank-by-topology: reference sorts workers by TPU pod / node id
-        # (worker_group.py:790,866) so ranks are ICI-contiguous.
+        # (worker_group.py:790,866) so ranks are ICI-contiguous. Ranks are
+        # re-assigned post-sort so list position == world rank everywhere.
         infos = ray_tpu.get(
             [w.get_address.remote() for w in self._workers], timeout=120)
         order = sorted(range(num_workers),
                        key=lambda i: (infos[i]["node_id"], infos[i]["pid"]))
         self._workers = [self._workers[i] for i in order]
         self._infos = [infos[i] for i in order]
+        ray_tpu.get([w.set_rank.remote(i)
+                     for i, w in enumerate(self._workers)], timeout=60)
         return infos
 
     def _bootstrap_distributed(self, num_workers: int):
@@ -187,8 +190,10 @@ class TrainController:
                              if self.metrics_history else {}),
                     checkpoint=self.ckpt_manager.best(),
                     metrics_history=list(self.metrics_history))
-            except (api.ActorDiedError, api.WorkerCrashedError, api.TaskError,
-                    TrainGroupError) as e:
+            except (api.RayTpuError, TrainGroupError) as e:
+                # RayTpuError covers actor death, worker crash, task errors
+                # AND placement failures (create_pg raising) — all of them
+                # consult the failure policy rather than escaping fit().
                 failures += 1
                 self._teardown_group()
                 if failures > max_failures:

@@ -48,6 +48,14 @@ class TrainWorker:
                 "port": _free_port(), "pid": os.getpid(),
                 "node_id": os.environ.get("RAY_TPU_NODE_ID", "")}
 
+    def set_rank(self, rank: int, node_rank: Optional[int] = None) -> bool:
+        """Final rank assignment AFTER topology sort (the controller orders
+        workers by (node, pid) so ranks are ICI-contiguous; the provisional
+        constructor rank is positional only)."""
+        self.rank = rank
+        self.node_rank = node_rank if node_rank is not None else rank
+        return True
+
     def setup_env(self, env: Dict[str, str]) -> bool:
         """Distributed bootstrap env, set BEFORE any jax import in train_fn
         (reference: _JaxBackend.on_start at v2/jax/config.py:96-107 runs

@@ -1,0 +1,180 @@
+"""Data: plans, transforms, shuffles, IO, iteration, splits.
+
+Reference strategy: data tests build plans and execute against in-process
+clusters (reference: python/ray/data/tests/). Pure-local execution here;
+the task-parallel path and streaming_split get a live runtime below.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+
+
+def test_from_items_take_count():
+    ds = rd.from_items([{"a": i} for i in range(10)])
+    assert ds.count() == 10
+    assert ds.take(3) == [{"a": 0}, {"a": 1}, {"a": 2}]
+
+
+def test_range_map_filter():
+    ds = rd.range(100).map(lambda r: {"id": r["id"] * 2})
+    ds = ds.filter(lambda r: r["id"] % 10 == 0)
+    assert ds.count() == 20
+    assert ds.take(2) == [{"id": 0}, {"id": 10}]
+
+
+def test_map_batches_numpy():
+    ds = rd.range(1000).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2}, batch_size=128)
+    rows = ds.take_all()
+    assert len(rows) == 1000
+    assert rows[5]["sq"] == 25
+
+
+def test_map_batches_pandas():
+    ds = rd.range(50).map_batches(
+        lambda df: df.assign(double=df["id"] * 2),
+        batch_size=25, batch_format="pandas")
+    assert ds.take(1)[0]["double"] == 0
+    assert ds.count() == 50
+
+
+def test_flat_map_limit():
+    ds = rd.from_items([{"x": 1}, {"x": 2}]).flat_map(
+        lambda r: [{"x": r["x"]}, {"x": -r["x"]}])
+    assert ds.count() == 4
+    assert ds.limit(3).count() == 3
+
+
+def test_sort_and_shuffle():
+    ds = rd.from_items([{"v": v} for v in [3, 1, 2, 5, 4]])
+    assert [r["v"] for r in ds.sort("v").take_all()] == [1, 2, 3, 4, 5]
+    assert [r["v"] for r in ds.sort("v", descending=True).take_all()] == \
+        [5, 4, 3, 2, 1]
+    shuffled = ds.random_shuffle(seed=0).take_all()
+    assert sorted(r["v"] for r in shuffled) == [1, 2, 3, 4, 5]
+
+
+def test_groupby():
+    ds = rd.from_items([{"k": i % 3, "v": i} for i in range(9)])
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 3, 1: 3, 2: 3}
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums == {0: 0 + 3 + 6, 1: 1 + 4 + 7, 2: 2 + 5 + 8}
+
+
+def test_aggregates_union_zip():
+    a = rd.range(10)
+    assert a.sum("id") == 45
+    assert a.mean("id") == 4.5
+    assert a.min("id") == 0 and a.max("id") == 9
+    b = rd.range(5)
+    assert a.union(b).count() == 15
+    z = rd.from_items([{"x": 1}]).zip(rd.from_items([{"y": 2}]))
+    assert z.take_all() == [{"x": 1, "y": 2}]
+
+
+def test_repartition():
+    ds = rd.range(100).repartition(7)
+    blocks = list(ds.iter_blocks())
+    assert len(blocks) == 7
+    assert sum(len(b["id"]) for b in blocks) == 100
+
+
+def test_parquet_roundtrip():
+    with tempfile.TemporaryDirectory() as tmp:
+        ds = rd.range(100).map_batches(
+            lambda b: {"id": b["id"], "x": b["id"] * 0.5}, batch_size=30)
+        path = os.path.join(tmp, "out")
+        ds.write_parquet(path)
+        back = rd.read_parquet(path)
+        assert back.count() == 100
+        assert back.sum("id") == ds.sum("id")
+
+
+def test_csv_json_text_io():
+    with tempfile.TemporaryDirectory() as tmp:
+        rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]).write_csv(
+            os.path.join(tmp, "csv"))
+        assert rd.read_csv(os.path.join(tmp, "csv")).count() == 2
+        rd.from_items([{"a": 1}]).write_json(os.path.join(tmp, "js"))
+        assert rd.read_json(os.path.join(tmp, "js")).take_all() == [{"a": 1}]
+        p = os.path.join(tmp, "t.txt")
+        with open(p, "w") as f:
+            f.write("hello\nworld\n")
+        assert rd.read_text(p).take_all() == [
+            {"text": "hello"}, {"text": "world"}]
+
+
+def test_iter_batches_and_torch():
+    ds = rd.range(100)
+    batches = list(ds.iter_batches(batch_size=32))
+    assert [len(b["id"]) for b in batches] == [32, 32, 32, 4]
+    import torch
+    tb = next(iter(ds.iter_torch_batches(batch_size=10)))
+    assert isinstance(tb["id"], torch.Tensor) and tb["id"].shape == (10,)
+
+
+def test_iter_jax_batches():
+    import jax
+    ds = rd.range(64)
+    batches = list(ds.iter_jax_batches(batch_size=32, prefetch=1))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["id"], jax.Array)
+    assert int(batches[0]["id"].sum()) == sum(range(32))
+
+
+def test_schema_columns():
+    ds = rd.from_items([{"a": 1, "b": 2.0}])
+    s = ds.schema()
+    assert set(s) == {"a", "b"}
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    import ray_tpu
+    from ray_tpu.config import Config
+    cfg = Config.from_env(num_workers_prestart=1, max_workers_per_node=4)
+    ray_tpu.init(num_cpus=4, config=cfg)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_map_batches_task_parallel(runtime):
+    ds = rd.range(200).map_batches(
+        lambda b: {"id": b["id"], "neg": -b["id"]},
+        batch_size=50, concurrency=2)
+    rows = ds.take_all()
+    assert len(rows) == 200
+    assert rows[3]["neg"] == -3
+
+
+def test_streaming_split_with_runtime(runtime):
+    ds = rd.range(100)
+    shards = ds.streaming_split(3)
+    counts = [sh.count() for sh in shards]
+    assert sum(counts) == 100
+    assert max(counts) - min(counts) <= 40  # roughly equal by rows
+
+
+def test_train_integration_dataset_shard(runtime):
+    import ray_tpu
+    from ray_tpu import train
+    from ray_tpu.train.api import ScalingConfig
+
+    def train_fn():
+        ctx = train.get_context()
+        it = ctx.get_dataset_shard("train")
+        total = sum(int(b["id"].sum())
+                    for b in it.iter_batches(batch_size=64))
+        train.report({"total": total, "rank": ctx.get_world_rank()})
+
+    res = train.JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": rd.range(100)}).fit()
+    assert res.error is None
