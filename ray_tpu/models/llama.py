@@ -19,6 +19,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ray_tpu.ops.attention import attention as _attention_op, _on_tpu
@@ -50,7 +51,23 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     remat: bool = True
+    # What the per-layer jax.checkpoint saves for the backward pass:
+    #   "full"  — save nothing, recompute the whole layer (min memory,
+    #             ~33% extra FLOPs: fwd runs twice);
+    #   "dots"  — save weight-matmul outputs (checkpoint_dots_with_no_batch_dims):
+    #             backward recomputes only cheap elementwise/norm ops;
+    #   "attn"  — save just the attention output (skips re-running the flash
+    #             kernel; weight matmuls are recomputed);
+    #   "none"  — no remat (same as remat=False).
+    remat_policy: str = "full"
+    # Dtype of the logits / cross-entropy path. float32 is the numerically
+    # conservative default; bfloat16 halves the (b, s, vocab) HBM traffic and
+    # runs the exp/logsumexp passes at the faster bf16 VPU rate (loss error
+    # ~1e-2 absolute — fine for throughput-oriented runs).
+    logits_dtype: str = "float32"
     attn_impl: str = "auto"        # auto | reference | flash | flash_interpret | ring
+    attn_block_q: int = 128        # flash kernel tile sizes (MXU-multiple)
+    attn_block_k: int = 128
 
     @property
     def head_dim(self) -> int:
@@ -156,27 +173,43 @@ def _rmsnorm(x, w, eps):
     return (x32 * lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
-def _rope(x, positions, theta):
-    """x: (b, s, h, d). Rotates pairs (d/2 split)."""
-    b, s, h, d = x.shape
-    half = d // 2
+def _rope_tables(positions, head_dim, theta):
+    """cos/sin tables (b, s, half) f32, computed ONCE per forward — the
+    sin/cos transcendentals are hoisted out of the per-layer code (they cost
+    a full VPU pass per layer otherwise)."""
+    half = head_dim // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = positions[:, :, None].astype(jnp.float32) * freqs  # (b, s, half)
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rope(x, cos, sin):
+    """x: (b, s, h, d); cos/sin: (b, s, d//2) precomputed tables."""
+    half = x.shape[-1] // 2
+    c = cos[:, :, None, :].astype(x.dtype)
+    s = sin[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate(
-        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
 def _attend(q, k, v, cfg: LlamaConfig, mesh: Optional[Mesh],
             axes: MeshAxes):
     impl = cfg.attn_impl
+    blocks = dict(block_q=cfg.attn_block_q, block_k=cfg.attn_block_k)
+
+    def named(out):
+        # Flash paths name their own residuals (attn_out/attn_lse inside the
+        # custom_vjp fwd rule); the XLA paths get a single named output so
+        # the "attn" remat policy can save it.
+        return _checkpoint_name(out, "attn_res")
+
     if mesh is None:
         if impl in ("auto", "ring"):
             impl = "flash" if _on_tpu() and q.shape[1] >= 128 \
                 else "reference"
-        return _attention_op(q, k, v, causal=True, impl=impl)
+        out = _attention_op(q, k, v, causal=True, impl=impl, **blocks)
+        return out if impl.startswith("flash") else named(out)
 
     cp = mesh.shape.get(axes.context, 1)
     bspec = P(axes.batch, axes.context, axes.tensor, None)
@@ -184,8 +217,9 @@ def _attend(q, k, v, cfg: LlamaConfig, mesh: Optional[Mesh],
     if impl == "ring" or (impl == "auto" and cp > 1):
         def f(q, k, v):
             return ring_attention(q, k, v, axis_name=axes.context)
-        return jax.shard_map(f, mesh=mesh, in_specs=(bspec, bspec, bspec),
-                             out_specs=bspec)(q, k, v)
+        return named(jax.shard_map(f, mesh=mesh,
+                                   in_specs=(bspec, bspec, bspec),
+                                   out_specs=bspec)(q, k, v))
 
     if cp > 1:
         # Explicit non-ring impl on a context-sharded mesh: run with global
@@ -195,17 +229,38 @@ def _attend(q, k, v, cfg: LlamaConfig, mesh: Optional[Mesh],
             raise ValueError(
                 f"attn_impl={impl!r} cannot run under a context-parallel "
                 f"mesh (context axis size {cp}); use 'ring' or 'auto'")
-        return _attention_op(q, k, v, causal=True, impl=impl)
+        return named(_attention_op(q, k, v, causal=True, impl=impl))
 
     if impl == "auto":
         impl = "flash" if _on_tpu() and q.shape[1] >= 128 \
             else "reference"
 
     def f(q, k, v):
-        return _attention_op(q, k, v, causal=True, impl=impl)
+        return _attention_op(q, k, v, causal=True, impl=impl, **blocks)
     # check_vma=False: pallas_call outputs carry no vma under shard_map.
-    return jax.shard_map(f, mesh=mesh, in_specs=(bspec, bspec, bspec),
-                         out_specs=bspec, check_vma=False)(q, k, v)
+    out = jax.shard_map(f, mesh=mesh, in_specs=(bspec, bspec, bspec),
+                        out_specs=bspec, check_vma=False)(q, k, v)
+    return out if impl.startswith("flash") else named(out)
+
+
+def _remat(layer, cfg: LlamaConfig):
+    if not cfg.remat or cfg.remat_policy == "none":
+        return layer
+    cp = jax.checkpoint_policies
+    if cfg.remat_policy == "full":
+        return jax.checkpoint(layer)
+    if cfg.remat_policy == "dots":
+        policy = cp.save_from_both_policies(
+            cp.checkpoint_dots_with_no_batch_dims,
+            cp.save_only_these_names("attn_out", "attn_lse"))
+    elif cfg.remat_policy == "attn":
+        # Saves the flash kernel outputs (o + lse residuals) so backward
+        # never re-runs the attention forward; "attn_res" covers the
+        # non-flash attention paths (reference/ring).
+        policy = cp.save_only_these_names("attn_out", "attn_lse", "attn_res")
+    else:
+        raise ValueError(f"unknown remat_policy: {cfg.remat_policy!r}")
+    return jax.checkpoint(layer, policy=policy)
 
 
 def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
@@ -224,6 +279,7 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     x = jnp.take(params["embed"], tokens, axis=0)
     x = act_constraint(x, P(axes.batch, axes.context, None))
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    rope_cos, rope_sin = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
     def layer(x, lp):
         # attention block
@@ -231,8 +287,8 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
         q = (y @ lp["wq"]).reshape(b, s, h, hd)
         k = (y @ lp["wk"]).reshape(b, s, kvh, hd)
         v = (y @ lp["wv"]).reshape(b, s, kvh, hd)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, rope_cos, rope_sin)
+        k = _rope(k, rope_cos, rope_sin)
         o = _attend(q, k, v, cfg, mesh, axes).astype(x.dtype)
         x = x + (o.reshape(b, s, h * hd) @ lp["wo"])
         x = act_constraint(x, P(axes.batch, axes.context, None))
@@ -244,10 +300,10 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
         x = act_constraint(x, P(axes.batch, axes.context, None))
         return x, None
 
-    step = jax.checkpoint(layer) if cfg.remat else layer
+    step = _remat(layer, cfg)
     x, _ = lax.scan(step, x, params["layers"])
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = (x @ params["lm_head"]).astype(jnp.dtype(cfg.logits_dtype))
     return logits
 
 
@@ -257,9 +313,13 @@ def loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
     """batch: {"tokens": (b, s), "targets": (b, s), "mask": optional}."""
     logits = forward(params, batch["tokens"], cfg, mesh, axes)
     targets = batch["targets"]
-    logz = jax.nn.logsumexp(logits, axis=-1)
+    # max/exp run in the logits dtype (bf16 when configured — faster VPU
+    # rate, half the HBM traffic); accumulation and the final log are f32.
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    sumexp = jnp.sum(jnp.exp(logits - m), axis=-1, dtype=jnp.float32)
+    logz = m[..., 0].astype(jnp.float32) + jnp.log(sumexp)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = logz - gold
+    nll = logz - gold.astype(jnp.float32)
     mask = batch.get("mask")
     if mask is None:
         return jnp.mean(nll)

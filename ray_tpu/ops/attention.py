@@ -70,11 +70,20 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     o, lse = _fa.flash_attention_fwd(q, k, v, sm_scale=sm_scale, causal=causal,
                                      block_q=block_q, block_k=block_k,
                                      interpret=interpret)
-    return o, (q, k, v, o, lse)
+    # Under jax.checkpoint with a save_only_these_names policy, naming the
+    # kernel outputs lets the backward pass reuse them instead of re-running
+    # the forward kernel (q/k/v are cheap weight-matmul recomputes; o/lse
+    # are not). The lse residual is stored logically (BH, S, 1) — saving the
+    # kernel's lane-broadcast (BH, S, LANES) layout would cost 128x the HBM.
+    from jax.ad_checkpoint import checkpoint_name
+    o = checkpoint_name(o, "attn_out")
+    lse_small = checkpoint_name(lse[:, :, :1], "attn_lse")
+    return o, (q, k, v, o, lse_small)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, o, lse = res
+    q, k, v, o, lse_small = res
+    lse = jnp.broadcast_to(lse_small, lse_small.shape[:2] + (_fa.LANES,))
     dq, dk, dv = _fa.flash_attention_bwd(
         q, k, v, o, do, lse, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret)
@@ -111,7 +120,8 @@ def _on_tpu() -> bool:
 
 def attention(q, k, v, *, causal: bool = True,
               sm_scale: Optional[float] = None,
-              impl: str = "auto") -> jax.Array:
+              impl: str = "auto",
+              block_q: int = 128, block_k: int = 128) -> jax.Array:
     """Dispatch: 'auto' uses the Pallas kernel on TPU for seq >= 128 and the
     XLA reference otherwise. 'flash' / 'reference' force a path;
     'flash_interpret' runs the kernel in interpret mode (CPU tests)."""
@@ -120,8 +130,10 @@ def attention(q, k, v, *, causal: bool = True,
     if impl == "reference":
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     if impl == "flash":
-        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               block_q=block_q, block_k=block_k)
     if impl == "flash_interpret":
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               block_q=block_q, block_k=block_k,
                                interpret=True)
     raise ValueError(f"unknown attention impl: {impl}")

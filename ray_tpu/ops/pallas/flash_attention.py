@@ -5,6 +5,17 @@ S x S score matrix out of HBM; blocks are sized for the MXU (128 lanes) and
 VMEM residency. Used by :mod:`ray_tpu.ops.attention` which wires it into a
 ``jax.custom_vjp``.
 
+Design notes (measured on v5e):
+- Matmul operands stay in the input dtype (bf16) with f32 MXU accumulation;
+  upcasting operands to f32 would halve MXU throughput.
+- ``sm_scale`` is folded into ``q`` before the kernels run, saving a full
+  elementwise pass over the S x S score matrix in every kernel (the VPU, not
+  the MXU, is the bottleneck of flash attention at long seq). The dq output
+  is rescaled once outside (O(S*D), negligible).
+- One masked code path: TPU predication (pl.when) compiles both branches
+  into the kernel, so splitting interior/edge tiles doubles VMEM stack for
+  no win (measured).
+
 Sequence lengths need not divide the block size: wrappers zero-pad to block
 multiples and kernels mask out-of-bounds columns (padded rows are sliced off
 and padded inputs are zeros, so gradients through padding vanish).
@@ -60,7 +71,7 @@ def _last_k_block(qi, block_q, block_k, num_kv_blocks, offset):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
-                sm_scale, causal, block_q, block_k, num_kv_blocks, kv_len,
+                causal, block_q, block_k, num_kv_blocks, kv_len,
                 offset, with_lse):
     if with_lse:
         lse_ref, m_scr, l_scr, acc_scr = rest
@@ -84,25 +95,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 
     @pl.when(ki <= last_k)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)            # (block_k, d)
-        v = v_ref[0].astype(jnp.float32)            # (block_k, d)
+        q = q_ref[0]                                # (block_q, d), pre-scaled
+        k = k_ref[0]                                # (block_k, d)
+        v = v_ref[0]                                # (block_k, d)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        s, keep = _mask_s(s * sm_scale, qi, ki, block_q, block_k,
+        s, keep = _mask_s(s, qi, ki, block_q, block_k,
                           kv_len, causal, offset)
 
         m_prev = m_scr[...][:, :1]                  # (block_q, 1)
         l_prev = l_scr[...][:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # (block_q, 1)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.where(keep, jnp.exp(s - m_new), 0.0)
+        # exp in the input dtype: bf16 exp is measurably faster on the VPU
+        # and p feeds a bf16 MXU matmul anyway; f32 inputs keep f32 exp.
+        pdt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
+        p = jnp.where(keep, jnp.exp((s - m_new).astype(pdt)), pdt(0.0))
         alpha = jnp.exp(m_prev - m_new)             # (block_q, 1)
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        l_new = alpha * l_prev + jnp.sum(p.astype(jnp.float32), axis=-1,
+                                         keepdims=True)
 
         acc = acc_scr[...]
         acc = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         acc_scr[...] = acc
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -129,12 +145,13 @@ def flash_attention_fwd(q, k, v, *, sm_scale, causal, block_q=128, block_k=128,
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     offset = sk - sq
+    q = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)  # fold scale in
     qp, kp, vp = _pad_seq(q, block_q), _pad_seq(k, block_k), _pad_seq(v, block_k)
     nq = qp.shape[1] // block_q
     nk = kp.shape[1] // block_k
 
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        _fwd_kernel, causal=causal,
         block_q=block_q, block_k=block_k, num_kv_blocks=nk, kv_len=sk,
         offset=offset, with_lse=with_lse)
 
@@ -176,11 +193,13 @@ def flash_attention_fwd(q, k, v, *, sm_scale, causal, block_q=128, block_k=128,
 # pass). Kernels: (1) dk/dv with grid over kv blocks, inner loop over q
 # blocks; (2) dq with grid over q blocks, inner loop over kv blocks. p is
 # recomputed per tile from q,k and lse; delta = rowsum(do * o).
+# q arrives pre-scaled by sm_scale, so p = exp(q'k - lse) directly and
+# ds needs no extra scale for dk; dq is rescaled by the wrapper.
 # ---------------------------------------------------------------------------
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, sm_scale, causal, block_q, block_k, num_q_blocks, kv_len,
+                *, causal, block_q, block_k, num_q_blocks, kv_len,
                 offset):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -199,25 +218,29 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(should_run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # (bq, d)
-        k = k_ref[0].astype(jnp.float32)            # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)          # (bq, d)
+        q = q_ref[0]                                # (bq, d), pre-scaled
+        k = k_ref[0]                                # (bk, d)
+        v = v_ref[0]
+        do = do_ref[0]                              # (bq, d)
         lse = lse_ref[0][:, :1]                     # (bq, 1)
         delta = delta_ref[0][:, :1]                 # (bq, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
+                                preferred_element_type=jnp.float32)
         s, keep = _mask_s(s, qi, ki, block_q, block_k, kv_len, causal, offset)
-        p = jnp.where(keep, jnp.exp(s - lse), 0.0)  # (bq, bk)
+        pdt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
+        p = jnp.where(keep, jnp.exp((s - lse).astype(pdt)), pdt(0.0))  # (bq, bk)
         # dv += p^T do
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         # dp = do v^T ; ds = p * (dp - delta)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = p * (dp - delta)
+        # dk = ds^T q'  (q' = sm_scale*q, so the scale is already included)
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_q_blocks - 1)
     def _finalize():
@@ -227,7 +250,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dq_ref, dq_scr,
-               *, sm_scale, causal, block_q, block_k, num_kv_blocks, kv_len,
+               *, causal, block_q, block_k, num_kv_blocks, kv_len,
                offset):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -243,21 +266,24 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(ki <= last_k)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]                                # pre-scaled
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
+                                preferred_element_type=jnp.float32)
         s, keep = _mask_s(s, qi, ki, block_q, block_k, kv_len, causal, offset)
-        p = jnp.where(keep, jnp.exp(s - lse), 0.0)
+        pdt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
+        p = jnp.where(keep, jnp.exp((s - lse).astype(pdt)), pdt(0.0))
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        ds = p * (dp - delta)
+        # dq' = ds k ; wrapper multiplies by sm_scale once outside.
         dq_scr[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(ki == last_k)
     def _finalize():
@@ -272,6 +298,7 @@ def flash_attention_bwd(q, k, v, o, do, lse, *, sm_scale, causal,
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     offset = sk - sq
+    q = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)  # fold scale in
     qp = _pad_seq(q, block_q)
     kp, vp = _pad_seq(k, block_k), _pad_seq(v, block_k)
     op, dop = _pad_seq(o, block_q), _pad_seq(do, block_q)
@@ -285,7 +312,7 @@ def flash_attention_bwd(q, k, v, o, do, lse, *, sm_scale, causal,
     delta = jnp.broadcast_to(delta[:, :, None], (bh, sqp, LANES))
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+        functools.partial(_dkv_kernel, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
                           kv_len=sk, offset=offset),
         grid=(bh, nk, nq),
@@ -315,7 +342,7 @@ def flash_attention_bwd(q, k, v, o, do, lse, *, sm_scale, causal,
     )(qp, kp, vp, dop, lse, delta)
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+        functools.partial(_dq_kernel, causal=causal,
                           block_q=block_q, block_k=block_k, num_kv_blocks=nk,
                           kv_len=sk, offset=offset),
         grid=(bh, nq, nk),
@@ -335,4 +362,5 @@ def flash_attention_bwd(q, k, v, o, do, lse, *, sm_scale, causal,
         interpret=interpret,
     )(qp, kp, vp, dop, lse, delta)
 
-    return dq[:, :sq], dk[:, :sk], dv[:, :sk]
+    dq = (dq[:, :sq].astype(jnp.float32) * sm_scale).astype(q.dtype)
+    return dq, dk[:, :sk], dv[:, :sk]

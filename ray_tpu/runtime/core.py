@@ -435,7 +435,11 @@ class CoreContext:
         seg = r.get("segname")
         if seg is None:
             raise ObjectLostError(f"{oid} not found in any object store")
-        mv = self.shm_reader.read(seg, r["size"])
+        # Read-only view: deserialized numpy arrays alias the node-wide
+        # object store; a writable view would let any consumer silently
+        # corrupt the sealed object for every other reader (the reference
+        # makes plasma buffers read-only for the same reason).
+        mv = self.shm_reader.read(seg, r["size"]).toreadonly()
         return loads_oob(mv)
 
     async def _handle_fetch_object(self, oid: ObjectID,
@@ -554,13 +558,17 @@ class CoreContext:
                 await self.leases.release_slot(lw)
                 self._apply_result(oids, r)
                 return
+            except rpc.RemoteError as e:
+                # Handler-level failure from a live worker: the worker is
+                # fine — return it to the idle pool (marking it dead would
+                # leave it stuck in LEASED forever, leaking slots).
+                if lw is not None:
+                    await self.leases.release_slot(lw)
+                self._fail_all(oids, TaskError(str(e)))
+                return
             except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
                 if lw is not None:
                     await self.leases.release_slot(lw, dead=True)
-                if isinstance(e, rpc.RemoteError):
-                    # handler-level failure that isn't a crash: surface it
-                    self._fail_all(oids, TaskError(str(e)))
-                    return
                 attempt += 1
                 if attempt > retries:
                     self._fail_all(

@@ -43,6 +43,17 @@ class ConnectionLost(RpcError):
     pass
 
 
+class ChaosDropped(RpcError):
+    """Injected transport-level failure: the request or reply was dropped by
+    the chaos plan. Retryable — ConnectionPool.call retries it, so enabling
+    chaos exercises the retry paths instead of failing tasks outright (the
+    reference's chaos likewise produces retryable transport errors,
+    rpc/rpc_chaos.h)."""
+
+
+_CHAOS_MARK = "__chaos__:"
+
+
 # --- chaos -----------------------------------------------------------------
 # Deterministic fault injection for tests (reference: src/ray/rpc/rpc_chaos.h
 # and the RAY_testing_rpc_failure env). Spec: "Method=N:p_req:p_rep,..." —
@@ -165,7 +176,7 @@ class RpcServer:
         if fail == "request":
             if writer is not None:
                 _write_frame(writer, (REPLY_ERR, msg_id,
-                                      "chaos: request dropped", None))
+                                      _CHAOS_MARK + "request dropped", None))
             return
         try:
             handler = self._handlers[method]
@@ -180,7 +191,7 @@ class RpcServer:
             return
         if fail == "reply":
             _write_frame(writer, (REPLY_ERR, msg_id,
-                                  "chaos: reply dropped", None))
+                                  _CHAOS_MARK + "reply dropped", None))
             return
         try:
             if err is None:
@@ -226,6 +237,8 @@ class RpcClient:
                     continue
                 if kind == REPLY_OK:
                     fut.set_result(payload)
+                elif isinstance(err, str) and err.startswith(_CHAOS_MARK):
+                    fut.set_exception(ChaosDropped(err))
                 else:
                     fut.set_exception(RemoteError(err, payload))
         except (asyncio.IncompleteReadError, ConnectionResetError,
@@ -299,8 +312,8 @@ class ConnectionPool:
             try:
                 c = await self.get(addr)
                 return await c.call(method, timeout=timeout, **payload)
-            except (ConnectionLost, ConnectionRefusedError, OSError,
-                    asyncio.TimeoutError) as e:
+            except (ConnectionLost, ChaosDropped, ConnectionRefusedError,
+                    OSError, asyncio.TimeoutError) as e:
                 last = e
                 await asyncio.sleep(self._backoff * (2 ** attempt))
         raise ConnectionLost(f"{method} to {addr} failed: {last}")

@@ -105,11 +105,17 @@ class TrainContext:
     def __init__(self, rank: int, world_size: int, local_rank: int,
                  node_rank: int, resume_checkpoint: Optional[Checkpoint],
                  dataset_shards: Optional[Dict[str, Any]] = None,
-                 storage_path: Optional[str] = None):
+                 storage_path: Optional[str] = None,
+                 group_id: str = ""):
         self.rank = rank
         self.world_size = world_size
         self.local_rank = local_rank
         self.node_rank = node_rank
+        # Controller-assigned generation id, unique per worker-group
+        # incarnation: namespaces rendezvous keys so a restarted group never
+        # observes barrier arrivals / broadcast values from the previous
+        # incarnation via the long-lived __train_rendezvous actor.
+        self.group_id = group_id
         self._resume = resume_checkpoint
         self._reports: "queue.Queue" = queue.Queue()
         self._seq = 0
@@ -141,14 +147,18 @@ class TrainContext:
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
         self._seq += 1
-        if checkpoint is not None and self.rank == 0 \
-                and self._storage_path:
+        if checkpoint is not None and self._storage_path:
             # Durable BEFORE report() returns: a crash right after report
             # must not lose the checkpoint (reference: report() persists to
             # storage synchronously — train/_internal/storage.py).
             import json
             os.makedirs(self._storage_path, exist_ok=True)
-            tmp = os.path.join(self._storage_path, ".latest.tmp")
+            # Per-rank/pid tmp name: ranks share the storage path, and a
+            # shared tmp file would let one rank truncate another's
+            # in-flight write before the atomic rename.
+            tmp = os.path.join(
+                self._storage_path,
+                f".latest.tmp.{self.rank}.{os.getpid()}")
             with open(tmp, "w") as f:
                 json.dump({"path": checkpoint.path,
                            "metrics": dict(metrics)}, f)

@@ -24,6 +24,16 @@ class CheckpointManager:
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Dict[str, Any]) -> None:
+        # Dedup by path: in SPMD training every rank may report the same
+        # checkpoint; tracking duplicates would let retention rmtree a
+        # still-live directory.
+        path = os.path.abspath(checkpoint.path) if checkpoint.path else None
+        for existing in self._tracked:
+            if path and existing.path and \
+                    os.path.abspath(existing.path) == path:
+                existing.metrics = dict(metrics)
+                self.latest = existing
+                return
         checkpoint.metrics = dict(metrics)
         self.latest = checkpoint
         self._tracked.append(checkpoint)

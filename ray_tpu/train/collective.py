@@ -59,9 +59,10 @@ def barrier(tag: str = "default", timeout: float = 120.0) -> None:
     """Block until every worker in the group reaches the same barrier
     (reference: collectives.py:59)."""
     ctx = get_context()
-    epoch = _epochs.get(("b", tag), 0)
-    _epochs[("b", tag)] = epoch + 1
-    key = f"barrier:{tag}:{epoch}"
+    gen = ctx.group_id  # per-incarnation namespace (see TrainContext)
+    epoch = _epochs.get(("b", gen, tag), 0)
+    _epochs[("b", gen, tag)] = epoch + 1
+    key = f"{gen}:barrier:{tag}:{epoch}"
     h = _rendezvous_handle()
     ray_tpu.get(h.arrive.remote(key, ctx.get_world_rank(),
                                 ctx.get_world_size()), timeout=timeout)
@@ -78,9 +79,10 @@ def broadcast_from_rank_zero(data: Any = None, tag: str = "default",
                              timeout: float = 120.0) -> Any:
     """Rank 0's value to everyone (reference: collectives.py:16)."""
     ctx = get_context()
-    epoch = _epochs.get(("bc", tag), 0)
-    _epochs[("bc", tag)] = epoch + 1
-    key = f"bcast:{tag}:{epoch}"
+    gen = ctx.group_id
+    epoch = _epochs.get(("bc", gen, tag), 0)
+    _epochs[("bc", gen, tag)] = epoch + 1
+    key = f"{gen}:bcast:{tag}:{epoch}"
     h = _rendezvous_handle()
     if ctx.get_world_rank() == 0:
         ray_tpu.get(h.put_value.remote(key, data), timeout=timeout)

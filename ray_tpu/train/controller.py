@@ -137,12 +137,17 @@ class TrainController:
     def _start_train(self):
         self._recover_latest_checkpoint()
         shards = self._split_datasets(len(self._workers))
+        # Fresh generation id per group incarnation: restarted groups must
+        # not see rendezvous state (barriers/broadcasts) left behind by the
+        # previous incarnation in the detached __train_rendezvous actor.
+        import uuid
+        group_id = uuid.uuid4().hex
         refs = []
         for i, w in enumerate(self._workers):
             refs.append(w.start_train_fn.remote(
                 self.train_fn_payload, self.train_loop_config,
                 self.ckpt_manager.latest, shards[i],
-                self.run_config.storage_path))
+                self.run_config.storage_path, group_id))
         ray_tpu.get(refs, timeout=120)
 
     def _split_datasets(self, n: int) -> List[Optional[dict]]:
@@ -229,9 +234,10 @@ class TrainController:
 
     def _handle_report(self, rank: int, rep: dict):
         # Rank 0's metrics are canonical (SPMD: all ranks see the same
-        # reduced values); checkpoints may come from any rank.
+        # reduced values). Checkpoints ARE registered from any rank — a
+        # distributed save may be reported by whichever rank coordinated it.
         if rank == 0:
             self.metrics_history.append(rep["metrics"])
         ckpt = rep.get("checkpoint")
-        if ckpt is not None and rank == 0:
+        if ckpt is not None:
             self.ckpt_manager.register(ckpt, rep["metrics"])

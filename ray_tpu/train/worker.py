@@ -78,14 +78,16 @@ class TrainWorker:
                        train_loop_config: Optional[dict],
                        resume_checkpoint: Optional[Checkpoint],
                        dataset_shards: Optional[dict] = None,
-                       storage_path: Optional[str] = None) -> bool:
+                       storage_path: Optional[str] = None,
+                       group_id: str = "") -> bool:
         fn = cloudpickle.loads(fn_payload)
         self.ctx = TrainContext(
             rank=self.rank, world_size=self.world_size,
             local_rank=self.local_rank, node_rank=self.node_rank,
             resume_checkpoint=resume_checkpoint,
             dataset_shards=dataset_shards,
-            storage_path=storage_path)
+            storage_path=storage_path,
+            group_id=group_id)
 
         def run():
             set_context(self.ctx)
