@@ -1,0 +1,21 @@
+"""ray_tpu.serve — online serving on the cluster runtime.
+
+Capability analog of python/ray/serve (reference: serve/api.py,
+_private/controller.py, _private/proxy.py, request_router/pow_2_router.py,
+serve/batching.py). Deployments are replica actor groups reconciled by a
+controller actor; handles route with power-of-two-choices; ``@serve.batch``
+coalesces requests for jitted model replicas; an asyncio HTTP proxy serves
+JSON ingress.
+"""
+
+from ray_tpu.serve.api import (Application, Deployment, delete, deployment,
+                               get_deployment_handle, proxy_address, run,
+                               shutdown, status)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.handle import DeploymentHandle
+
+__all__ = [
+    "Application", "Deployment", "DeploymentHandle", "batch", "delete",
+    "deployment", "get_deployment_handle", "proxy_address", "run",
+    "shutdown", "status",
+]

@@ -1,0 +1,322 @@
+"""ServeController: the serving control plane, one detached actor per
+cluster.
+
+Reference: python/ray/serve/_private/controller.py:127 (ServeController),
+deployment_state.py:2645 (DeploymentState FSM), autoscaling_state.py
+(queue-length autoscaling). The shape here: a declarative target table
+(deployment -> spec) and an async reconcile loop that converges actual
+replicas to target — create missing, stop excess, replace dead (health
+pings), and resize targets from replica queue metrics when autoscaling is
+configured.
+
+Runs inside a worker's event loop, so all cluster operations use the async
+CoreContext API directly (the sync facade would deadlock the loop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import api
+from ray_tpu.runtime.ids import ActorID
+
+RECONCILE_INTERVAL_S = 0.25
+HEALTH_CHECK_INTERVAL_S = 1.0
+HEALTH_CHECK_TIMEOUT_S = 10.0
+
+
+class _ReplicaInfo:
+    __slots__ = ("actor_id", "state", "name", "started_at",
+                 "last_healthy", "ongoing")
+
+    def __init__(self, actor_id: ActorID, name: str):
+        self.actor_id = actor_id
+        self.name = name
+        self.state = "STARTING"          # STARTING | RUNNING | STOPPING
+        self.started_at = time.time()
+        self.last_healthy = time.time()
+        self.ongoing = 0
+
+
+class _DeploymentState:
+    def __init__(self, name: str, spec: dict):
+        self.name = name
+        self.spec = spec
+        self.replicas: Dict[str, _ReplicaInfo] = {}
+        self.version = 0
+        self.target = self._initial_target()
+        self.last_scale_up_signal = time.time()
+        self.last_scale_change = 0.0
+
+    def _initial_target(self) -> int:
+        auto = self.spec.get("autoscaling_config")
+        if auto:
+            return int(auto.get("initial_replicas",
+                                auto.get("min_replicas", 1)))
+        return int(self.spec.get("num_replicas", 1))
+
+    def running(self) -> List[_ReplicaInfo]:
+        return [r for r in self.replicas.values() if r.state == "RUNNING"]
+
+
+class ServeController:
+    """Deploy with max_concurrency > 1; call ``start()`` once after
+    creation to launch the reconcile loop."""
+
+    def __init__(self):
+        self.deployments: Dict[str, _DeploymentState] = {}
+        self.apps: Dict[str, List[str]] = {}       # app -> deployment names
+        self._loop_task: Optional[asyncio.Task] = None
+        self._proxy_started = False
+
+    # -- internal async cluster ops ---------------------------------------
+
+    def _ctx(self):
+        return api._g.ctx
+
+    async def _acall(self, actor_id: ActorID, method: str, *args,
+                     timeout: Optional[float] = 30.0, **kwargs):
+        ctx = self._ctx()
+        refs = await ctx.submit_actor_call(actor_id, method, args, kwargs)
+        return await ctx.get(refs[0], timeout)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> bool:
+        if self._loop_task is None:
+            self._loop_task = asyncio.ensure_future(self._reconcile_loop())
+        return True
+
+    async def ping(self) -> str:
+        return "ok"
+
+    # -- deploy API --------------------------------------------------------
+
+    async def deploy_app(self, app_name: str,
+                         deployments: List[dict]) -> bool:
+        """deployments: list of specs {name, cls_payload, init_args,
+        init_kwargs, num_replicas|autoscaling_config, max_ongoing_requests,
+        route_prefix, actor_options, user_config}."""
+        names = []
+        for spec in deployments:
+            name = spec["name"]
+            names.append(name)
+            existing = self.deployments.get(name)
+            if existing is None:
+                self.deployments[name] = _DeploymentState(name, spec)
+            else:
+                # In-place upgrade: replace spec; replicas are replaced by
+                # the reconcile loop (stop-all-then-start keeps it simple
+                # and matches restart-on-upgrade semantics).
+                existing.spec = spec
+                existing.target = existing._initial_target()
+                for r in existing.replicas.values():
+                    r.state = "STOPPING"
+                existing.version += 1
+        # Deployments removed from the app spec are torn down.
+        for old in self.apps.get(app_name, []):
+            if old not in names and old in self.deployments:
+                for r in self.deployments[old].replicas.values():
+                    r.state = "STOPPING"
+                self.deployments[old].target = 0
+                self.deployments[old].spec["_deleted"] = True
+        self.apps[app_name] = names
+        return True
+
+    async def list_apps(self) -> List[str]:
+        return list(self.apps)
+
+    async def delete_app(self, app_name: str) -> bool:
+        for name in self.apps.pop(app_name, []):
+            dep = self.deployments.get(name)
+            if dep is not None:
+                dep.target = 0
+                dep.spec["_deleted"] = True
+                for r in dep.replicas.values():
+                    r.state = "STOPPING"
+        return True
+
+    async def wait_ready(self, app_name: str, timeout: float = 120.0) -> dict:
+        deadline = time.monotonic() + timeout
+        names = self.apps.get(app_name, [])
+        while time.monotonic() < deadline:
+            ready = all(
+                len(self.deployments[n].running()) >= 1
+                and len(self.deployments[n].running()) >=
+                min(self.deployments[n].target, 1)
+                for n in names if n in self.deployments)
+            if names and ready:
+                return {"ok": True}
+            await asyncio.sleep(0.1)
+        return {"ok": False,
+                "error": f"app {app_name!r} not ready in {timeout}s"}
+
+    # -- routing -----------------------------------------------------------
+
+    async def get_routing_table(self, deployment_name: str) -> dict:
+        dep = self.deployments.get(deployment_name)
+        if dep is None:
+            return {"replicas": [], "version": -1}
+        return {"replicas": [r.actor_id.binary() for r in dep.running()],
+                "version": dep.version}
+
+    async def get_ingress_routes(self) -> List[dict]:
+        """[{route_prefix, deployment}] sorted longest-prefix-first."""
+        routes = []
+        for name, dep in self.deployments.items():
+            prefix = dep.spec.get("route_prefix")
+            if prefix and not dep.spec.get("_deleted"):
+                routes.append({"route_prefix": prefix, "deployment": name})
+        routes.sort(key=lambda r: -len(r["route_prefix"]))
+        return routes
+
+    async def status(self) -> dict:
+        out = {}
+        for name, dep in self.deployments.items():
+            out[name] = {
+                "target": dep.target,
+                "version": dep.version,
+                "replicas": {
+                    rid: {"state": r.state, "ongoing": r.ongoing}
+                    for rid, r in dep.replicas.items()
+                },
+            }
+        return out
+
+    # -- reconcile ---------------------------------------------------------
+
+    async def _reconcile_loop(self):
+        while True:
+            try:
+                await self._reconcile_once()
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                import traceback
+                traceback.print_exc()
+            await asyncio.sleep(RECONCILE_INTERVAL_S)
+
+    async def _reconcile_once(self):
+        for name in list(self.deployments):
+            dep = self.deployments[name]
+            await self._autoscale(dep)
+            await self._converge(dep)
+            if dep.spec.get("_deleted") and not dep.replicas:
+                del self.deployments[name]
+
+    async def _converge(self, dep: _DeploymentState):
+        # 1. reap STOPPING replicas
+        for rid in list(dep.replicas):
+            r = dep.replicas[rid]
+            if r.state == "STOPPING":
+                try:
+                    await self._ctx().kill_actor(r.actor_id, no_restart=True)
+                except Exception:
+                    pass
+                del dep.replicas[rid]
+                dep.version += 1
+        # 2. health: STARTING -> RUNNING on first ping; RUNNING -> replaced
+        #    on ping failure
+        for rid in list(dep.replicas):
+            r = dep.replicas[rid]
+            if r.state == "STARTING":
+                try:
+                    await self._acall(r.actor_id, "ping", timeout=1.0)
+                    r.state = "RUNNING"
+                    r.last_healthy = time.time()
+                    dep.version += 1
+                except Exception:
+                    if time.time() - r.started_at > 120.0:
+                        r.state = "STOPPING"
+            elif r.state == "RUNNING" and \
+                    time.time() - r.last_healthy > HEALTH_CHECK_INTERVAL_S:
+                try:
+                    await self._acall(r.actor_id, "ping",
+                                      timeout=HEALTH_CHECK_TIMEOUT_S)
+                    r.last_healthy = time.time()
+                except Exception:
+                    r.state = "STOPPING"
+                    dep.version += 1
+        # 3. scale toward target
+        alive = [r for r in dep.replicas.values()
+                 if r.state in ("STARTING", "RUNNING")]
+        missing = dep.target - len(alive)
+        for _ in range(max(0, missing)):
+            await self._start_replica(dep)
+        if missing < 0:
+            # stop the youngest excess replicas (oldest keep serving)
+            excess = sorted(alive, key=lambda r: r.started_at)[missing:]
+            for r in excess:
+                r.state = "STOPPING"
+                dep.version += 1
+
+    async def _start_replica(self, dep: _DeploymentState):
+        from ray_tpu.serve.replica import Replica
+        rid = uuid.uuid4().hex[:8]
+        name = f"SERVE_REPLICA:{dep.name}:{rid}"
+        spec = dep.spec
+        opts = dict(spec.get("actor_options") or {})
+        resources = dict(opts.get("resources") or {})
+        if opts.get("num_cpus") is not None:
+            resources["CPU"] = float(opts["num_cpus"])
+        if opts.get("num_tpus") is not None:
+            resources["TPU"] = float(opts["num_tpus"])
+        if "CPU" not in resources and "TPU" not in resources:
+            resources["CPU"] = 1.0
+        try:
+            actor_id = await self._ctx().create_actor(
+                Replica,
+                (dep.name, rid, spec["cls_payload"],
+                 tuple(spec.get("init_args") or ()),
+                 dict(spec.get("init_kwargs") or {}),
+                 spec.get("user_config")),
+                {},
+                name=name, namespace="serve",
+                resources=resources,
+                max_concurrency=int(spec.get("max_ongoing_requests", 16)),
+                lifetime="detached")
+        except Exception:
+            return
+        dep.replicas[rid] = _ReplicaInfo(actor_id, name)
+
+    # -- autoscaling -------------------------------------------------------
+
+    async def _autoscale(self, dep: _DeploymentState):
+        auto = dep.spec.get("autoscaling_config")
+        if not auto or dep.spec.get("_deleted"):
+            return
+        running = dep.running()
+        if not running:
+            return
+        total_ongoing = 0
+        for r in running:
+            try:
+                m = await self._acall(r.actor_id, "metrics", timeout=2.0)
+                r.ongoing = int(m["ongoing"])
+            except Exception:
+                continue
+            total_ongoing += r.ongoing
+        target_per = float(auto.get("target_ongoing_requests", 2.0))
+        lo = int(auto.get("min_replicas", 1))
+        hi = int(auto.get("max_replicas", 8))
+        desired = max(lo, min(hi, math.ceil(total_ongoing / target_per)))
+        now = time.time()
+        if desired > dep.target:
+            # scale up immediately (but not more than once per interval)
+            if now - dep.last_scale_change > float(
+                    auto.get("upscale_delay_s", 0.5)):
+                dep.target = desired
+                dep.last_scale_change = now
+            dep.last_scale_up_signal = now
+        elif desired < dep.target:
+            # scale down only after a sustained quiet period
+            delay = float(auto.get("downscale_delay_s", 5.0))
+            if now - dep.last_scale_up_signal > delay:
+                dep.target = max(desired, lo)
+                dep.last_scale_change = now
+        else:
+            dep.last_scale_up_signal = now

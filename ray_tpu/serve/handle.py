@@ -1,0 +1,171 @@
+"""DeploymentHandle: the caller-side router to a deployment's replicas.
+
+Reference: python/ray/serve/handle.py (DeploymentHandle) +
+serve/_private/request_router/pow_2_router.py:27 — replica choice is
+power-of-two-choices on in-flight request counts: sample two replicas,
+send to the less-loaded one. Counts are tracked caller-side (incremented
+on send, decremented when the result object is ready) so the router needs
+no synchronous coordination.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import api
+from ray_tpu.api import ActorHandle
+from ray_tpu.runtime.ids import ActorID
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+SERVE_NAMESPACE = "serve"
+
+_ROUTE_TTL_S = 0.5
+
+
+class _HandleRef:
+    """Pickle-safe placeholder for a DeploymentHandle inside deployment
+    init args (composition): resolved to a live handle in the replica."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+
+
+def _api_loop():
+    if api._g.elt is not None:
+        return api._g.elt.loop
+    return api._g.ctx_loop
+
+
+class _Router:
+    """Per-process routing state for one deployment."""
+
+    def __init__(self, deployment_name: str):
+        self.name = deployment_name
+        self.replicas: List[bytes] = []     # actor id bytes
+        self.version = -1
+        self.fetched_at = 0.0
+        self.inflight: Dict[bytes, int] = {}
+        self.lock = threading.Lock()
+
+    def _controller(self) -> ActorHandle:
+        return api.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+
+    def refresh(self, block_until_nonempty: bool = True,
+                timeout: float = 30.0):
+        now = time.monotonic()
+        if self.replicas and now - self.fetched_at < _ROUTE_TTL_S:
+            return
+        deadline = now + timeout
+        while True:
+            table = api.get(self._controller().get_routing_table.remote(
+                self.name), timeout=timeout)
+            with self.lock:
+                self.replicas = [bytes(r) for r in table["replicas"]]
+                self.version = table["version"]
+                self.fetched_at = time.monotonic()
+            if self.replicas or not block_until_nonempty:
+                return
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"deployment {self.name!r} has no running replicas")
+            time.sleep(0.1)
+
+    def pick(self) -> bytes:
+        """Power-of-two-choices by local in-flight counts."""
+        with self.lock:
+            reps = list(self.replicas)
+        if not reps:
+            raise RuntimeError(f"no replicas for {self.name!r}")
+        if len(reps) == 1:
+            return reps[0]
+        a, b = random.sample(reps, 2)
+        with self.lock:
+            ia = self.inflight.get(a, 0)
+            ib = self.inflight.get(b, 0)
+        return a if ia <= ib else b
+
+    def track(self, rid: bytes, ref) -> None:
+        with self.lock:
+            self.inflight[rid] = self.inflight.get(rid, 0) + 1
+
+        async def _untrack():
+            try:
+                await api._g.ctx.wait([ref], 1, None)
+            except Exception:
+                pass
+            with self.lock:
+                self.inflight[rid] = max(0, self.inflight.get(rid, 1) - 1)
+
+        loop = _api_loop()
+        asyncio.run_coroutine_threadsafe(_untrack(), loop)
+
+    def drop(self, rid: bytes) -> None:
+        """Remove a replica the caller observed dead and force a refresh."""
+        with self.lock:
+            if rid in self.replicas:
+                self.replicas.remove(rid)
+            self.fetched_at = 0.0
+
+
+_routers: Dict[str, _Router] = {}
+_routers_lock = threading.Lock()
+
+
+def _router_for(name: str) -> _Router:
+    with _routers_lock:
+        r = _routers.get(name)
+        if r is None:
+            r = _Router(name)
+            _routers[name] = r
+        return r
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._handle._route(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    """Routes calls to a deployment's replicas (p2c). Picklable — ships
+    across actors as a name reference."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name,))
+
+    def __getattr__(self, name):
+        if name.startswith("_") or name in ("deployment_name",):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def remote(self, *args, **kwargs):
+        return self._route("__call__", args, kwargs)
+
+    def _route(self, method: str, args: tuple, kwargs: dict,
+               _retries: int = 2):
+        router = _router_for(self.deployment_name)
+        router.refresh()
+        rid = router.pick()
+        replica = ActorHandle(ActorID(rid))
+        try:
+            ref = replica.handle_request.remote(method, args, kwargs)
+        except api.RayTpuError:
+            if _retries <= 0:
+                raise
+            router.drop(rid)
+            return self._route(method, args, kwargs, _retries - 1)
+        router.track(rid, ref)
+        return ref
+
+    def options(self, **_opts) -> "DeploymentHandle":
+        return self

@@ -1,0 +1,163 @@
+"""HTTP ingress proxy: one actor per cluster (per node when scaled out).
+
+Reference: python/ray/serve/_private/proxy.py — the reference embeds a
+starlette ASGI app; here a dependency-free asyncio HTTP/1.1 server is
+enough for the framework's JSON-in/JSON-out serving surface. Routing is
+longest-prefix over the controller's ingress table; the request body
+(JSON when the content-type says so, raw bytes otherwise) becomes the
+deployment's argument.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Dict, Optional, Tuple
+
+from ray_tpu import api
+
+
+class HTTPProxy:
+    """Actor. Call ``start(host, port)`` once; serves until killed."""
+
+    def __init__(self):
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._routes = []                 # [{route_prefix, deployment}]
+        self._routes_fetched = 0.0
+        self._requests = 0
+        self._errors = 0
+
+    async def start(self, host: str = "127.0.0.1", port: int = 8000) -> dict:
+        self._server = await asyncio.start_server(self._on_conn, host, port)
+        addr = self._server.sockets[0].getsockname()
+        return {"host": addr[0], "port": addr[1]}
+
+    async def ping(self) -> str:
+        return "ok"
+
+    async def metrics(self) -> dict:
+        return {"requests": self._requests, "errors": self._errors}
+
+    # -- routing table -----------------------------------------------------
+
+    async def _refresh_routes(self):
+        if time.monotonic() - self._routes_fetched < 1.0 and self._routes:
+            return
+        from ray_tpu.serve.handle import CONTROLLER_NAME, SERVE_NAMESPACE
+        ctx = api._g.ctx
+        info = await ctx.pool.call(ctx.head_addr, "get_named_actor",
+                                   name=CONTROLLER_NAME,
+                                   namespace=SERVE_NAMESPACE)
+        if not info or info.get("state") == "DEAD":
+            return
+        refs = await ctx.submit_actor_call(
+            info["actor_id"], "get_ingress_routes", (), {})
+        self._routes = await ctx.get(refs[0], 10.0)
+        self._routes_fetched = time.monotonic()
+
+    def _match(self, path: str) -> Optional[str]:
+        for r in self._routes:
+            p = r["route_prefix"]
+            if path == p or path.startswith(p.rstrip("/") + "/") or p == "/":
+                return r["deployment"]
+        return None
+
+    # -- http --------------------------------------------------------------
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter):
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    return
+                method, path, headers, body = req
+                await self._dispatch(writer, method, path, headers, body)
+                if headers.get("connection", "").lower() == "close":
+                    return
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader):
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, target, _version = line.decode().split()
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        n = int(headers.get("content-length", 0))
+        body = await reader.readexactly(n) if n else b""
+        path = target.split("?", 1)[0]
+        return method, path, headers, body
+
+    async def _dispatch(self, writer, method, path, headers, body):
+        self._requests += 1
+        if path == "/-/healthz":
+            return self._respond(writer, 200, {"status": "ok"})
+        try:
+            await self._refresh_routes()
+        except Exception as e:
+            self._errors += 1
+            return self._respond(
+                writer, 500, {"error": f"route refresh: {e}"})
+        if path == "/-/routes":
+            return self._respond(writer, 200, {"routes": self._routes})
+        dep = self._match(path)
+        if dep is None:
+            self._errors += 1
+            return self._respond(writer, 404,
+                                 {"error": f"no route for {path}"})
+        ctype = headers.get("content-type", "")
+        if body and "json" in ctype:
+            arg = json.loads(body)
+        elif body:
+            arg = body
+        else:
+            arg = None
+        loop = asyncio.get_running_loop()
+        try:
+            # Handle routing + submission is the sync caller API — run it on
+            # a thread; await the result object on this loop.
+            from ray_tpu.serve.handle import DeploymentHandle
+            h = DeploymentHandle(dep)
+            ref = await loop.run_in_executor(
+                None, lambda: h.remote(arg) if arg is not None
+                else h.remote())
+            result = await api.get_async(ref, timeout=120.0)
+        except BaseException as e:  # noqa: BLE001
+            self._errors += 1
+            return self._respond(writer, 500,
+                                 {"error": f"{type(e).__name__}: {e}"})
+        self._respond(writer, 200, result)
+
+    def _respond(self, writer, code: int, payload):
+        reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}
+        if isinstance(payload, (bytes, bytearray)):
+            body = bytes(payload)
+            ctype = "application/octet-stream"
+        elif isinstance(payload, str):
+            body = payload.encode()
+            ctype = "text/plain; charset=utf-8"
+        else:
+            body = json.dumps(payload).encode()
+            ctype = "application/json"
+        head = (f"HTTP/1.1 {code} {reason.get(code, 'OK')}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"\r\n").encode()
+        writer.write(head + body)

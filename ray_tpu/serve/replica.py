@@ -1,0 +1,96 @@
+"""Serve replica actor: hosts one instance of a deployment's user class.
+
+Reference: python/ray/serve/_private/replica.py (UserCallableWrapper) —
+the replica tracks ongoing-request counts (the router's p2c signal and the
+autoscaler's input), runs user methods sync-or-async, and exposes
+health/reconfigure hooks. This implementation targets async single-loop
+actors (max_concurrency > 1) so a jitted-model replica can batch requests
+with ``@serve.batch``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+
+class Replica:
+    """Created by the ServeController with max_concurrency > 1."""
+
+    def __init__(self, deployment_name: str, replica_id: str,
+                 cls_payload: bytes, init_args: tuple, init_kwargs: dict,
+                 user_config: Optional[dict] = None):
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        cls = cloudpickle.loads(cls_payload)
+        # Resolve handle placeholders (composed deployments) lazily at
+        # replica construction: the controller ships _HandleRef markers.
+        from ray_tpu.serve.handle import DeploymentHandle, _HandleRef
+        def resolve(v):
+            if isinstance(v, _HandleRef):
+                return DeploymentHandle(v.deployment_name)
+            return v
+        init_args = tuple(resolve(a) for a in init_args)
+        init_kwargs = {k: resolve(v) for k, v in init_kwargs.items()}
+        self.instance = cls(*init_args, **init_kwargs)
+        self._ongoing = 0
+        self._processed = 0
+        self._errors = 0
+        self._started_at = time.time()
+        if user_config is not None and hasattr(self.instance, "reconfigure"):
+            self.instance.reconfigure(user_config)
+
+    # -- data path ---------------------------------------------------------
+
+    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+        """Run a user method. Coroutine methods run on the actor's event
+        loop (enables @serve.batch coalescing); sync methods run on the
+        actor's thread pool via the worker's executor."""
+        self._ongoing += 1
+        try:
+            fn = getattr(self.instance, method)
+            if inspect.iscoroutinefunction(fn):
+                out = await fn(*args, **kwargs)
+            else:
+                loop = asyncio.get_running_loop()
+                out = await loop.run_in_executor(
+                    None, lambda: fn(*args, **kwargs))
+            self._processed += 1
+            return out
+        except BaseException:
+            self._errors += 1
+            raise
+        finally:
+            self._ongoing -= 1
+
+    # -- control path ------------------------------------------------------
+
+    def ping(self) -> str:
+        """Health check; also honors a user-defined check_health()."""
+        if hasattr(self.instance, "check_health"):
+            self.instance.check_health()
+        return "ok"
+
+    def metrics(self) -> Dict[str, Any]:
+        return {
+            "replica_id": self.replica_id,
+            "deployment": self.deployment_name,
+            "ongoing": self._ongoing,
+            "processed": self._processed,
+            "errors": self._errors,
+            "uptime_s": time.time() - self._started_at,
+        }
+
+    def reconfigure(self, user_config: dict) -> bool:
+        if hasattr(self.instance, "reconfigure"):
+            self.instance.reconfigure(user_config)
+        return True
+
+    def prepare_shutdown(self) -> bool:
+        if hasattr(self.instance, "shutdown"):
+            self.instance.shutdown()
+        return True

@@ -1,0 +1,234 @@
+"""Serve: deployments, routing, batching, autoscaling, fault recovery.
+
+Test strategy mirrors the reference's serve tests on an in-process cluster
+(reference: python/ray/serve/tests/ on ray_start fixtures).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=16)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_apps(cluster):
+    yield
+    # Delete all apps between tests but keep controller/proxy warm.
+    try:
+        ctrl = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+        for app in ray_tpu.get(ctrl.list_apps.remote(), timeout=10):
+            ray_tpu.get(ctrl.delete_app.remote(app), timeout=10)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if not ray_tpu.get(ctrl.status.remote(), timeout=10):
+                break
+            time.sleep(0.1)
+    except ValueError:
+        pass
+
+
+@serve.deployment(num_replicas=2)
+class Echo:
+    def __init__(self, prefix="x"):
+        self.prefix = prefix
+
+    def __call__(self, v=None):
+        return f"{self.prefix}:{v}"
+
+    def tag(self):
+        return self.prefix
+
+
+def test_deploy_and_call(cluster):
+    h = serve.run(Echo.bind("a"), name="app1", route_prefix=None)
+    out = ray_tpu.get([h.remote(i) for i in range(6)], timeout=30)
+    assert out == [f"a:{i}" for i in range(6)]
+    # named method routing
+    assert ray_tpu.get(h.tag.remote(), timeout=30) == "a"
+    st = serve.status()
+    assert st["Echo"]["target"] == 2
+    assert len(st["Echo"]["replicas"]) == 2
+
+
+def test_function_deployment(cluster):
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    h = serve.run(double.bind(), name="app_fn", route_prefix=None)
+    assert ray_tpu.get(h.remote(21), timeout=30) == 42
+
+
+def test_composition(cluster):
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            y = ray_tpu.get(self.pre.remote(x), timeout=30)
+            return y * 10
+
+    h = serve.run(Model.bind(Preprocess.bind()), name="app_comp",
+                  route_prefix=None)
+    assert ray_tpu.get(h.remote(4), timeout=60) == 50
+
+
+def test_batching(cluster):
+    @serve.deployment(max_ongoing_requests=32)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+        async def __call__(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 2 for x in xs]
+
+        async def seen_batches(self):
+            return list(self.batch_sizes)
+
+    h = serve.run(Batched.options(num_replicas=1).bind(), name="app_batch",
+                  route_prefix=None)
+    refs = [h.remote(i) for i in range(16)]
+    out = ray_tpu.get(refs, timeout=30)
+    assert sorted(out) == [i * 2 for i in range(16)]
+    sizes = ray_tpu.get(h.seen_batches.remote(), timeout=30)
+    # Concurrent requests must have been coalesced (not 16 batches of 1).
+    assert max(sizes) > 1, sizes
+    assert sum(sizes) == 16
+
+
+def test_p2c_spreads_load(cluster):
+    @serve.deployment(num_replicas=2)
+    class Who:
+        def __init__(self):
+            import os
+            self.pid = os.getpid()
+
+        def __call__(self):
+            return self.pid
+
+    h = serve.run(Who.bind(), name="app_p2c", route_prefix=None)
+    pids = set(ray_tpu.get([h.remote() for _ in range(20)], timeout=30))
+    assert len(pids) == 2, f"expected both replicas hit, got {pids}"
+
+
+def test_replica_recovery(cluster):
+    h = serve.run(Echo.options(name="EchoRec", num_replicas=2).bind("r"),
+                  name="app_rec", route_prefix=None)
+    assert ray_tpu.get(h.remote(1), timeout=30) == "r:1"
+    # Kill one replica out from under the controller.
+    st = serve.status()
+    rid = next(iter(st["EchoRec"]["replicas"]))
+    victim = ray_tpu.get_actor(f"SERVE_REPLICA:EchoRec:{rid}",
+                               namespace="serve")
+    ray_tpu.kill(victim)
+    # Controller health checks must replace it.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        st = serve.status()
+        states = [r["state"] for r in st["EchoRec"]["replicas"].values()]
+        if states.count("RUNNING") >= 2 and rid not in \
+                st["EchoRec"]["replicas"]:
+            break
+        time.sleep(0.25)
+    else:
+        pytest.fail(f"replica not replaced: {st}")
+    out = ray_tpu.get([h.remote(i) for i in range(6)], timeout=60)
+    assert out == [f"r:{i}" for i in range(6)]
+
+
+def test_autoscaling_up_and_down(cluster):
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1,
+        "upscale_delay_s": 0.0, "downscale_delay_s": 1.5,
+    }, max_ongoing_requests=16)
+    class Slow:
+        def __call__(self, _=None):
+            time.sleep(0.4)
+            return "done"
+
+    h = serve.run(Slow.bind(), name="app_auto", route_prefix=None)
+    st = serve.status()
+    assert st["Slow"]["target"] == 1
+    # Sustained concurrent load -> scale up.
+    refs = [h.remote(i) for i in range(24)]
+    deadline = time.monotonic() + 45
+    scaled = False
+    while time.monotonic() < deadline:
+        if serve.status()["Slow"]["target"] >= 2:
+            scaled = True
+            break
+        time.sleep(0.2)
+    assert scaled, f"never scaled up: {serve.status()}"
+    ray_tpu.get(refs, timeout=90)
+    # Idle -> scale back to min.
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline:
+        if serve.status()["Slow"]["target"] == 1:
+            break
+        time.sleep(0.3)
+    else:
+        pytest.fail(f"never scaled down: {serve.status()}")
+
+
+def test_http_proxy(cluster):
+    serve.run(Echo.options(name="EchoHttp").bind("h"), name="app_http",
+              route_prefix="/echo")
+    addr = serve.proxy_address()
+    base = f"http://{addr['host']}:{addr['port']}"
+
+    # healthz + routes
+    health = json.load(urllib.request.urlopen(f"{base}/-/healthz", timeout=10))
+    assert health["status"] == "ok"
+    routes = json.load(urllib.request.urlopen(f"{base}/-/routes", timeout=10))
+    assert any(r["deployment"] == "EchoHttp" for r in routes["routes"])
+
+    req = urllib.request.Request(
+        f"{base}/echo", data=json.dumps("w").encode(),
+        headers={"Content-Type": "application/json"})
+    assert json.load(urllib.request.urlopen(req, timeout=30)) == "h:w"
+
+    # 404 for unknown route
+    try:
+        urllib.request.urlopen(f"{base}/nope", timeout=10)
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_upgrade_replaces_replicas(cluster):
+    h = serve.run(Echo.options(name="EchoUp").bind("v1"), name="app_up",
+                  route_prefix=None)
+    assert ray_tpu.get(h.remote(0), timeout=30) == "v1:0"
+    h = serve.run(Echo.options(name="EchoUp").bind("v2"), name="app_up",
+                  route_prefix=None)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            if ray_tpu.get(h.remote(0), timeout=30) == "v2:0":
+                break
+        except ray_tpu.RayTpuError:
+            pass
+        time.sleep(0.2)
+    else:
+        pytest.fail("upgrade never took effect")
