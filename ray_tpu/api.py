@@ -210,15 +210,38 @@ def _require_init():
 
 def put(value: Any) -> ObjectRef:
     ctx = _require_init()
-    return _run(ctx.put(value))
+    from ray_tpu.runtime.ids import ObjectID
+    from ray_tpu.runtime.serialization import serialize
+    ser = serialize(value)
+    if ser.total_bytes <= ctx.config.inline_object_max_bytes:
+        # Inline object: resolve in the caller's thread; nobody can be
+        # awaiting a ref that hasn't been returned yet, so no loop hop.
+        oid = ObjectID.generate()
+        ctx.store.resolve(oid, frame=ser.to_bytes())
+        return ObjectRef(oid, ctx.addr, ser.total_bytes)
+    return _run(ctx.put_serialized(ser))
 
 
 def get(refs, timeout: Optional[float] = None):
     ctx = _require_init()
-    if isinstance(refs, list) and not refs:
+    single = isinstance(refs, ObjectRef)
+    # Materialize once: generator inputs must not be consumed twice.
+    ref_list = [refs] if single else list(refs)
+    if not ref_list:
         return []
+    # Fast path: every ref already resolved inline in this process — load
+    # on the caller's thread, no event-loop round trip.
+    values = []
+    for r in ref_list:
+        hit, v = ctx.try_get_local(r)
+        if not hit:
+            break
+        values.append(v)
+    else:
+        return values[0] if single else values
     wait_budget = None if timeout is None else timeout + 10
-    return _run(ctx.get(refs, timeout), timeout=wait_budget)
+    return _run(ctx.get(refs if single else ref_list, timeout),
+                timeout=wait_budget)
 
 
 async def get_async(refs, timeout: Optional[float] = None):
@@ -277,13 +300,13 @@ class RemoteFunction:
         ctx = _require_init()
         opts = self._opts
         num_returns = opts.get("num_returns", 1)
-        refs = _run(ctx.submit_task(
+        refs = ctx.submit_task_sync(
             self._fn, args, kwargs,
             num_returns=num_returns,
             resources=_norm_resources(opts),
             max_retries=opts.get("max_retries"),
             pg=_pg_tuple(opts),
-            policy=opts.get("scheduling_strategy", "default")))
+            policy=opts.get("scheduling_strategy", "default"))
         return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *a, **kw):
@@ -308,11 +331,11 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         ctx = _require_init()
         num_returns = self._opts.get("num_returns", 1)
-        refs = _run(ctx.submit_actor_call(
+        refs = ctx.submit_actor_call_sync(
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=num_returns,
             max_task_retries=self._opts.get(
-                "max_task_retries", self._handle._max_task_retries)))
+                "max_task_retries", self._handle._max_task_retries))
         return refs[0] if num_returns == 1 else refs
 
 

@@ -102,7 +102,9 @@ class NodeAgent:
             "commit_bundle": self.commit_bundle,
             "return_bundle": self.return_bundle,
             "worker_ready": self.worker_ready,
-            "register_segment": self.register_segment,
+            "alloc_object": self.alloc_object,
+            "seal_object": self.seal_object,
+            "abort_object": self.abort_object,
             "resolve_object": self.resolve_object,
             "fetch_chunk": self.fetch_chunk,
             "free_objects": self.free_objects,
@@ -156,6 +158,9 @@ class NodeAgent:
                     version=self._view_version, timeout=10.0)
                 if r.get("view"):
                     self.cluster_view = r["view"]
+                # Reap allocations whose producer died between alloc and
+                # seal — otherwise they pin unevictable capacity forever.
+                self.store.sweep_unsealed(ttl_s=60.0)
             except Exception:
                 pass
             await asyncio.sleep(period)
@@ -236,14 +241,34 @@ class NodeAgent:
                 return w
         return None
 
+    _worker_claims = 0
+
     async def _get_worker(self) -> Optional[WorkerHandle]:
-        w = self._pop_idle()
-        if w is not None:
-            return w
-        n_live = len([x for x in self.workers.values() if x.state != DEAD])
-        if n_live >= self.config.max_workers_per_node:
-            return None
-        return await self._spawn_worker()
+        """Pop an idle worker, else spawn — but claim a worker already
+        mid-boot before spawning an (n+1)th: process startup pays a ~2s
+        interpreter+plugin import, and concurrent spawns contend on CPU
+        (the reference's worker pool likewise prefers its starting
+        workers, raylet/worker_pool.cc PopWorker)."""
+        self._worker_claims += 1
+        try:
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + self.config.worker_start_timeout_s
+            while True:
+                w = self._pop_idle()
+                if w is not None:
+                    return w
+                live = [x for x in self.workers.values()
+                        if x.state != DEAD]
+                if len(live) >= self.config.max_workers_per_node:
+                    return None
+                starting = sum(1 for x in live if x.state == STARTING)
+                if starting < self._worker_claims:
+                    return await self._spawn_worker()
+                if loop.time() > deadline:
+                    return None
+                await asyncio.sleep(0.02)
+        finally:
+            self._worker_claims -= 1
 
     # --- leases (task scheduling) --------------------------------------------
 
@@ -329,11 +354,14 @@ class NodeAgent:
             if pg_id is None and allow_spillback \
                     and not _fits(resources, self.resources_total):
                 # Never feasible here. Prefer a peer with room now; else a
-                # peer whose total capacity fits (request queues there);
-                # else the demand is truly infeasible cluster-wide.
-                target = self._spillback_target(resources)
+                # peer whose total capacity fits (request queues there).
+                # An empty view may just be membership lag (fresh node, or
+                # a peer about to join) — poll briefly before declaring the
+                # demand infeasible cluster-wide.
+                target = (self._spillback_target(resources)
+                          or self._capacity_target(resources))
                 if target is None:
-                    target = self._capacity_target(resources)
+                    target = await self._await_feasible_peer(resources)
                 if target is not None:
                     return {"spillback": target}
                 return {"error": f"infeasible resources {resources}"}
@@ -361,6 +389,21 @@ class NodeAgent:
             pg_id=pg_id, bundle_index=bundle_index)
         return {"granted": {"lease_id": lease_id, "worker_addr": w.addr,
                             "worker_id": w.worker_id}}
+
+    async def _await_feasible_peer(self, resources: dict,
+                                   window_s: float = 10.0):
+        """Poll the synced cluster view for a capacity-feasible peer; the
+        view refreshes via heartbeat piggyback, so a fresh node sees peers
+        within one heartbeat period."""
+        deadline = asyncio.get_running_loop().time() + min(
+            window_s, self.config.lease_timeout_s)
+        while asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.2)
+            target = (self._spillback_target(resources)
+                      or self._capacity_target(resources))
+            if target is not None:
+                return target
+        return None
 
     async def release_lease(self, lease_id: str, worker_died: bool = False):
         lease = self.leases.pop(lease_id, None)
@@ -472,20 +515,30 @@ class NodeAgent:
 
     # --- object plane -----------------------------------------------------------
 
-    async def register_segment(self, oid: ObjectID, size: int):
-        """A local process created+sealed a segment under the session naming
-        scheme; adopt it into the store and publish its location."""
-        self.store.adopt(oid, size)
+    async def alloc_object(self, oid: ObjectID, size: int):
+        """Reserve store space for a local producer; it writes the frame
+        into (segname, offset) then calls seal_object (plasma's
+        Create/Seal split, reference: plasma/store.h)."""
+        segname, offset = self.store.allocate(oid, size)
+        return {"segname": segname, "offset": offset}
+
+    async def seal_object(self, oid: ObjectID):
+        self.store.seal(oid)
+        size = self.store.size_of(oid)
         await self.pool.call(self.head_addr, "add_object_location",
                              oid=oid, node_id=self.node_id, size=size)
         return {"ok": True}
 
+    async def abort_object(self, oid: ObjectID):
+        self.store.abort(oid)
+        return {"ok": True}
+
     async def resolve_object(self, oid: ObjectID, pull: bool = True):
-        """Local segname for oid, pulling from a remote node if needed
-        (reference: PullManager + ObjectManager chunked transfer)."""
-        seg = self.store.segment_name(oid)
-        if seg is not None:
-            return {"segname": seg, "size": self.store.size_of(oid)}
+        """Local (segname, offset) for oid, pulling from a remote node if
+        needed (reference: PullManager + ObjectManager chunked transfer)."""
+        loc = self.store.location(oid)
+        if loc is not None:
+            return {"segname": loc[0], "offset": loc[1], "size": loc[2]}
         if not pull:
             return {"segname": None}
         # Dedup concurrent pulls of the same object (reference:
@@ -499,8 +552,10 @@ class NodeAgent:
         ok = await asyncio.shield(inflight)
         if not ok:
             return {"segname": None}
-        return {"segname": self.store.segment_name(oid),
-                "size": self.store.size_of(oid)}
+        loc = self.store.location(oid)
+        if loc is None:
+            return {"segname": None}
+        return {"segname": loc[0], "offset": loc[1], "size": loc[2]}
 
     async def _pull_from_any(self, oid: ObjectID) -> bool:
         locs = await self.pool.call(self.head_addr, "get_object_locations",

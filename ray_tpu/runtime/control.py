@@ -55,6 +55,7 @@ class ActorInfo:
     death_cause: Optional[str] = None
     namespace: str = "default"
     pg: Optional[tuple] = None                 # (pg_id, bundle_index)
+    max_concurrency: int = 1                   # callers batch iff == 1
 
 
 @dataclass
@@ -278,7 +279,8 @@ class ControlService:
                              resources, max_restarts: int,
                              creation_spec: bytes, namespace: str = "default",
                              scheduling: Optional[dict] = None,
-                             pg: Optional[tuple] = None):
+                             pg: Optional[tuple] = None,
+                             max_concurrency: int = 1):
         if name:
             key = (namespace, name)
             if key in self.named_actors:
@@ -291,7 +293,8 @@ class ControlService:
                          resources=dict(resources),
                          max_restarts=max_restarts,
                          creation_spec=creation_spec, namespace=namespace,
-                         pg=tuple(pg) if pg else None)
+                         pg=tuple(pg) if pg else None,
+                         max_concurrency=int(max_concurrency))
         self.actors[actor_id] = info
         node = await self._schedule_actor(info, scheduling or {})
         if node is None:
@@ -445,7 +448,8 @@ class ControlService:
                 return {"state": "UNKNOWN"}
             if a.state == ALIVE:
                 return {"state": ALIVE, "addr": a.addr,
-                        "num_restarts": a.num_restarts}
+                        "num_restarts": a.num_restarts,
+                        "max_concurrency": a.max_concurrency}
             if a.state == DEAD:
                 return {"state": DEAD, "reason": a.death_cause}
             remaining = deadline - time.monotonic()

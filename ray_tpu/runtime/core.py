@@ -21,7 +21,6 @@ from __future__ import annotations
 import asyncio
 import time
 from dataclasses import dataclass, field
-from multiprocessing import shared_memory
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.config import Config
@@ -34,6 +33,10 @@ from ray_tpu.runtime.serialization import (FunctionCache, Serialized,
 PIPELINE_DEPTH = 2          # in-flight tasks per leased worker
 MAX_SPILLBACK_HOPS = 4
 LEASE_IDLE_RETURN_S = 2.0
+ACTOR_BATCH_MAX = 64        # calls coalesced into one actor RPC
+ACTOR_MAX_INFLIGHT_BATCHES = 8  # pipelined un-acked batches per actor
+TASK_BATCH_MAX = 32         # tasks coalesced into one worker RPC
+MAX_TASK_PUMPS = 32         # concurrent batch senders per resource shape
 
 
 # --- public value types -----------------------------------------------------
@@ -151,6 +154,16 @@ class MemoryStore:
         return e is not None and e.status != PENDING
 
 
+@dataclass
+class _TaskSpec:
+    task_id: TaskID
+    digest: bytes
+    args_frame: bytes
+    oids: List[ObjectID]
+    retries: int
+    attempt: int = 0
+
+
 # --- lease pool -------------------------------------------------------------
 
 @dataclass
@@ -159,20 +172,40 @@ class _LeasedWorker:
     agent_addr: Tuple[str, int]
     worker_addr: Tuple[str, int]
     worker_id: WorkerID
+    key: Optional[tuple] = None
     inflight: int = 0
     last_used: float = field(default_factory=time.monotonic)
     dead: bool = False
 
 
+class _ShapePool:
+    """Per-resource-shape lease state: workers, parked waiters, and the
+    number of lease requests in flight to the agents."""
+
+    __slots__ = ("workers", "waiters", "pending_leases")
+
+    def __init__(self):
+        self.workers: List[_LeasedWorker] = []
+        from collections import deque
+        self.waiters: "deque[asyncio.Future]" = deque()
+        self.pending_leases = 0
+
+
 class LeasePool:
     """Submitter-side cache of leased workers keyed by resource shape
-    (reference: normal_task_submitter.h lease caching/pipelining)."""
+    (reference: normal_task_submitter.h lease caching/pipelining).
+
+    Freed slots are handed directly to the oldest parked waiter (O(1) per
+    release) instead of notify_all on a shared condition — with thousands
+    of queued tasks the broadcast wakeups were O(n^2) and dominated task
+    throughput. Lease requests scale with demand (ceil(waiters/depth),
+    capped) rather than one at a time."""
+
+    MAX_PENDING_LEASES = 16
 
     def __init__(self, ctx: "CoreContext"):
         self.ctx = ctx
-        self._by_shape: Dict[tuple, List[_LeasedWorker]] = {}
-        self._pending_requests: Dict[tuple, int] = {}
-        self._cond = asyncio.Condition()
+        self._pools: Dict[tuple, _ShapePool] = {}
         self._reaper: Optional[asyncio.Task] = None
 
     @staticmethod
@@ -186,32 +219,28 @@ class LeasePool:
         if self._reaper is None:
             self._reaper = asyncio.ensure_future(self._reap_loop())
         key = self.shape_key(resources, pg, policy)
+        sp = self._pools.setdefault(key, _ShapePool())
         if policy == "spread":
             # True spreading: one fresh lease per task, rotated by the
             # agents' round-robin — no reuse that would pin one node.
             lw = await self._lease_now(resources, pg, policy)
+            lw.key = key
             lw.inflight = 1
-            async with self._cond:
-                self._by_shape.setdefault(key, []).append(lw)
+            sp.workers.append(lw)
             return lw
-        async with self._cond:
-            while True:
-                err = self.ctx.consume_scheduling_error(key)
-                if err is not None:
-                    raise err
-                pool = self._by_shape.setdefault(key, [])
-                pool[:] = [lw for lw in pool if not lw.dead]
-                free = [lw for lw in pool if lw.inflight < PIPELINE_DEPTH]
-                if free:
-                    lw = min(free, key=lambda x: x.inflight)
-                    lw.inflight += 1
-                    lw.last_used = time.monotonic()
-                    return lw
-                if self._pending_requests.get(key, 0) == 0:
-                    self._pending_requests[key] = 1
-                    asyncio.ensure_future(
-                        self._request_lease(key, resources, pg, policy))
-                await self._cond.wait()
+        best = None
+        for lw in sp.workers:
+            if not lw.dead and lw.inflight < PIPELINE_DEPTH:
+                if best is None or lw.inflight < best.inflight:
+                    best = lw
+        if best is not None:
+            best.inflight += 1
+            best.last_used = time.monotonic()
+            return best
+        fut = asyncio.get_running_loop().create_future()
+        sp.waiters.append(fut)
+        self._maybe_request_leases(key, sp)
+        return await fut
 
     async def _lease_now(self, resources, pg, policy) -> _LeasedWorker:
         addr = self.ctx.agent_addr
@@ -235,55 +264,126 @@ class LeasePool:
             raise RayTpuError(r.get("error", "lease refused"))
         raise RayTpuError("spillback loop exceeded hop limit")
 
-    async def _request_lease(self, key, resources, pg, policy):
+    def _maybe_request_leases(self, key: tuple, sp: _ShapePool):
+        import math
+        demand = math.ceil(len(sp.waiters) / PIPELINE_DEPTH)
+        want = min(demand, self.MAX_PENDING_LEASES) - sp.pending_leases
+        for _ in range(want):
+            sp.pending_leases += 1
+            asyncio.ensure_future(self._request_lease(key, sp))
+
+    async def _request_lease(self, key: tuple, sp: _ShapePool):
+        resources, pg, policy = dict(key[0]), key[1], key[2]
         try:
             lw = await self._lease_now(resources, pg, policy)
-            async with self._cond:
-                self._by_shape.setdefault(key, []).append(lw)
-        except Exception as e:  # noqa: BLE001 — wake waiters with failure
-            self.ctx.record_scheduling_error(key, e)
+            lw.key = key
+            # Demand may have drained while this request was queued at the
+            # agent: a surplus lease would sit idle holding resources until
+            # the reaper — hand it straight back instead.
+            if not sp.waiters and any(
+                    w for w in sp.workers
+                    if not w.dead and w.inflight < PIPELINE_DEPTH):
+                try:
+                    await self.ctx.pool.call(
+                        lw.agent_addr, "release_lease",
+                        lease_id=lw.lease_id, timeout=5.0)
+                except Exception:
+                    pass
+                return
+            sp.workers.append(lw)
+            for _ in range(PIPELINE_DEPTH):
+                if not self._hand_slot(sp, lw):
+                    break
+        except Exception as e:  # noqa: BLE001 — propagate to parked waiters
+            if "infeasible" in str(e):
+                # Cluster-wide terminal (the agent already grace-polled
+                # for joining nodes): every waiter would fail the same way.
+                while sp.waiters:
+                    fut = sp.waiters.popleft()
+                    if not fut.done():
+                        fut.set_exception(e)
+            else:
+                # Transient (timeout / no worker): fail only one waiter —
+                # other in-flight requests may be about to succeed.
+                while sp.waiters:
+                    fut = sp.waiters.popleft()
+                    if not fut.done():
+                        fut.set_exception(e)
+                        break
         finally:
-            async with self._cond:
-                self._pending_requests[key] = 0
-                self._cond.notify_all()
+            sp.pending_leases -= 1
+            if sp.waiters:
+                self._maybe_request_leases(key, sp)
+
+    def _hand_slot(self, sp: _ShapePool, lw: _LeasedWorker) -> bool:
+        """Give one execution slot on lw to the oldest live waiter."""
+        while sp.waiters:
+            fut = sp.waiters.popleft()
+            if fut.done():  # cancelled waiter
+                continue
+            lw.inflight += 1
+            lw.last_used = time.monotonic()
+            fut.set_result(lw)
+            return True
+        return False
 
     async def release_slot(self, lw: _LeasedWorker, dead: bool = False):
-        async with self._cond:
-            lw.inflight -= 1
-            lw.last_used = time.monotonic()
-            if dead:
+        sp = self._pools.get(lw.key)
+        lw.inflight -= 1
+        lw.last_used = time.monotonic()
+        if not dead and lw.key is not None and lw.key[2] == "spread" \
+                and lw.inflight == 0 and not lw.dead:
+            # Spread leases are one-shot by design: return the resources
+            # immediately rather than letting an idle lease pin a node.
+            lw.dead = True
+            if sp is not None and lw in sp.workers:
+                sp.workers.remove(lw)
+            try:
+                await self.ctx.pool.call(lw.agent_addr, "release_lease",
+                                         lease_id=lw.lease_id, timeout=5.0)
+            except Exception:
+                pass
+            return
+        if dead:
+            if not lw.dead:
                 lw.dead = True
+                if sp is not None and lw in sp.workers:
+                    sp.workers.remove(lw)
                 try:
                     await self.ctx.pool.call(
                         lw.agent_addr, "release_lease",
                         lease_id=lw.lease_id, worker_died=True)
                 except Exception:
                     pass
-            self._cond.notify_all()
+            if sp is not None and sp.waiters:
+                self._maybe_request_leases(lw.key, sp)
+            return
+        if sp is not None and sp.waiters and lw.inflight < PIPELINE_DEPTH:
+            # Hand the freed slot straight to a parked waiter.
+            self._hand_slot(sp, lw)
 
     async def _reap_loop(self):
         while True:
             await asyncio.sleep(LEASE_IDLE_RETURN_S / 2)
             now = time.monotonic()
-            async with self._cond:
-                for key, pool in self._by_shape.items():
-                    keep = []
-                    for lw in pool:
-                        if (not lw.dead and lw.inflight == 0
-                                and now - lw.last_used > LEASE_IDLE_RETURN_S):
-                            lw.dead = True
-                            asyncio.ensure_future(self.ctx.pool.call(
-                                lw.agent_addr, "release_lease",
-                                lease_id=lw.lease_id))
-                        elif not lw.dead:
-                            keep.append(lw)
-                    pool[:] = keep
+            for key, sp in self._pools.items():
+                keep = []
+                for lw in sp.workers:
+                    if (not lw.dead and lw.inflight == 0
+                            and now - lw.last_used > LEASE_IDLE_RETURN_S):
+                        lw.dead = True
+                        asyncio.ensure_future(self.ctx.pool.call(
+                            lw.agent_addr, "release_lease",
+                            lease_id=lw.lease_id))
+                    elif not lw.dead:
+                        keep.append(lw)
+                sp.workers[:] = keep
 
     async def shutdown(self):
         if self._reaper:
             self._reaper.cancel()
-        for pool in self._by_shape.values():
-            for lw in pool:
+        for sp in self._pools.values():
+            for lw in sp.workers:
                 if not lw.dead:
                     try:
                         await self.ctx.pool.call(
@@ -291,7 +391,7 @@ class LeasePool:
                             lease_id=lw.lease_id, timeout=2.0)
                     except Exception:
                         pass
-        self._by_shape.clear()
+        self._pools.clear()
 
 
 # --- core context -----------------------------------------------------------
@@ -322,10 +422,15 @@ class CoreContext:
         self.fn_cache = FunctionCache()
         self._shipped_digests: Dict[Tuple[str, int], set] = {}
         self.shm_reader = SharedStoreReader()
-        self._sched_errors: Dict[tuple, Exception] = {}
         self._actor_addr_cache: Dict[ActorID, Tuple[str, int]] = {}
+        self._actor_pending: Dict[ActorID, Any] = {}
+        self._actor_pump_live: Dict[ActorID, bool] = {}
+        self._actor_inflight: Dict[ActorID, set] = {}
+        self._actor_mc: Dict[ActorID, int] = {}
+        self._task_queues: Dict[tuple, dict] = {}
 
     async def start(self, host: str = "127.0.0.1"):
+        self.loop = asyncio.get_running_loop()
         self.addr = await self.server.start(host, 0)
         return self.addr
 
@@ -338,41 +443,57 @@ class CoreContext:
     async def _handle_ping(self):
         return "pong"
 
-    def record_scheduling_error(self, key, err: Exception):
-        self._sched_errors[key] = err
-
-    def consume_scheduling_error(self, key) -> Optional[Exception]:
-        return self._sched_errors.pop(key, None)
-
     # --- object plane: put/get/wait ---------------------------------------
 
-    def _segname(self, oid: ObjectID) -> str:
-        return (f"rt{self.session_id[:6]}{self.node_id.hex()[:6]}"
-                f"_{oid.hex()}")
-
     async def put_shm(self, oid: ObjectID, ser: Serialized) -> int:
-        """Write a Serialized frame into a node-local shared segment and
-        register it with the agent (which adopts lifetime)."""
-        data = ser.to_bytes()
-        shm = shared_memory.SharedMemory(
-            create=True, size=max(len(data), 1), name=self._segname(oid))
-        shm.buf[:len(data)] = data
-        size = len(data)
-        shm.close()
-        await self.pool.call(self.agent_addr, "register_segment",
-                             oid=oid, size=size)
+        """Write a Serialized frame into the node's shared store: ask the
+        agent for (segment, offset) in a pre-faulted arena, write the frame
+        directly into the cached mapping (no intermediate copy, no fresh
+        mmap page faults), then seal."""
+        size = ser.frame_nbytes
+        r = await self.pool.call(self.agent_addr, "alloc_object",
+                                 oid=oid, size=size)
+        try:
+            mv = self.shm_reader.read(r["segname"], size, r["offset"])
+            ser.write_into(mv)
+            del mv
+        except BaseException:
+            try:
+                await self.pool.call(self.agent_addr, "abort_object",
+                                     oid=oid)
+            except Exception:
+                pass
+            raise
+        await self.pool.call(self.agent_addr, "seal_object", oid=oid)
         return size
 
-    async def put(self, value: Any) -> ObjectRef:
-        from ray_tpu.runtime.serialization import serialize
+    async def put_serialized(self, ser: Serialized) -> ObjectRef:
         oid = ObjectID.generate()
-        ser = serialize(value)
         if ser.total_bytes <= self.config.inline_object_max_bytes:
             self.store.resolve(oid, frame=ser.to_bytes())
             return ObjectRef(oid, self.addr, ser.total_bytes)
         size = await self.put_shm(oid, ser)
         self.store.resolve(oid, shm_size=size)
         return ObjectRef(oid, self.addr, size)
+
+    async def put(self, value: Any) -> ObjectRef:
+        from ray_tpu.runtime.serialization import serialize
+        return await self.put_serialized(serialize(value))
+
+    def try_get_local(self, ref: ObjectRef):
+        """Caller-thread fast path: returns (True, value) iff the object is
+        resolved in this process's memory store as an inline value (or a
+        cached error, which raises). shm-resident objects need the agent
+        RPC and fall through. Thread-safe: dict reads under the GIL on
+        entries only mutated monotonically PENDING->final."""
+        e = self.store.get_entry(ref.oid)
+        if e is None:
+            return False, None
+        if e.status == READY:
+            return True, self._loads_value(e.frame)
+        if e.status == ERROR:
+            raise self._loads_error(e.error_frame)
+        return False, None
 
     async def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -439,7 +560,8 @@ class CoreContext:
         # object store; a writable view would let any consumer silently
         # corrupt the sealed object for every other reader (the reference
         # makes plasma buffers read-only for the same reason).
-        mv = self.shm_reader.read(seg, r["size"]).toreadonly()
+        mv = self.shm_reader.read(
+            seg, r["size"], r.get("offset", 0)).toreadonly()
         return loads_oob(mv)
 
     async def _handle_fetch_object(self, oid: ObjectID,
@@ -458,58 +580,80 @@ class CoreContext:
         return {"kind": "lost"}
 
     async def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
-                   timeout: Optional[float] = None,
-                   poll_s: float = 0.01):
+                   timeout: Optional[float] = None):
+        """Park one subscription per pending ref (owner-side event wait; for
+        borrowed refs a long-poll parked on the owner) and return once
+        `num_returns` are ready — no polling loop (reference:
+        raylet/wait_manager.h parks waiters on object-ready callbacks)."""
+        refs = list(refs)
+        num_returns = min(num_returns, len(refs))
+        tasks: Dict[asyncio.Task, ObjectRef] = {
+            asyncio.ensure_future(self._await_ready(r)): r for r in refs}
         deadline = (time.monotonic() + timeout) if timeout is not None else None
-        pending = list(refs)
-        ready: List[ObjectRef] = []
-        while True:
-            still = []
-            for ref in pending:
-                if await self._is_ready(ref):
-                    ready.append(ref)
-                else:
-                    still.append(ref)
-            pending = still
-            if len(ready) >= num_returns or not pending:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            await asyncio.sleep(poll_s)
-            poll_s = min(poll_s * 1.5, 0.2)
+        ready_set: set = set()
+        try:
+            while tasks and len(ready_set) < num_returns:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                done, _ = await asyncio.wait(
+                    tasks.keys(), timeout=remaining,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    break
+                for t in done:
+                    ready_set.add(id(tasks.pop(t)))
+        finally:
+            for t in tasks:
+                t.cancel()
+        ready = [r for r in refs if id(r) in ready_set]
+        pending = [r for r in refs if id(r) not in ready_set]
         return ready, pending
 
-    async def _is_ready(self, ref: ObjectRef) -> bool:
+    async def _await_ready(self, ref: ObjectRef) -> None:
+        """Resolves when the ref is ready; caches the result locally so the
+        subsequent get() is a memory-store hit."""
         e = self.store.get_entry(ref.oid)
         if e is not None and e.status != PENDING:
-            return True
+            return
         if self._is_owner(ref):
-            return False
-        try:
-            r = await self.pool.call(ref.owner_addr, "fetch_object",
-                                     oid=ref.oid, wait_timeout=0.001,
-                                     timeout=5.0)
-            if r.get("kind") in ("inline", "error", "shm"):
-                # cache inline results so get() later is local
-                if r["kind"] == "inline":
-                    self.store.resolve(ref.oid, frame=r["frame"])
-                elif r["kind"] == "error":
-                    self.store.resolve(ref.oid, error_frame=r["frame"])
-                else:
-                    self.store.resolve(ref.oid, shm_size=r["size"])
-                return True
-        except rpc.RpcError:
-            pass
-        return False
+            await self.store.wait_ready(ref.oid, None)
+            return
+        while True:
+            try:
+                r = await self.pool.call(ref.owner_addr, "fetch_object",
+                                         oid=ref.oid, wait_timeout=30.0,
+                                         timeout=40.0)
+            except rpc.RpcError:
+                await asyncio.sleep(0.2)
+                continue
+            kind = r.get("kind")
+            if kind == "inline":
+                self.store.resolve(ref.oid, frame=r["frame"])
+                return
+            if kind == "error":
+                self.store.resolve(ref.oid, error_frame=r["frame"])
+                return
+            if kind == "shm":
+                self.store.resolve(ref.oid, shm_size=r["size"])
+                return
+            # "timeout": owner hasn't produced it yet — park again.
 
     # --- task submission ---------------------------------------------------
 
-    async def submit_task(self, fn: Callable, args: tuple, kwargs: dict,
-                          *, num_returns: int = 1,
-                          resources: Optional[dict] = None,
-                          max_retries: Optional[int] = None,
-                          pg: Optional[tuple] = None,
-                          policy: str = "default") -> List[ObjectRef]:
+    def submit_task_sync(self, fn: Callable, args: tuple, kwargs: dict,
+                         *, num_returns: int = 1,
+                         resources: Optional[dict] = None,
+                         max_retries: Optional[int] = None,
+                         pg: Optional[tuple] = None,
+                         policy: str = "default") -> List[ObjectRef]:
+        """Thread-safe submission from the sync API: serialization runs on
+        the caller's thread (off the event loop), then scheduling hops to
+        the loop with one call_soon_threadsafe — no per-call round trip
+        (the reference's equivalent split is the Cython submit path feeding
+        the C++ io_service, _raylet.pyx submit_task)."""
         resources = dict(resources or {"CPU": 1.0})
         retries = (max_retries if max_retries is not None
                    else self.config.default_max_task_retries)
@@ -520,65 +664,176 @@ class CoreContext:
         refs = [ObjectRef(oid, self.addr) for oid in oids]
         digest = self.fn_cache.digest_for(fn)
         args_frame = dumps_oob((args, kwargs))
-        asyncio.ensure_future(self._drive_task(
-            task_id, digest, args_frame, oids, resources,
-            retries, pg, policy))
+        spec = _TaskSpec(task_id, digest, args_frame, oids, retries)
+        key = LeasePool.shape_key(resources, pg, policy)
+        # Dependency resolution happens owner-side BEFORE the task takes a
+        # lease (reference: task dependency manager gates scheduling,
+        # raylet/dependency_manager.h). Otherwise a task blocking on its
+        # args inside a worker pins the lease its producer needs —
+        # deadlock under load.
+        deps = [a for a in args if isinstance(a, ObjectRef)]
+        deps += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+        if deps:
+            self.loop.call_soon_threadsafe(
+                self._spawn, self._enqueue_after_deps(key, spec, deps))
+        else:
+            self.loop.call_soon_threadsafe(self._enqueue_task, key, spec)
         return refs
 
-    async def _drive_task(self, task_id, digest, args_frame,
-                          oids, resources, retries, pg, policy):
-        attempt = 0
-        while True:
-            lw = None
-            try:
-                lw = await self.leases.acquire(resources, pg, policy)
-                shipped = self._shipped_digests.setdefault(
-                    lw.worker_addr, set())
-                payload = (None if digest in shipped
-                           else self.fn_cache.payload_for(digest))
+    async def _enqueue_after_deps(self, key: tuple, spec: "_TaskSpec",
+                                  deps: List[ObjectRef]):
+        try:
+            await asyncio.gather(*[self._await_ready(r) for r in deps])
+        except Exception as e:  # noqa: BLE001 — dep fetch failed
+            self._fail_all(spec.oids, RayTpuError(
+                f"task dependency resolution failed: {e}"))
+            return
+        self._enqueue_task(key, spec)
+
+    @staticmethod
+    def _spawn(coro):
+        asyncio.ensure_future(coro)
+
+    async def submit_task(self, fn: Callable, args: tuple, kwargs: dict,
+                          *, num_returns: int = 1,
+                          resources: Optional[dict] = None,
+                          max_retries: Optional[int] = None,
+                          pg: Optional[tuple] = None,
+                          policy: str = "default") -> List[ObjectRef]:
+        return self.submit_task_sync(
+            fn, args, kwargs, num_returns=num_returns, resources=resources,
+            max_retries=max_retries, pg=pg, policy=policy)
+
+    # Stateless tasks flow through per-shape pumps, like actor calls: each
+    # pump holds one lease slot at a time and drains whatever queued into
+    # one exec_task_batch RPC, so frame/task/executor-hop costs amortize
+    # while distinct pumps still spread batches across workers.
+
+    def _enqueue_task(self, key: tuple, spec: "_TaskSpec"):
+        st = self._task_queues.get(key)
+        if st is None:
+            from collections import deque
+            st = self._task_queues[key] = {"q": deque(), "pumps": 0,
+                                           "sending": 0}
+        st["q"].append(spec)
+        self._kick_task_pumps(key, st)
+
+    def _kick_task_pumps(self, key: tuple, st: dict):
+        # Pumps busy mid-send don't count toward coverage: a queued task
+        # must never wait behind an in-flight batch while capacity is idle.
+        idle_pumps = st["pumps"] - st["sending"]
+        if st["pumps"] < MAX_TASK_PUMPS and len(st["q"]) > idle_pumps:
+            st["pumps"] += 1
+            asyncio.ensure_future(self._task_pump(key, st))
+
+    async def _task_pump(self, key: tuple, st: dict):
+        q = st["q"]
+        resources, pg, policy = dict(key[0]), key[1], key[2]
+        try:
+            while q:
+                if policy == "spread":
+                    # Claim the spec BEFORE leasing: each spread lease is
+                    # round-robin over nodes, so leases must map 1:1 to
+                    # tasks — a surplus lease acquired after the queue
+                    # drained would waste its rotation slot and skew the
+                    # spread.
+                    spec = q.popleft()
+                    try:
+                        lw = await self.leases.acquire(
+                            resources, pg, policy)
+                    except Exception as e:  # noqa: BLE001
+                        self._fail_all(spec.oids, e if isinstance(
+                            e, RayTpuError) else WorkerCrashedError(
+                            f"lease failed: {e}"))
+                        continue
+                    st["sending"] += 1
+                    try:
+                        await self._send_task_batch(key, st, lw, [spec])
+                    finally:
+                        st["sending"] -= 1
+                    continue
                 try:
-                    r = await self.pool.call(
-                        lw.worker_addr, "exec_task",
-                        task_id=task_id, fn_digest=digest,
-                        fn_payload=payload, args_frame=args_frame,
-                        return_oids=oids, owner_addr=self.addr,
-                        timeout=None)
-                except rpc.RemoteError as re:
-                    if "unknown function digest" in str(re):
-                        r = await self.pool.call(
-                            lw.worker_addr, "exec_task",
-                            task_id=task_id, fn_digest=digest,
-                            fn_payload=self.fn_cache.payload_for(digest),
-                            args_frame=args_frame,
-                            return_oids=oids, owner_addr=self.addr,
-                            timeout=None)
-                    else:
-                        raise
-                shipped.add(digest)
-                await self.leases.release_slot(lw)
-                self._apply_result(oids, r)
-                return
-            except rpc.RemoteError as e:
-                # Handler-level failure from a live worker: the worker is
-                # fine — return it to the idle pool (marking it dead would
-                # leave it stuck in LEASED forever, leaking slots).
-                if lw is not None:
+                    lw = await self.leases.acquire(resources, pg, policy)
+                except Exception as e:  # noqa: BLE001 — scheduling failure
+                    err = (e if isinstance(e, RayTpuError)
+                           else WorkerCrashedError(f"lease failed: {e}"))
+                    if "infeasible" in str(e):  # terminal for the shape
+                        while q:
+                            self._fail_all(q.popleft().oids, err)
+                        return
+                    if q:  # transient: fail one task, keep pumping
+                        self._fail_all(q.popleft().oids, err)
+                    continue
+                if not q:
                     await self.leases.release_slot(lw)
-                self._fail_all(oids, TaskError(str(e)))
-                return
-            except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
-                if lw is not None:
-                    await self.leases.release_slot(lw, dead=True)
-                attempt += 1
-                if attempt > retries:
-                    self._fail_all(
-                        oids, WorkerCrashedError(
-                            f"task {task_id} failed after {attempt} "
-                            f"attempts: {e}"))
                     return
-            except RayTpuError as e:
-                self._fail_all(oids, e)
-                return
+                # Share the queue across live pumps: fan out to idle
+                # workers before coalescing (no head-of-line blocking of a
+                # fast task behind a slow one when capacity is free);
+                # batch only once the backlog exceeds the pump count.
+                width = min(TASK_BATCH_MAX,
+                            -(-len(q) // max(st["pumps"], 1)))
+                batch = [q.popleft()
+                         for _ in range(min(len(q), width))]
+                st["sending"] += 1
+                try:
+                    await self._send_task_batch(key, st, lw, batch)
+                finally:
+                    st["sending"] -= 1
+        finally:
+            st["pumps"] -= 1
+            if q:
+                self._kick_task_pumps(key, st)
+
+    async def _send_task_batch(self, key, st, lw, batch,
+                               force_payload: bool = False):
+        shipped = self._shipped_digests.setdefault(lw.worker_addr, set())
+        calls = []
+        for s in batch:
+            payload = (self.fn_cache.payload_for(s.digest)
+                       if force_payload or s.digest not in shipped
+                       else None)
+            calls.append({
+                "task_id": s.task_id, "fn_digest": s.digest,
+                "fn_payload": payload, "args_frame": s.args_frame,
+                "return_oids": s.oids})
+        try:
+            r = await self.pool.call(
+                lw.worker_addr, "exec_task_batch", calls=calls,
+                owner_addr=self.addr, timeout=None)
+        except rpc.RemoteError as e:
+            # Handler-level failure from a live worker: the worker is
+            # fine — return it to the idle pool (marking it dead would
+            # leave it stuck in LEASED forever, leaking slots).
+            await self.leases.release_slot(lw)
+            for s in batch:
+                self._fail_all(s.oids, TaskError(str(e)))
+            return
+        except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
+            await self.leases.release_slot(lw, dead=True)
+            for s in batch:
+                s.attempt += 1
+                if s.attempt > s.retries:
+                    self._fail_all(s.oids, WorkerCrashedError(
+                        f"task {s.task_id} failed after {s.attempt} "
+                        f"attempts: {e}"))
+                else:
+                    st["q"].append(s)
+            return
+        for s in batch:
+            shipped.add(s.digest)
+        redo = []
+        for res, s in zip(r["batch"], batch):
+            if isinstance(res, dict) and res.get("need_payload"):
+                redo.append(s)
+            else:
+                self._apply_result(s.oids, res)
+        if redo:
+            # Worker restarted behind a reused address: re-ship payloads.
+            await self._send_task_batch(key, st, lw, redo,
+                                        force_payload=True)
+            return
+        await self.leases.release_slot(lw)
 
     def _apply_result(self, oids: List[ObjectID], r: dict):
         results = r["results"]  # list aligned with oids
@@ -621,7 +876,9 @@ class CoreContext:
             name=name, class_name=getattr(cls, "__name__", str(cls)),
             resources=resources, max_restarts=max_restarts,
             creation_spec=creation_spec, namespace=namespace,
-            scheduling=scheduling, pg=pg)
+            scheduling=scheduling, pg=pg,
+            max_concurrency=max_concurrency)
+        self._actor_mc[actor_id] = max_concurrency
         if not r.get("ok"):
             raise ActorError(r.get("error", "actor registration failed"))
         return actor_id
@@ -637,24 +894,132 @@ class CoreContext:
         if r.get("state") == "ALIVE":
             addr = tuple(r["addr"])
             self._actor_addr_cache[actor_id] = addr
+            self._actor_mc[actor_id] = int(r.get("max_concurrency", 1))
             return addr
         if r.get("state") == "DEAD":
             raise ActorDiedError(
                 f"actor {actor_id} is dead: {r.get('reason')}")
         raise ActorError(f"actor {actor_id} not alive: {r}")
 
-    async def submit_actor_call(self, actor_id: ActorID, method: str,
-                                args: tuple, kwargs: dict,
-                                num_returns: int = 1,
-                                max_task_retries: int = 0) -> List[ObjectRef]:
+    def submit_actor_call_sync(self, actor_id: ActorID, method: str,
+                               args: tuple, kwargs: dict,
+                               num_returns: int = 1,
+                               max_task_retries: int = 0) -> List[ObjectRef]:
+        """Thread-safe actor-call submission (see submit_task_sync)."""
         oids = [ObjectID.generate() for _ in range(num_returns)]
         for oid in oids:
             self.store.create_pending(oid)
         refs = [ObjectRef(oid, self.addr) for oid in oids]
         args_frame = dumps_oob((args, kwargs))
-        asyncio.ensure_future(self._drive_actor_call(
-            actor_id, method, args_frame, oids, max_task_retries))
+        self.loop.call_soon_threadsafe(
+            self._enqueue_actor_call, actor_id,
+            (method, args_frame, oids, max_task_retries, 0))
         return refs
+
+    async def submit_actor_call(self, actor_id: ActorID, method: str,
+                                args: tuple, kwargs: dict,
+                                num_returns: int = 1,
+                                max_task_retries: int = 0) -> List[ObjectRef]:
+        return self.submit_actor_call_sync(
+            actor_id, method, args, kwargs, num_returns, max_task_retries)
+
+    # Calls to one actor flow through a per-actor pump that coalesces
+    # whatever is queued into one RPC (up to ACTOR_BATCH_MAX): the per-call
+    # costs — frame, event-loop task, executor hop on the worker — amortize
+    # across the batch, which is where the async actor-call throughput
+    # comes from. One pump per actor keeps per-caller submission order,
+    # matching the reference's actor task ordering guarantee
+    # (actor_task_submitter.h sequence numbers).
+
+    def _enqueue_actor_call(self, actor_id: ActorID, call: tuple):
+        from collections import deque
+        q = self._actor_pending.get(actor_id)
+        if q is None:
+            q = self._actor_pending[actor_id] = deque()
+        q.append(call)
+        if not self._actor_pump_live.get(actor_id):
+            self._actor_pump_live[actor_id] = True
+            asyncio.ensure_future(self._actor_pump(actor_id))
+
+    async def _actor_pump(self, actor_id: ActorID):
+        """Drains the queue into batches, PIPELINED: batches are sent in
+        order but replies are awaited off-pump, so a long-running call
+        never blocks later submissions (max_concurrency and async actors
+        depend on requests continuing to arrive)."""
+        q = self._actor_pending[actor_id]
+        inflight = self._actor_inflight.setdefault(actor_id, set())
+        try:
+            while q:
+                # Establish addr+connection first so concurrent batch
+                # tasks can't reorder their sends during setup.
+                try:
+                    addr = await self.resolve_actor_addr(actor_id)
+                    await self.pool.get(addr)
+                except Exception:
+                    pass  # the batch task surfaces the error per-call
+                mc = self._actor_mc.get(actor_id, 0)
+                cap = (ACTOR_MAX_INFLIGHT_BATCHES if mc <= 1
+                       else max(mc, ACTOR_MAX_INFLIGHT_BATCHES))
+                while len(inflight) >= cap:
+                    await asyncio.wait(
+                        inflight, return_when=asyncio.FIRST_COMPLETED)
+                if not q:
+                    break
+                # Batch ONLY when execution is serialized anyway
+                # (max_concurrency == 1): a batch gets one reply, so in a
+                # concurrent actor a fast call's result would wait on the
+                # slowest call in its batch.
+                if mc == 1:
+                    batch = [q.popleft()
+                             for _ in range(min(len(q), ACTOR_BATCH_MAX))]
+                else:
+                    batch = [q.popleft()]
+                fut = asyncio.ensure_future(
+                    self._drive_actor_batch(actor_id, batch))
+                inflight.add(fut)
+                fut.add_done_callback(inflight.discard)
+        finally:
+            self._actor_pump_live[actor_id] = False
+            if q:  # raced with an enqueue that saw the pump still live
+                self._actor_pump_live[actor_id] = True
+                asyncio.ensure_future(self._actor_pump(actor_id))
+
+    async def _drive_actor_batch(self, actor_id: ActorID, batch: list):
+        if len(batch) == 1:
+            method, args_frame, oids, retries, _att = batch[0]
+            await self._drive_actor_call(
+                actor_id, method, args_frame, oids, retries)
+            return
+        calls = [{"method": m, "args_frame": af, "return_oids": oids}
+                 for (m, af, oids, _r, _a) in batch]
+        try:
+            addr = await self.resolve_actor_addr(actor_id)
+            r = await self.pool.call(
+                addr, "actor_call_batch", actor_id=actor_id,
+                calls=calls, owner_addr=self.addr, timeout=None)
+            for res, (_m, _af, oids, _r2, _a) in zip(r["batch"], batch):
+                self._apply_result(oids, res)
+        except (rpc.ConnectionLost, OSError) as e:
+            # Per-call retry budgets: a call with max_task_retries=0 must
+            # never re-execute (it may not be idempotent); the rest go
+            # back through the pump individually.
+            self._actor_addr_cache.pop(actor_id, None)
+            retryable = []
+            for (m, af, oids, retries, attempt) in batch:
+                if attempt + 1 > retries:
+                    self._fail_all(oids, ActorDiedError(
+                        f"actor {actor_id} connection lost: {e}"))
+                else:
+                    retryable.append((m, af, oids, retries, attempt + 1))
+            if retryable:
+                await asyncio.sleep(0.2)
+                for call in retryable:
+                    self._enqueue_actor_call(actor_id, call)
+        except (rpc.RemoteError, ActorError) as e:
+            err = (TaskError(str(e))
+                   if isinstance(e, rpc.RemoteError) else e)
+            for (_m, _af, oids, _r2, _a) in batch:
+                self._fail_all(oids, err)
 
     async def _drive_actor_call(self, actor_id, method, args_frame, oids,
                                 retries):

@@ -1,10 +1,14 @@
 """Object plane: per-node shared-memory store + per-process memory store.
 
 The plasma analog (reference: src/ray/object_manager/plasma/store.h,
-object_store.h, eviction_policy.h). Each sealed object is one named POSIX
-shared-memory segment holding a Serialized frame, so any process on the node
-maps it and deserializes zero-copy (numpy/jax host buffers view the mapping
-directly). LRU eviction spills sealed objects to disk and restores them on
+object_store.h, eviction_policy.h). Objects live inside a small number of
+large, pre-faulted shared-memory **arenas** managed by the node agent with a
+first-fit free-list allocator — the same design reason plasma keeps one
+mmap'd pool: a fresh mmap per object pays ~16k page faults per 64 MiB and
+caps put bandwidth ~4x below a warm mapping. Any process on the node maps an
+arena once (cached) and deserializes zero-copy at an offset (numpy/jax host
+buffers view the mapping directly). Oversized objects fall back to dedicated
+segments. LRU eviction spills sealed objects to disk and restores them on
 demand (reference: raylet/local_object_manager.h spill/restore).
 
 Small objects never come here — they live in the owner's in-process
@@ -14,14 +18,18 @@ core_worker/store_provider/memory_store/memory_store.h).
 
 from __future__ import annotations
 
+import bisect
 import os
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from multiprocessing import resource_tracker, shared_memory
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ray_tpu.runtime.ids import ObjectID
+
+ARENA_BYTES = 256 * 1024 * 1024
+ALIGN = 4096
 
 
 def _disable_shm_tracking() -> None:
@@ -53,14 +61,110 @@ def _attach(name: str) -> shared_memory.SharedMemory:
     return shared_memory.SharedMemory(name=name)
 
 
+def _align(n: int) -> int:
+    return (max(n, 1) + ALIGN - 1) // ALIGN * ALIGN
+
+
+DEALLOC_GRACE_S = 10.0
+
+
+class _Arena:
+    """One large pre-faulted segment plus a sorted free list of
+    (offset, size) ranges; first-fit alloc, coalescing dealloc.
+
+    Freed ranges sit in a quarantine for DEALLOC_GRACE_S before becoming
+    allocatable again: readers hold zero-copy views into the arena
+    (loads_oob aliases the mapping) and there is no cross-process unpin
+    signal, so immediate reuse would rewrite bytes under a live view (the
+    reference pins plasma objects while clients hold them; the grace
+    window is the coordination-free approximation). If no quarantined
+    range has aged out, alloc falls back to a dedicated segment upstream
+    — slower, never unsafe."""
+
+    def __init__(self, name: str, nbytes: int):
+        self.name = name
+        self.nbytes = nbytes
+        self.shm = shared_memory.SharedMemory(
+            create=True, size=nbytes, name=name)
+        import numpy as np
+        view = np.frombuffer(self.shm.buf, dtype=np.uint8)
+        view[:] = 0  # pre-fault every page once, at creation
+        del view
+        self.free: List[Tuple[int, int]] = [(0, nbytes)]
+        self.pending: List[Tuple[float, int, int]] = []  # (ts, off, n)
+
+    def alloc(self, n: int) -> Optional[int]:
+        self._reclaim()
+        n = _align(n)
+        for i, (off, sz) in enumerate(self.free):
+            if sz >= n:
+                if sz == n:
+                    self.free.pop(i)
+                else:
+                    self.free[i] = (off + n, sz - n)
+                return off
+        return None
+
+    def dealloc(self, off: int, n: int, immediate: bool = False) -> None:
+        """immediate=True for explicit user free() (unsafe-if-in-use is
+        the documented contract, matching the reference's ray.internal
+        free); runtime-initiated eviction always quarantines."""
+        if immediate:
+            self._insert_free(off, _align(n))
+        else:
+            self.pending.append((time.monotonic(), off, _align(n)))
+
+    def _reclaim(self) -> None:
+        if not self.pending:
+            return
+        now = time.monotonic()
+        keep = []
+        for ts, off, n in self.pending:
+            if now - ts >= DEALLOC_GRACE_S:
+                self._insert_free(off, n)
+            else:
+                keep.append((ts, off, n))
+        self.pending = keep
+
+    def _insert_free(self, off: int, n: int) -> None:
+        i = bisect.bisect_left(self.free, (off, 0))
+        self.free.insert(i, (off, n))
+        # Coalesce with right then left neighbour.
+        if i + 1 < len(self.free):
+            o, s = self.free[i]
+            o2, s2 = self.free[i + 1]
+            if o + s == o2:
+                self.free[i] = (o, s + s2)
+                self.free.pop(i + 1)
+        if i > 0:
+            o0, s0 = self.free[i - 1]
+            o, s = self.free[i]
+            if o0 + s0 == o:
+                self.free[i - 1] = (o0, s0 + s)
+                self.free.pop(i)
+
+    def destroy(self) -> None:
+        try:
+            self.shm.close()
+            self.shm.unlink()
+        except Exception:
+            pass
+
+
 @dataclass
 class _Entry:
-    shm: Optional[shared_memory.SharedMemory]
     size: int
+    shm: Optional[shared_memory.SharedMemory] = None  # dedicated segment
+    arena: Optional[_Arena] = None
+    offset: int = 0
     sealed: bool = False
     pins: int = 0
     spilled_path: Optional[str] = None
     created_at: float = field(default_factory=time.monotonic)
+
+    @property
+    def in_memory(self) -> bool:
+        return self.shm is not None or self.arena is not None
 
 
 class ObjectStoreFull(Exception):
@@ -69,7 +173,8 @@ class ObjectStoreFull(Exception):
 
 class SharedObjectStore:
     """The node-local store. One instance lives in the node agent (the
-    creator/owner of all segments); workers attach read-only by name."""
+    creator/owner of all arenas and segments); other processes attach
+    read-only by (segment name, offset)."""
 
     def __init__(self, session_id: str, capacity_bytes: int,
                  spill_dir: Optional[str] = None, node_uid: str = ""):
@@ -80,39 +185,90 @@ class SharedObjectStore:
         self.capacity = capacity_bytes
         self.spill_dir = spill_dir
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
+        self._arenas: List[_Arena] = []
+        self._arena_seq = 0
         self._used = 0
 
     def _segname(self, oid: ObjectID) -> str:
         return f"rt{self.session_id[:6]}{self.node_uid[:6]}_{oid.hex()}"
 
+    def _arena_bytes(self) -> int:
+        return min(ARENA_BYTES, max(self.capacity // 2, ALIGN))
+
     # --- write path ---
-    def create(self, oid: ObjectID, nbytes: int) -> memoryview:
+    def allocate(self, oid: ObjectID, nbytes: int) -> Tuple[str, int]:
+        """Reserve space for an unsealed object; returns (segname, offset)
+        for the producer to write the frame into."""
         if oid in self._entries:
             e = self._entries[oid]
             if e.sealed:
                 raise FileExistsError(f"{oid} already sealed")
             raise FileExistsError(f"{oid} being created")
         self._ensure_space(nbytes)
+        shm, arena, off = self._alloc_raw(oid, nbytes)
+        self._entries[oid] = _Entry(
+            size=nbytes, shm=shm, arena=arena, offset=off)
+        self._used += nbytes
+        return (arena.name if arena is not None
+                else self._segname(oid)), off
+
+    def _alloc_raw(self, oid: ObjectID, nbytes: int):
+        """Backing space for nbytes: (shm, arena, offset). Arena for
+        ordinary objects; dedicated segment when oversized or arenas are
+        exhausted under the capacity bound."""
+        if nbytes <= self._arena_bytes() // 2:
+            for arena in self._arenas:
+                off = arena.alloc(nbytes)
+                if off is not None:
+                    return None, arena, off
+            total_arena = sum(a.nbytes for a in self._arenas)
+            if total_arena + self._arena_bytes() <= max(
+                    self.capacity, self._arena_bytes()):
+                arena = self._new_arena()
+                off = arena.alloc(nbytes)
+                if off is not None:
+                    return None, arena, off
         shm = shared_memory.SharedMemory(
             create=True, size=max(nbytes, 1), name=self._segname(oid))
-        self._entries[oid] = _Entry(shm=shm, size=nbytes)
-        self._used += nbytes
-        return shm.buf[:nbytes]
+        return shm, None, 0
 
-    def adopt(self, oid: ObjectID, size: int) -> None:
-        """Take ownership of a segment another local process created+sealed
-        under the session naming scheme (workers write results in place and
-        hand lifetime management to the agent)."""
-        if oid in self._entries:
-            return
-        self._ensure_space(size)
-        shm = _attach(self._segname(oid))
-        self._entries[oid] = _Entry(shm=shm, size=size, sealed=True)
-        self._used += size
+    def _new_arena(self) -> _Arena:
+        name = (f"rt{self.session_id[:6]}{self.node_uid[:6]}"
+                f"_arena{self._arena_seq}")
+        self._arena_seq += 1
+        arena = _Arena(name, self._arena_bytes())
+        self._arenas.append(arena)
+        return arena
+
+    def create(self, oid: ObjectID, nbytes: int) -> memoryview:
+        """Allocate and return a writable view (agent-local writes, e.g.
+        the chunked pull path)."""
+        self.allocate(oid, nbytes)
+        e = self._entries[oid]
+        if e.arena is not None:
+            return e.arena.shm.buf[e.offset:e.offset + nbytes]
+        return e.shm.buf[:nbytes]
 
     def seal(self, oid: ObjectID) -> None:
         self._entries[oid].sealed = True
         self._entries.move_to_end(oid)
+
+    def abort(self, oid: ObjectID) -> None:
+        """Drop an unsealed allocation (producer died mid-write)."""
+        e = self._entries.get(oid)
+        if e is not None and not e.sealed:
+            self.delete(oid)
+
+    def sweep_unsealed(self, ttl_s: float = 60.0) -> int:
+        """Reap allocations never sealed within ttl (producer crashed
+        between Create and Seal; reference: plasma aborts a client's
+        unsealed objects on disconnect)."""
+        now = time.monotonic()
+        victims = [oid for oid, e in self._entries.items()
+                   if not e.sealed and now - e.created_at > ttl_s]
+        for oid in victims:
+            self.delete(oid)
+        return len(victims)
 
     def put_bytes(self, oid: ObjectID, data) -> None:
         mv = self.create(oid, len(data))
@@ -131,19 +287,24 @@ class SharedObjectStore:
         e = self._entries.get(oid)
         if e is None or not e.sealed:
             return None
-        if e.shm is None:  # spilled — restore
+        if not e.in_memory:  # spilled — restore
             self._restore(oid, e)
         self._entries.move_to_end(oid)
+        if e.arena is not None:
+            return e.arena.shm.buf[e.offset:e.offset + e.size]
         return e.shm.buf[:e.size]
 
-    def segment_name(self, oid: ObjectID) -> Optional[str]:
-        """For cross-process access: workers attach by name."""
+    def location(self, oid: ObjectID) -> Optional[Tuple[str, int, int]]:
+        """(segname, offset, size) for cross-process attach-by-name."""
         e = self._entries.get(oid)
         if e is None or not e.sealed:
             return None
-        if e.shm is None:
+        if not e.in_memory:
             self._restore(oid, e)
-        return self._segname(oid)
+        self._entries.move_to_end(oid)
+        if e.arena is not None:
+            return e.arena.name, e.offset, e.size
+        return self._segname(oid), 0, e.size
 
     def size_of(self, oid: ObjectID) -> Optional[int]:
         e = self._entries.get(oid)
@@ -164,22 +325,33 @@ class SharedObjectStore:
         e = self._entries.pop(oid, None)
         if e is None:
             return
-        if e.shm is not None:
-            self._used -= e.size
-            try:
-                e.shm.close()
-                e.shm.unlink()
-            except Exception:
-                pass
+        self._release_memory(e, immediate=True)
         if e.spilled_path:
             try:
                 os.unlink(e.spilled_path)
             except OSError:
                 pass
 
+    def _release_memory(self, e: _Entry, immediate: bool = False) -> None:
+        if e.arena is not None:
+            self._used -= e.size
+            e.arena.dealloc(e.offset, e.size, immediate=immediate)
+            e.arena = None
+        elif e.shm is not None:
+            self._used -= e.size
+            try:
+                e.shm.close()
+                e.shm.unlink()
+            except Exception:
+                pass
+            e.shm = None
+
     def shutdown(self) -> None:
         for oid in list(self._entries):
             self.delete(oid)
+        for arena in self._arenas:
+            arena.destroy()
+        self._arenas.clear()
 
     @property
     def used_bytes(self) -> int:
@@ -187,7 +359,8 @@ class SharedObjectStore:
 
     def stats(self) -> dict:
         return {"objects": len(self._entries), "used_bytes": self._used,
-                "capacity_bytes": self.capacity}
+                "capacity_bytes": self.capacity,
+                "arenas": len(self._arenas)}
 
     # --- eviction / spill ---
     def _ensure_space(self, nbytes: int) -> None:
@@ -198,7 +371,7 @@ class SharedObjectStore:
         while self._used + nbytes > self.capacity:
             victim = next(
                 (oid for oid, e in self._entries.items()
-                 if e.sealed and e.pins == 0 and e.shm is not None), None)
+                 if e.sealed and e.pins == 0 and e.in_memory), None)
             if victim is None:
                 raise ObjectStoreFull(
                     f"need {nbytes} B, {self.capacity - self._used} free, "
@@ -210,16 +383,13 @@ class SharedObjectStore:
         if self.spill_dir:
             os.makedirs(self.spill_dir, exist_ok=True)
             path = os.path.join(self.spill_dir, oid.hex())
+            mv = (e.arena.shm.buf[e.offset:e.offset + e.size]
+                  if e.arena is not None else e.shm.buf[:e.size])
             with open(path, "wb") as f:
-                f.write(e.shm.buf[:e.size])
+                f.write(mv)
+            del mv
             e.spilled_path = path
-        self._used -= e.size
-        try:
-            e.shm.close()
-            e.shm.unlink()
-        except Exception:
-            pass
-        e.shm = None
+        self._release_memory(e)
         if not e.spilled_path:
             del self._entries[oid]
 
@@ -227,26 +397,29 @@ class SharedObjectStore:
         if not e.spilled_path:
             raise KeyError(f"{oid} evicted without spill copy")
         self._ensure_space(e.size)
-        shm = shared_memory.SharedMemory(
-            create=True, size=max(e.size, 1), name=self._segname(oid))
-        with open(e.spilled_path, "rb") as f:
-            f.readinto(shm.buf[:e.size])
-        e.shm = shm
+        e.shm, e.arena, e.offset = self._alloc_raw(oid, e.size)
         self._used += e.size
+        mv = (e.arena.shm.buf[e.offset:e.offset + e.size]
+              if e.arena is not None else e.shm.buf[:e.size])
+        with open(e.spilled_path, "rb") as f:
+            f.readinto(mv)
+        del mv
 
 
 class SharedStoreReader:
-    """Read-only attach-by-name view used by worker processes."""
+    """Read-only attach-by-name view used by other processes on the node.
+    Mappings are cached per segment name, so arena reads after the first
+    are pure pointer math."""
 
     def __init__(self):
         self._open: Dict[str, shared_memory.SharedMemory] = {}
 
-    def read(self, segname: str, size: int) -> memoryview:
+    def read(self, segname: str, size: int, offset: int = 0) -> memoryview:
         shm = self._open.get(segname)
         if shm is None:
             shm = _attach(segname)
             self._open[segname] = shm
-        return shm.buf[:size]
+        return shm.buf[offset:offset + size]
 
     def release(self, segname: str) -> None:
         shm = self._open.pop(segname, None)

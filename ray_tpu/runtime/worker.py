@@ -30,6 +30,14 @@ from ray_tpu.runtime.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.runtime.serialization import dumps_oob, loads_oob, serialize
 
 
+class _BatchError:
+    """Marks a per-call failure inside a batch executed on the worker
+    thread (exceptions can't be raised per-slot there)."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class _HostedActor:
     def __init__(self, instance, max_concurrency: int):
         self.instance = instance
@@ -47,8 +55,10 @@ class WorkerExecutor:
         self.running: Dict[TaskID, asyncio.Future] = {}
         self.cancelled: set = set()
         ctx.server.add_handler("exec_task", self.exec_task)
+        ctx.server.add_handler("exec_task_batch", self.exec_task_batch)
         ctx.server.add_handler("host_actor", self.host_actor)
         ctx.server.add_handler("actor_call", self.actor_call)
+        ctx.server.add_handler("actor_call_batch", self.actor_call_batch)
         ctx.server.add_handler("cancel_task", self.cancel_task)
         ctx.server.add_handler("shutdown_worker", self.shutdown_worker)
 
@@ -120,6 +130,70 @@ class WorkerExecutor:
         except BaseException as e:  # noqa: BLE001
             return self._package_error(e, return_oids)
 
+    async def exec_task_batch(self, calls: list, owner_addr):
+        """Coalesced stateless tasks (see core.py _task_pump). Sync
+        functions in the batch share ONE executor hop; async ones run on
+        the loop. Unknown digests come back as need_payload slots so the
+        owner can re-ship the function (worker restarts behind a reused
+        address)."""
+        out = [None] * len(calls)
+        sync_items = []
+        for i, c in enumerate(calls):
+            if c["task_id"] in self.cancelled:
+                self.cancelled.discard(c["task_id"])
+                out[i] = self._package_error(
+                    TaskError("task cancelled"), c["return_oids"])
+                continue
+            try:
+                fn = self.ctx.fn_cache.resolve(
+                    c["fn_digest"], c.get("fn_payload"))
+            except KeyError:
+                out[i] = {"need_payload": True}
+                continue
+            try:
+                args, kwargs = await self._resolve_args(c["args_frame"])
+            except BaseException as e:  # noqa: BLE001
+                out[i] = self._package_error(e, c["return_oids"])
+                continue
+            if inspect.iscoroutinefunction(fn):
+                try:
+                    value = await fn(*args, **kwargs)
+                except BaseException as e:  # noqa: BLE001
+                    out[i] = self._package_error(e, c["return_oids"])
+                else:
+                    out[i] = await self._package_slot(
+                        value, c["return_oids"])
+            else:
+                sync_items.append((i, fn, args, kwargs))
+        if sync_items:
+            loop = asyncio.get_running_loop()
+            vals = await loop.run_in_executor(
+                self.task_pool, self._run_task_batch_sync, sync_items)
+            for (i, _fn, _a, _k), v in zip(sync_items, vals):
+                c = calls[i]
+                out[i] = await self._package_slot(v, c["return_oids"])
+        return {"batch": out}
+
+    async def _package_slot(self, v, return_oids):
+        """Package one batched call's result; a per-call failure (e.g. an
+        unpicklable return) must not poison the rest of the batch."""
+        if isinstance(v, _BatchError):
+            return self._package_error(v.exc, return_oids)
+        try:
+            return await self._package(v, return_oids)
+        except BaseException as e:  # noqa: BLE001
+            return self._package_error(e, return_oids)
+
+    @staticmethod
+    def _run_task_batch_sync(items):
+        vals = []
+        for _i, fn, args, kwargs in items:
+            try:
+                vals.append(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 — per-task error
+                vals.append(_BatchError(e))
+        return vals
+
     async def cancel_task(self, task_id: TaskID):
         self.cancelled.add(task_id)
         return {"ok": True}
@@ -162,6 +236,61 @@ class WorkerExecutor:
             return await self._package(value, return_oids)
         except BaseException as e:  # noqa: BLE001
             return self._package_error(e, return_oids)
+
+    async def actor_call_batch(self, actor_id: ActorID, calls: list,
+                               owner_addr):
+        """Coalesced actor calls from one caller (see core.py _actor_pump).
+        When every method in the batch is a plain sync function, the whole
+        batch runs in ONE executor hop — the per-call thread handoff is the
+        dominant cost it eliminates."""
+        hosted = self.actors.get(actor_id)
+        if hosted is None:
+            err = TaskError(f"actor {actor_id} not hosted here")
+            return {"batch": [self._package_error(err, c["return_oids"])
+                              for c in calls]}
+        methods = [getattr(hosted.instance, c["method"], None)
+                   for c in calls]
+        all_sync = all(m is not None and callable(m)
+                       and not inspect.iscoroutinefunction(m)
+                       for m in methods)
+        if all_sync and hosted.lock is not None:
+            resolved = []
+            for c in calls:
+                try:
+                    resolved.append(await self._resolve_args(
+                        c["args_frame"]))
+                except BaseException as e:  # noqa: BLE001 — isolate call
+                    resolved.append(_BatchError(e))
+            async with hosted.lock:
+                loop = asyncio.get_running_loop()
+                values = await loop.run_in_executor(
+                    hosted.executor, self._run_batch_sync, methods, resolved)
+            out = []
+            for v, c in zip(values, calls):
+                out.append(await self._package_slot(v, c["return_oids"]))
+            return {"batch": out}
+        # Mixed/async batch: run per-call handlers CONCURRENTLY — async
+        # actor methods rely on interleaving on the loop (e.g. serve's
+        # @batch coalescing and max_concurrency semantics).
+        out = await asyncio.gather(*[
+            self.actor_call(actor_id, c["method"], c["args_frame"],
+                            c["return_oids"], owner_addr)
+            for c in calls])
+        return {"batch": list(out)}
+
+    @staticmethod
+    def _run_batch_sync(methods, resolved):
+        vals = []
+        for m, r in zip(methods, resolved):
+            if isinstance(r, _BatchError):  # arg resolution failed
+                vals.append(r)
+                continue
+            args, kwargs = r
+            try:
+                vals.append(m(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 — per-call error
+                vals.append(_BatchError(e))
+        return vals
 
     async def shutdown_worker(self):
         asyncio.get_running_loop().call_later(0.05, sys.exit, 0)

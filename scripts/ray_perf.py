@@ -1,0 +1,200 @@
+"""Runtime microbenchmarks — the analog of the reference's core perf
+harness (reference: python/ray/_private/ray_perf.py:95, results stored in
+release/perf_metrics/microbenchmark.json). Run:
+
+    python scripts/ray_perf.py [--quick]
+
+Prints one line per metric plus a JSON summary, and compares against the
+reference numbers recorded in BASELINE.md (Anyscale release-infra VMs; this
+harness runs wherever you run it, so treat the comparison as directional).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+
+import numpy as np
+
+import ray_tpu
+
+BASELINE = {  # BASELINE.md "Core microbenchmarks" table
+    "actor_calls_sync_1_1": 1645.0,
+    "actor_calls_async_1_1": 7528.0,
+    "actor_calls_async_n_n": 22975.0,
+    "tasks_sync_single_client": 751.0,
+    "tasks_async_single_client": 5781.0,
+    "tasks_async_multi_client": 18575.0,
+    "put_small_per_s": 4552.0,
+    "get_small_per_s": 10155.0,
+    "put_gigabytes_per_s": 10.9,
+    "wait_1k_refs_per_s": 4.27,
+    "pg_create_remove_per_s": 589.0,
+}
+
+
+def timeit(name, fn, multiplier=1, trials=3, warmup=1):
+    """fn() runs one batch and returns the op count in the batch."""
+    for _ in range(warmup):
+        fn()
+    rates = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        n = fn()
+        dt = time.perf_counter() - t0
+        rates.append(n * multiplier / dt)
+    mean = statistics.mean(rates)
+    std = statistics.stdev(rates) if len(rates) > 1 else 0.0
+    base = BASELINE.get(name)
+    vs = f"  [{mean / base:5.2f}x baseline {base:g}]" if base else ""
+    print(f"{name:34s} {mean:12.1f} ± {std:8.1f} /s{vs}", flush=True)
+    return {"name": name, "value": mean, "std": std,
+            "vs_baseline": (mean / base) if base else None}
+
+
+@ray_tpu.remote
+def _noop():
+    return None
+
+
+@ray_tpu.remote
+class _Counter:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+
+@ray_tpu.remote
+def _hammer(actors, n):
+    """Reference n:n shape: calls originate from worker processes, each
+    with its own submission loop (ray_perf.py actor_multi2/work)."""
+    ray_tpu.get([actors[i % len(actors)].inc.remote() for i in range(n)])
+    return n
+
+
+@ray_tpu.remote
+def _fanout(n):
+    ray_tpu.get([_noop.remote() for _ in range(n)])
+    return n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    scale = 0.3 if args.quick else 1.0
+
+    ray_tpu.init(num_cpus=8)
+    results = []
+
+    # --- object plane -------------------------------------------------------
+    small = b"x" * 100
+    n_put = int(2000 * scale)
+
+    def put_small():
+        for _ in range(n_put):
+            ray_tpu.put(small)
+        return n_put
+    results.append(timeit("put_small_per_s", put_small))
+
+    ref = ray_tpu.put(small)
+    n_get = int(5000 * scale)
+
+    def get_small():
+        for _ in range(n_get):
+            ray_tpu.get(ref)
+        return n_get
+    results.append(timeit("get_small_per_s", get_small))
+
+    big = np.zeros(64 * 1024 * 1024, dtype=np.uint8)  # 64 MiB, shm path
+    n_big = max(2, int(8 * scale))
+
+    def put_big():
+        for _ in range(n_big):
+            r = ray_tpu.put(big)
+            ray_tpu.free([r])
+        return n_big
+    results.append(timeit("put_gigabytes_per_s", put_big,
+                          multiplier=big.nbytes / (1 << 30)))
+
+    # --- tasks --------------------------------------------------------------
+    n_sync = int(300 * scale)
+
+    def tasks_sync():
+        for _ in range(n_sync):
+            ray_tpu.get(_noop.remote())
+        return n_sync
+    results.append(timeit("tasks_sync_single_client", tasks_sync))
+
+    n_async = int(2000 * scale)
+
+    def tasks_async():
+        ray_tpu.get([_noop.remote() for _ in range(n_async)])
+        return n_async
+    results.append(timeit("tasks_async_single_client", tasks_async))
+
+    # --- actors -------------------------------------------------------------
+    a = _Counter.remote()
+    ray_tpu.get(a.inc.remote())
+    n_acall = int(500 * scale)
+
+    def actor_sync():
+        for _ in range(n_acall):
+            ray_tpu.get(a.inc.remote())
+        return n_acall
+    results.append(timeit("actor_calls_sync_1_1", actor_sync))
+
+    n_abatch = int(3000 * scale)
+
+    def actor_async():
+        ray_tpu.get([a.inc.remote() for _ in range(n_abatch)])
+        return n_abatch
+    results.append(timeit("actor_calls_async_1_1", actor_async))
+
+    actors = [_Counter.remote() for _ in range(4)]
+    ray_tpu.get([x.inc.remote() for x in actors])
+    m_clients, n_per = 4, int(800 * scale)
+
+    def actor_nn():
+        ray_tpu.get([_hammer.remote(actors, n_per)
+                     for _ in range(m_clients)])
+        return m_clients * n_per
+    results.append(timeit("actor_calls_async_n_n", actor_nn))
+
+    def multi_client_tasks():
+        ray_tpu.get([_fanout.remote(n_per) for _ in range(m_clients)])
+        return m_clients * n_per
+    results.append(timeit("tasks_async_multi_client", multi_client_tasks))
+
+    # --- wait ---------------------------------------------------------------
+    refs_1k = [ray_tpu.put(i) for i in range(1000)]
+
+    def wait_1k():
+        for _ in range(5):
+            ray_tpu.wait(refs_1k, num_returns=len(refs_1k), timeout=10)
+        return 5
+    results.append(timeit("wait_1k_refs_per_s", wait_1k))
+
+    # --- placement groups ---------------------------------------------------
+    n_pg = int(60 * scale)
+
+    def pg_churn():
+        for _ in range(n_pg):
+            pg = ray_tpu.placement_group([{"CPU": 1}])
+            pg.ready(timeout=30)
+            ray_tpu.remove_placement_group(pg)
+        return n_pg
+    results.append(timeit("pg_create_remove_per_s", pg_churn, trials=2))
+
+    ray_tpu.shutdown()
+    print(json.dumps({r["name"]: round(r["value"], 1) for r in results}))
+    return results
+
+
+if __name__ == "__main__":
+    main()
