@@ -278,6 +278,15 @@ class GroupedData:
         self._key = key
 
     def _agg(self, cols: Dict[str, Tuple[str, Callable]]) -> Dataset:
+        if _runtime_up():
+            # Hash-partitioned distributed aggregation: every row of a key
+            # lands in one partition, aggregated there by a task
+            # (reference: hash-aggregate over hash_shuffle.py).
+            from ray_tpu.data.shuffle import distributed_groupby
+            blocks = list(distributed_groupby(
+                self._ds.iter_blocks(), self._key, cols))
+            return Dataset([_Op("from_blocks", "source", None,
+                                {"blocks": blocks})])
         groups: Dict[Any, List[dict]] = {}
         for row in self._ds.iter_rows():
             groups.setdefault(row[self._key], []).append(row)
@@ -285,20 +294,13 @@ class GroupedData:
         for k, rows in groups.items():
             out = {self._key: k}
             for out_name, (col, fn) in cols.items():
-                out[out_name] = fn([r[col] for r in rows])
+                out[out_name] = fn(np.asarray([r[col] for r in rows]))
             out_rows.append(out)
         return Dataset([_Op("from_blocks", "source", None,
                             {"blocks": [block_from_rows(out_rows)]})])
 
     def count(self) -> Dataset:
-        ds = self._ds
-        key = self._key
-        groups: Dict[Any, int] = {}
-        for row in ds.iter_rows():
-            groups[row[key]] = groups.get(row[key], 0) + 1
-        rows = [{key: k, "count()": v} for k, v in groups.items()]
-        return Dataset([_Op("from_blocks", "source", None,
-                            {"blocks": [block_from_rows(rows)]})])
+        return self._agg({"count()": (self._key, len)})
 
     def sum(self, on: str) -> Dataset:
         return self._agg({f"sum({on})": (on, lambda v: float(np.sum(v)))})
@@ -490,6 +492,30 @@ def _limit_stream(stream: Iterator[Block], n: int) -> Iterator[Block]:
 
 
 def _all2all(stream: Iterator[Block], op: _Op) -> Iterator[Block]:
+    if _runtime_up():
+        # Distributed path: map/reduce over runtime tasks + object plane;
+        # the driver streams refs, never the whole dataset (reference:
+        # hash_shuffle.py / planner/exchange).
+        from ray_tpu.data.shuffle import distributed_all2all
+        mode = op.args["mode"]
+        if mode == "shuffle":
+            spec = {"mode": "shuffle", "seed": op.args.get("seed")}
+            yield from distributed_all2all(stream, spec)
+            return
+        if mode == "sort":
+            spec = {"mode": "range", "key": op.args["key"],
+                    "descending": op.args.get("descending", False)}
+            yield from distributed_all2all(stream, spec)
+            return
+        if mode == "repartition":
+            spec = {"mode": "split"}
+            yield from distributed_all2all(stream, spec,
+                                           n_out=op.args["n"])
+            return
+    yield from _all2all_local(stream, op)
+
+
+def _all2all_local(stream: Iterator[Block], op: _Op) -> Iterator[Block]:
     mode = op.args["mode"]
     blocks = [b for b in stream if block_num_rows(b)]
     if not blocks:

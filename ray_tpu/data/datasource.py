@@ -43,8 +43,13 @@ def range(n: int, *, block_size: int = 65536) -> Dataset:  # noqa: A001
     return _source_ds("range", block_fns=fns)
 
 
-def from_numpy(arr: np.ndarray, column: str = "data") -> Dataset:
-    return _source_ds("from_numpy", blocks=[{column: arr}])
+def from_numpy(arr, column: str = "data") -> Dataset:
+    """A single ndarray (one column) or a dict of same-length ndarrays."""
+    if isinstance(arr, dict):
+        return _source_ds("from_numpy",
+                          blocks=[{k: np.asarray(v)
+                                   for k, v in arr.items()}])
+    return _source_ds("from_numpy", blocks=[{column: np.asarray(arr)}])
 
 
 def from_pandas(df) -> Dataset:

@@ -1,0 +1,183 @@
+"""Distributed all-to-all: hash/range/random shuffle over runtime tasks.
+
+The analog of the reference's all-to-all execution
+(python/ray/data/_internal/execution/operators/hash_shuffle.py and
+planner/exchange/*: map tasks partition each input block, reduce tasks
+merge one partition each). Blocks move through the shared-memory object
+plane — the driver only ever holds block *refs* plus the single block it
+is currently streaming to the consumer, never the whole dataset.
+
+Phases:
+  1. collect: stream input blocks into the object store (one at a time).
+  2. (sort only) sample: each block contributes a key sample; the driver
+     computes range boundaries from the union of samples.
+  3. map: one `_partition` task per input block -> n_out sub-blocks.
+  4. reduce: one `_merge` task per output partition; intermediate refs are
+     freed as soon as their partition is reduced.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.data.block import (Block, block_concat, block_num_rows,
+                                block_slice, block_take)
+
+# Partitions per shuffle: bounded so n_in x n_out ref fan-out stays sane.
+MAX_PARTITIONS = 64
+
+
+def _spec_partition(block: Block, n_out: int, spec: dict) -> List[Block]:
+    """Split one block into n_out sub-blocks per the shuffle spec. Runs
+    inside a worker task."""
+    total = block_num_rows(block)
+    mode = spec["mode"]
+    if mode == "shuffle":
+        rng = np.random.default_rng(spec.get("seed"))
+        part = rng.integers(0, n_out, size=total)
+    elif mode == "hash":
+        key = np.asarray(block[spec["key"]])
+        if key.dtype.kind in "OUS":
+            # Stable cross-process hash: Python's hash() is salted per
+            # process, which would scatter one key across partitions.
+            import zlib
+            part = np.asarray(
+                [zlib.crc32(str(k).encode()) % n_out for k in key],
+                dtype=np.int64)
+        else:
+            part = (key.astype(np.int64, copy=False) % n_out + n_out) % n_out
+    elif mode == "range":
+        key = np.asarray(block[spec["key"]])
+        part = np.searchsorted(spec["bounds"], key, side="right")
+    elif mode == "split":
+        per = max(1, -(-total // n_out))
+        part = np.minimum(np.arange(total) // per, n_out - 1)
+    else:
+        raise ValueError(mode)
+    out = []
+    for j in range(n_out):
+        idx = np.nonzero(part == j)[0]
+        out.append(block_take(block, idx) if len(idx) else {})
+    # num_returns=1 stores the return value as ONE object — return the
+    # bare block so the merge task doesn't see a single-element list.
+    return out[0] if n_out == 1 else out
+
+
+def _spec_merge(spec: dict, *parts: Block) -> Block:
+    """Merge one partition's sub-blocks into a final block. Runs inside a
+    worker task."""
+    parts = [p for p in parts if block_num_rows(p)]
+    if not parts:
+        return {}
+    merged = block_concat(list(parts))
+    mode = spec["mode"]
+    if mode == "shuffle":
+        rng = np.random.default_rng(spec.get("seed"))
+        return block_take(merged, rng.permutation(block_num_rows(merged)))
+    if mode == "range":
+        idx = np.argsort(merged[spec["key"]], kind="stable")
+        return block_take(merged, idx)
+    if mode == "hash" and spec.get("aggs"):
+        return _aggregate(merged, spec["key"], spec["aggs"])
+    return merged
+
+
+def _aggregate(block: Block, key: str,
+               aggs: Dict[str, Tuple[str, Callable]]) -> Block:
+    keys = np.asarray(block[key])
+    order = np.argsort(keys, kind="stable")
+    keys_sorted = keys[order]
+    uniq, starts = np.unique(keys_sorted, return_index=True)
+    out_rows: Dict[str, list] = {key: list(uniq)}
+    for out_name in aggs:
+        out_rows[out_name] = []
+    bounds = list(starts) + [len(keys_sorted)]
+    for g in range(len(uniq)):
+        sel = order[bounds[g]:bounds[g + 1]]
+        for out_name, (col, fn) in aggs.items():
+            out_rows[out_name].append(fn(np.asarray(block[col])[sel]))
+    return {k: np.asarray(v) for k, v in out_rows.items()}
+
+
+def distributed_all2all(stream: Iterator[Block],
+                        spec: dict,
+                        n_out: Optional[int] = None) -> Iterator[Block]:
+    """Run the shuffle across the cluster; yields output blocks one at a
+    time (cites reference shape: hash_shuffle.py HashShuffleOperator)."""
+    import ray_tpu
+
+    in_refs = []
+    for b in stream:
+        if block_num_rows(b):
+            in_refs.append(ray_tpu.put(b))
+    if not in_refs:
+        return
+    if n_out is None:
+        n_out = min(max(1, len(in_refs)), MAX_PARTITIONS)
+
+    if spec["mode"] == "range":
+        spec = dict(spec)
+        spec["bounds"] = _sample_bounds(in_refs, spec, n_out)
+
+    part_fn = ray_tpu.remote(_spec_partition).options(num_returns=n_out)
+    rows = []
+    for ref in in_refs:
+        r = part_fn.remote(ref, n_out, spec)
+        rows.append([r] if n_out == 1 else r)  # bare ref when 1 return
+    cols = [[rows[i][j] for i in range(len(rows))] for j in range(n_out)]
+    merge_fn = ray_tpu.remote(_spec_merge)
+    out_refs = [merge_fn.remote(spec, *col) for col in cols]
+    # Stream the reduced partitions; free inputs after the first merge
+    # lands (all maps have resolved their args by then) and each
+    # partition's intermediates as soon as it is consumed.
+    first = True
+    descending = spec.get("descending", False)
+    order = range(n_out - 1, -1, -1) if descending else range(n_out)
+    for j in order:
+        out = ray_tpu.get(out_refs[j], timeout=600)
+        # get() returns zero-copy views into the shared store; copy before
+        # freeing or the arena range gets recycled under the caller.
+        out = {k: np.array(v) for k, v in out.items()}
+        if first:
+            ray_tpu.free(in_refs)
+            first = False
+        ray_tpu.free(cols[j] + [out_refs[j]])
+        if block_num_rows(out):
+            if descending:
+                out = block_take(
+                    out, np.arange(block_num_rows(out) - 1, -1, -1))
+            yield out
+
+
+def _sample_bounds(in_refs, spec: dict, n_out: int) -> np.ndarray:
+    """Range-partition boundaries from per-block samples (reference:
+    planner/exchange/sort_task_spec.py SortTaskSpec.sample_boundaries)."""
+    import ray_tpu
+
+    key = spec["key"]
+
+    def _sample(block, k=64):
+        vals = np.asarray(block[key])
+        if len(vals) > k:
+            idx = np.random.default_rng(0).choice(
+                len(vals), size=k, replace=False)
+            vals = vals[idx]
+        return vals
+
+    sample_fn = ray_tpu.remote(_sample)
+    samples = ray_tpu.get([sample_fn.remote(r) for r in in_refs],
+                          timeout=300)
+    allv = np.sort(np.concatenate([s for s in samples if len(s)]))
+    qs = [int(len(allv) * (j + 1) / n_out) for j in range(n_out - 1)]
+    return allv[np.clip(qs, 0, len(allv) - 1)]
+
+
+def distributed_groupby(stream: Iterator[Block], key: str,
+                        aggs: Dict[str, Tuple[str, Callable]]
+                        ) -> Iterator[Block]:
+    """Hash-partition by key, aggregate per partition (all rows of one key
+    land in one partition, so per-partition aggregation is exact)."""
+    spec = {"mode": "hash", "key": key, "aggs": aggs}
+    yield from distributed_all2all(stream, spec)

@@ -1,0 +1,97 @@
+"""Distributed all-to-all: shuffle/sort/groupby/repartition over runtime
+tasks on a 2-node cluster, blocks flowing through the object plane
+(reference test shape: python/ray/data/tests/test_all_to_all.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.config import Config
+
+
+@pytest.fixture(scope="module")
+def two_node():
+    cfg = Config.from_env(num_workers_prestart=0, max_workers_per_node=3,
+                          default_max_task_retries=0)
+    c = Cluster(cfg)
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.address, num_cpus=0, config=cfg)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _big_range(n, block_rows=5000):
+    # Blocks above the inline threshold so intermediates ride shm.
+    blocks = rd.range(n)
+    return blocks.map_batches(
+        lambda b: {"id": b["id"],
+                   "pad": np.zeros((len(b["id"]), 64), dtype=np.float64)},
+        batch_size=block_rows)
+
+
+def test_distributed_random_shuffle(two_node):
+    ds = _big_range(20_000).random_shuffle(seed=7)
+    ids = np.concatenate([b["id"] for b in ds.iter_blocks()])
+    assert len(ids) == 20_000
+    assert not np.array_equal(ids, np.arange(20_000))  # actually permuted
+    assert np.array_equal(np.sort(ids), np.arange(20_000))  # lossless
+
+
+def test_distributed_sort(two_node):
+    rng = np.random.default_rng(3)
+    vals = rng.permutation(30_000)
+    ds = rd.from_numpy({"v": vals,
+                        "pad": np.zeros((30_000, 32))}).repartition(6)
+    out = ds.sort("v")
+    got = np.concatenate([b["v"] for b in out.iter_blocks()])
+    assert np.array_equal(got, np.arange(30_000))
+
+
+def test_distributed_sort_descending(two_node):
+    ds = rd.range(5_000).repartition(4).sort("id", descending=True)
+    got = np.concatenate([b["id"] for b in ds.iter_blocks()])
+    assert np.array_equal(got, np.arange(4_999, -1, -1))
+
+
+def test_distributed_groupby_sum(two_node):
+    n = 20_000
+    ds = rd.from_numpy({"k": np.arange(n) % 13,
+                        "v": np.ones(n)}).repartition(5)
+    out = ds.groupby("k").sum("v").to_pandas()
+    out = out.sort_values("k").reset_index(drop=True)
+    assert len(out) == 13
+    expect = [len(range(k, n, 13)) for k in range(13)]
+    assert list(out["sum(v)"]) == [float(e) for e in expect]
+
+
+def test_distributed_groupby_count_mean(two_node):
+    n = 9_000
+    ds = rd.from_numpy({"k": np.arange(n) % 4,
+                        "v": np.arange(n, dtype=np.float64)})
+    cnt = ds.groupby("k").count().to_pandas().sort_values("k")
+    assert list(cnt["count()"]) == [2250] * 4
+    mean = ds.groupby("k").mean("v").to_pandas().sort_values("k")
+    for k in range(4):
+        expect = np.mean(np.arange(k, n, 4))
+        assert abs(float(mean["mean(v)"].iloc[k]) - expect) < 1e-9
+
+
+def test_distributed_repartition(two_node):
+    ds = _big_range(12_000).repartition(4)
+    blocks = [b for b in ds.iter_blocks()]
+    assert len(blocks) == 4
+    total = sum(len(b["id"]) for b in blocks)
+    assert total == 12_000
+
+
+def test_shuffle_spans_nodes(two_node):
+    """Map/reduce tasks actually run on the worker nodes (the driver node
+    has zero CPUs), so blocks crossed the object plane."""
+    ds = _big_range(10_000).random_shuffle(seed=1)
+    assert ds.count() == 10_000
+    view = ray_tpu.cluster_resources()
+    assert view.get("CPU", 0) == 4.0
