@@ -1,0 +1,18 @@
+"""ray_tpu.tune — hyperparameter search over runtime actors.
+
+Reference surface: python/ray/tune (tuner.py:43, tune_config.py,
+schedulers/async_hyperband.py, search/sample.py)."""
+
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.search import (Categorical, Domain, Float, Integer,
+                                 choice, grid_search, loguniform, randint,
+                                 uniform)
+from ray_tpu.tune.tuner import (Result, ResultGrid, TrialStopped,
+                                TuneConfig, Tuner, report)
+
+__all__ = [
+    "ASHAScheduler", "FIFOScheduler", "Categorical", "Domain", "Float",
+    "Integer", "choice", "grid_search", "loguniform", "randint",
+    "uniform", "Result", "ResultGrid", "TrialStopped", "TuneConfig",
+    "Tuner", "report",
+]

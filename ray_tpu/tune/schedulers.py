@@ -1,0 +1,82 @@
+"""Trial schedulers: FIFO and ASHA early stopping.
+
+Reference: python/ray/tune/schedulers/trial_scheduler.py (decision enum),
+schedulers/async_hyperband.py (AsyncHyperBandScheduler._Bracket: rungs at
+grace*eta^k; a trial reaching a rung below the top-1/eta quantile of that
+rung's recorded results is stopped)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    """No early stopping — every trial runs to completion."""
+
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str, metrics: dict) -> None:
+        pass
+
+
+class _Rung:
+    def __init__(self, milestone: float):
+        self.milestone = milestone
+        self.recorded: Dict[str, float] = {}
+
+    def cutoff(self, frac: float, mode: str) -> Optional[float]:
+        if not self.recorded:
+            return None
+        import numpy as np
+        vals = list(self.recorded.values())
+        q = (1 - frac) * 100 if mode == "max" else frac * 100
+        return float(np.percentile(vals, q))
+
+
+class ASHAScheduler:
+    """Asynchronous successive halving (reference:
+    schedulers/async_hyperband.py AsyncHyperBandScheduler)."""
+
+    def __init__(self, *, metric: Optional[str] = None, mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 max_t: float = 100, grace_period: float = 1,
+                 reduction_factor: float = 4):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.rungs: List[_Rung] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(_Rung(t))
+            t *= reduction_factor
+        self.rungs.reverse()  # highest milestone first
+
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        t = metrics.get(self.time_attr)
+        val = metrics.get(self.metric)
+        if t is None or val is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP  # budget exhausted: finished, not culled
+        decision = CONTINUE
+        for rung in self.rungs:
+            if t < rung.milestone or trial_id in rung.recorded:
+                continue
+            cut = rung.cutoff(1.0 / self.rf, self.mode)
+            rung.recorded[trial_id] = float(val)
+            if cut is not None:
+                bad = (val < cut) if self.mode == "max" else (val > cut)
+                if bad:
+                    decision = STOP
+            break  # only the highest applicable rung records
+        return decision
+
+    def on_trial_complete(self, trial_id: str, metrics: dict) -> None:
+        pass

@@ -1,0 +1,91 @@
+"""Search spaces + variant generation.
+
+Reference: python/ray/tune/search/sample.py (Domain/Float/Integer/
+Categorical), search/basic_variant.py (BasicVariantGenerator: grid
+cross-product x num_samples random draws)."""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class Domain:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lo: float, hi: float, log: bool = False):
+        self.lo, self.hi, self.log = lo, hi, log
+
+    def sample(self, rng):
+        if self.log:
+            import math
+            return math.exp(rng.uniform(math.log(self.lo),
+                                        math.log(self.hi)))
+        return rng.uniform(self.lo, self.hi)
+
+
+class Integer(Domain):
+    def __init__(self, lo: int, hi: int):
+        self.lo, self.hi = lo, hi
+
+    def sample(self, rng):
+        return rng.randrange(self.lo, self.hi)
+
+
+class Categorical(Domain):
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return rng.choice(self.values)
+
+
+def uniform(lo: float, hi: float) -> Float:
+    return Float(lo, hi)
+
+
+def loguniform(lo: float, hi: float) -> Float:
+    return Float(lo, hi, log=True)
+
+
+def randint(lo: int, hi: int) -> Integer:
+    """Inclusive lo, exclusive hi (reference: tune.randint)."""
+    return Integer(lo, hi)
+
+
+def choice(values: Sequence[Any]) -> Categorical:
+    return Categorical(values)
+
+
+def grid_search(values: Sequence[Any]) -> Dict[str, List[Any]]:
+    """Marker consumed by the variant generator: the cross product of all
+    grid dimensions is exhausted (x num_samples)."""
+    return {"grid_search": list(values)}
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int,
+                      seed: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Expand grid dimensions to their cross product; draw every sampled
+    Domain independently per variant (reference: basic_variant.py)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items()
+                 if isinstance(v, dict) and "grid_search" in v]
+    grid_values = [param_space[k]["grid_search"] for k in grid_keys]
+    combos = list(itertools.product(*grid_values)) if grid_keys else [()]
+    out: List[Dict[str, Any]] = []
+    for _ in range(num_samples):
+        for combo in combos:
+            cfg = {}
+            for k, v in param_space.items():
+                if k in grid_keys:
+                    cfg[k] = combo[grid_keys.index(k)]
+                elif isinstance(v, Domain):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            out.append(cfg)
+    return out

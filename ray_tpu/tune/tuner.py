@@ -1,0 +1,272 @@
+"""Tuner: concurrent trials as actors + report plumbing.
+
+Reference: python/ray/tune/tuner.py:43 (Tuner.fit), tune/execution/
+tune_controller.py:65 (trial lifecycle loop), tune/trainable/ (report
+path). Each trial runs the user trainable inside a dedicated actor; the
+driver-side controller polls trial reports, feeds the scheduler, and
+stops losers early."""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.tune.schedulers import STOP, FIFOScheduler
+from ray_tpu.tune.search import generate_variants
+
+
+class TrialStopped(Exception):
+    """Raised inside a trainable when the scheduler stops the trial."""
+
+
+_trial_local = threading.local()
+
+
+def report(metrics: Optional[dict] = None, *, checkpoint: Optional[Any] = None,
+           **kw) -> None:
+    """Report metrics (and optionally a checkpoint) from inside a
+    trainable (reference: tune.report / train.report)."""
+    st = getattr(_trial_local, "state", None)
+    m = dict(metrics or {})
+    m.update(kw)
+    if st is None:
+        return  # running outside tune: no-op, keeps trainables testable
+    with st.lock:
+        st.iteration += 1
+        m.setdefault("training_iteration", st.iteration)
+        st.reports.append(m)
+        if checkpoint is not None:
+            st.checkpoint = checkpoint
+        stop = st.stop
+    if stop:
+        raise TrialStopped()
+
+
+class _TrialState:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.reports: List[dict] = []
+        self.iteration = 0
+        self.stop = False
+        self.checkpoint = None
+        self.status = "RUNNING"
+        self.error: Optional[str] = None
+        self.final_return = None
+
+
+class _TrialActor:
+    """Hosts one trial. run() executes the trainable on an executor
+    thread; poll()/request_stop() are async so they stay responsive on
+    the worker loop while the trainable runs (max_concurrency > 1)."""
+
+    def __init__(self):
+        self.state = _TrialState()
+
+    def run(self, fn: Callable[[dict], Any], config: dict):
+        _trial_local.state = self.state
+        st = self.state
+        try:
+            out = fn(config)
+            with st.lock:
+                st.final_return = out
+                st.status = "TERMINATED"
+        except TrialStopped:
+            with st.lock:
+                st.status = "STOPPED"
+        except BaseException:  # noqa: BLE001 — recorded, not raised
+            with st.lock:
+                st.error = traceback.format_exc()
+                st.status = "ERROR"
+        finally:
+            _trial_local.state = None
+        return True
+
+    async def poll(self, cursor: int) -> dict:
+        st = self.state
+        with st.lock:
+            return {"reports": list(st.reports[cursor:]),
+                    "cursor": len(st.reports),
+                    "status": st.status,
+                    "error": st.error}
+
+    async def request_stop(self) -> bool:
+        with self.state.lock:
+            self.state.stop = True
+        return True
+
+    async def get_final(self) -> dict:
+        st = self.state
+        with st.lock:
+            # Checkpoints/returns may hold arrays: ship via the object
+            # plane (the reply itself is an object already).
+            return {"checkpoint": st.checkpoint,
+                    "final_return": st.final_return,
+                    "last_report": st.reports[-1] if st.reports else {}}
+
+
+@dataclass
+class TuneConfig:
+    """Reference: tune/tune_config.py."""
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Any = None
+    seed: Optional[int] = None
+    resources_per_trial: Optional[Dict[str, float]] = None
+
+
+@dataclass
+class Result:
+    """Reference: air/result.py."""
+    config: dict
+    metrics: dict
+    error: Optional[str] = None
+    checkpoint: Any = None
+    all_reports: List[dict] = field(default_factory=list)
+    status: str = "TERMINATED"
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[Result]:
+        return [r for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self._results
+                  if not r.error and metric in r.metrics]
+        if not scored:
+            raise ValueError("no successful trial reported "
+                             f"metric {metric!r}")
+        keyfn = lambda r: r.metrics[metric]  # noqa: E731
+        return (max if mode == "max" else min)(scored, key=keyfn)
+
+    def get_dataframe(self):
+        import pandas as pd
+        rows = []
+        for r in self._results:
+            row = {f"config/{k}": v for k, v in r.config.items()}
+            row.update(r.metrics)
+            row["status"] = r.status
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+@dataclass
+class _Trial:
+    trial_id: str
+    config: dict
+    actor: Any = None
+    run_ref: Any = None
+    cursor: int = 0
+    reports: List[dict] = field(default_factory=list)
+    stop_requested: bool = False
+
+
+class Tuner:
+    """Reference: tune/tuner.py:43. ``Tuner(fn, param_space=...,
+    tune_config=TuneConfig(...)).fit()`` -> ResultGrid."""
+
+    def __init__(self, trainable: Callable[[dict], Any], *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None):
+        if not callable(trainable):
+            raise TypeError("trainable must be a callable(config)")
+        self._fn = trainable
+        self._space = dict(param_space or {})
+        self._cfg = tune_config or TuneConfig()
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+        cfg = self._cfg
+        scheduler = cfg.scheduler or FIFOScheduler()
+        if getattr(scheduler, "metric", None) is None and cfg.metric:
+            scheduler.metric = cfg.metric
+            scheduler.mode = cfg.mode
+        configs = generate_variants(self._space, cfg.num_samples, cfg.seed)
+        trials = [_Trial(uuid.uuid4().hex[:8], c) for c in configs]
+        limit = cfg.max_concurrent_trials or max(
+            1, int(ray_tpu.cluster_resources().get("CPU", 4)))
+        resources = cfg.resources_per_trial or {"CPU": 1.0}
+
+        actor_cls = ray_tpu.remote(_TrialActor).options(
+            max_concurrency=4, resources=resources)
+        pending = list(trials)
+        running: Dict[str, _Trial] = {}
+        results: Dict[str, Result] = {}
+
+        def finalize(t: _Trial, status: str, error: Optional[str] = None):
+            checkpoint = None
+            final_metrics = t.reports[-1] if t.reports else {}
+            try:
+                fin = ray_tpu.get(t.actor.get_final.remote(), timeout=30)
+                checkpoint = fin["checkpoint"]
+                if isinstance(fin.get("final_return"), dict):
+                    final_metrics = {**final_metrics,
+                                     **fin["final_return"]}
+            except Exception:
+                pass
+            results[t.trial_id] = Result(
+                config=t.config, metrics=final_metrics, error=error,
+                checkpoint=checkpoint, all_reports=list(t.reports),
+                status=status)
+            scheduler.on_trial_complete(t.trial_id, final_metrics)
+            try:
+                ray_tpu.kill(t.actor)
+            except Exception:
+                pass
+
+        while pending or running:
+            while pending and len(running) < limit:
+                t = pending.pop(0)
+                t.actor = actor_cls.remote()
+                t.run_ref = t.actor.run.remote(self._fn, t.config)
+                running[t.trial_id] = t
+            for t in list(running.values()):
+                try:
+                    r = ray_tpu.get(t.actor.poll.remote(t.cursor),
+                                    timeout=60)
+                except ray_tpu.RayTpuError as e:
+                    finalize(t, "ERROR", f"trial actor lost: {e}")
+                    running.pop(t.trial_id)
+                    continue
+                t.cursor = r["cursor"]
+                t.reports.extend(r["reports"])
+                for m in r["reports"]:
+                    if (not t.stop_requested
+                            and scheduler.on_result(
+                                t.trial_id, m) == STOP):
+                        t.stop_requested = True
+                        t.actor.request_stop.remote()
+                if r["status"] != "RUNNING":
+                    status = ("TERMINATED" if r["status"] == "TERMINATED"
+                              else r["status"])
+                    finalize(t, status, r["error"])
+                    running.pop(t.trial_id)
+            if running:
+                time.sleep(0.05)
+        ordered = [results[t.trial_id] for t in trials
+                   if t.trial_id in results]
+        return ResultGrid(ordered, cfg.metric, cfg.mode)

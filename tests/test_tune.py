@@ -1,0 +1,125 @@
+"""Tune: variant generation, concurrent trials, ASHA early stopping.
+
+Reference test shape: python/ray/tune/tests/test_tune_* on a local
+cluster."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    from ray_tpu.config import Config
+    cfg = Config.from_env(num_workers_prestart=2, max_workers_per_node=8)
+    ray_tpu.init(num_cpus=8, config=cfg)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_generate_variants_grid_and_sample():
+    from ray_tpu.tune.search import generate_variants
+    space = {"lr": tune.grid_search([0.1, 0.01]),
+             "wd": tune.uniform(0, 1),
+             "layers": tune.choice([2, 4]),
+             "fixed": 7}
+    variants = generate_variants(space, num_samples=3, seed=0)
+    assert len(variants) == 6  # 2 grid x 3 samples
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    assert all(0 <= v["wd"] <= 1 and v["fixed"] == 7 for v in variants)
+
+
+def test_tuner_fit_returns_best(runtime):
+    def trainable(config):
+        # Quadratic bowl: best near x=3.
+        loss = (config["x"] - 3.0) ** 2
+        tune.report({"loss": loss})
+        return {"final_loss": loss}
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0.0, 1.0, 3.0, 5.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    num_samples=1))
+    results = tuner.fit()
+    assert len(results) == 4
+    best = results.get_best_result()
+    assert best.config["x"] == 3.0
+    assert best.metrics["loss"] == 0.0
+    assert best.metrics["final_loss"] == 0.0
+
+
+def test_tuner_reports_and_checkpoint(runtime):
+    def trainable(config):
+        for step in range(5):
+            tune.report({"score": step * config["m"]},
+                        checkpoint={"step": step, "m": config["m"]})
+
+    tuner = tune.Tuner(
+        trainable, param_space={"m": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"))
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert best.config["m"] == 2
+    assert best.metrics["score"] == 8
+    assert best.checkpoint["step"] == 4
+    assert len(best.all_reports) == 5
+
+
+def test_tuner_trial_error_isolated(runtime):
+    def trainable(config):
+        if config["x"] == 1:
+            raise RuntimeError("boom")
+        tune.report({"loss": config["x"]})
+
+    tuner = tune.Tuner(
+        trainable, param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"))
+    results = tuner.fit()
+    assert len(results.errors) == 1
+    assert "boom" in results.errors[0].error
+    assert results.get_best_result().config["x"] == 0
+
+
+def test_asha_stops_losers(runtime):
+    def trainable(config):
+        import time as _t
+        for it in range(1, 33):
+            # Good trials improve; bad trials stagnate high. Paced so the
+            # controller can observe reports and stop mid-run.
+            loss = 100.0 if config["bad"] else 100.0 / it
+            tune.report({"loss": loss})
+            _t.sleep(0.05)
+
+    # Good trials run in the first wave so rung cutoffs exist before the
+    # stagnating trials reach them.
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"bad": tune.grid_search(
+            [False, False, False, True, True, True])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=1,
+            max_concurrent_trials=3,
+            scheduler=tune.ASHAScheduler(
+                metric="loss", mode="min", grace_period=2,
+                reduction_factor=2, max_t=32)))
+    results = tuner.fit()
+    assert len(results) == 6
+    best = results.get_best_result()
+    assert best.config["bad"] is False
+    stopped = [r for r in results if r.status == "STOPPED"]
+    finished_iters = {r.config["bad"]: len(r.all_reports) for r in results}
+    # At least one stagnating trial must have been culled early.
+    assert stopped, f"ASHA culled nothing: {finished_iters}"
+    assert all(r.config["bad"] for r in stopped)
+
+
+def test_asha_rung_math():
+    s = tune.ASHAScheduler(metric="m", mode="max", grace_period=1,
+                           reduction_factor=2, max_t=8)
+    # Trial A leads at every rung; trial B trails badly.
+    assert s.on_result("A", {"training_iteration": 1, "m": 10}) == "CONTINUE"
+    assert s.on_result("A", {"training_iteration": 2, "m": 20}) == "CONTINUE"
+    assert s.on_result("B", {"training_iteration": 1, "m": 1}) == "STOP"
