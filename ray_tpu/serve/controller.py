@@ -144,10 +144,12 @@ class ServeController:
         deadline = time.monotonic() + timeout
         names = self.apps.get(app_name, [])
         while time.monotonic() < deadline:
+            # Every deployment must reach its full target before run()
+            # returns — returning at the first replica lets callers cache
+            # a partial routing table and pile onto one replica.
             ready = all(
-                len(self.deployments[n].running()) >= 1
-                and len(self.deployments[n].running()) >=
-                min(self.deployments[n].target, 1)
+                len(self.deployments[n].running()) >=
+                max(self.deployments[n].target, 1)
                 for n in names if n in self.deployments)
             if names and ready:
                 return {"ok": True}

@@ -150,10 +150,9 @@ class HTTPProxy:
         if isinstance(payload, (bytes, bytearray)):
             body = bytes(payload)
             ctype = "application/octet-stream"
-        elif isinstance(payload, str):
-            body = payload.encode()
-            ctype = "text/plain; charset=utf-8"
         else:
+            # JSON-in/JSON-out surface: strings too ride as JSON so
+            # clients can round-trip any handler return value.
             body = json.dumps(payload).encode()
             ctype = "application/json"
         head = (f"HTTP/1.1 {code} {reason.get(code, 'OK')}\r\n"
