@@ -16,6 +16,9 @@ _API = (
     "available_resources", "timeline", "ObjectRef", "ActorHandle",
     "free", "get_async", "placement_group", "remove_placement_group",
     "PlacementGroup",
+    # exceptions (the reference exports these at top level too)
+    "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
+    "WorkerCrashedError", "ObjectLostError", "GetTimeoutError",
 )
 
 

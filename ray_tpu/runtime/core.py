@@ -37,6 +37,7 @@ ACTOR_BATCH_MAX = 64        # calls coalesced into one actor RPC
 ACTOR_MAX_INFLIGHT_BATCHES = 8  # pipelined un-acked batches per actor
 TASK_BATCH_MAX = 32         # tasks coalesced into one worker RPC
 MAX_TASK_PUMPS = 32         # concurrent batch senders per resource shape
+LINEAGE_MAX_BYTES = 256 * 1024 * 1024  # owner-side recoverability budget
 
 
 # --- public value types -----------------------------------------------------
@@ -149,9 +150,38 @@ class MemoryStore:
     def delete(self, oid: ObjectID):
         self._entries.pop(oid, None)
 
+    def reset_pending(self, oid: ObjectID) -> _Entry:
+        """Back to PENDING in place — parked waiters keep their event and
+        wake on the next resolve (used by object recovery)."""
+        e = self._entries.get(oid)
+        if e is None:
+            return self.create_pending(oid)
+        e.status = PENDING
+        e.frame = e.error_frame = None
+        e.shm_size = 0
+        e.event.clear()
+        return e
+
     def __contains__(self, oid: ObjectID):
         e = self._entries.get(oid)
         return e is not None and e.status != PENDING
+
+
+def _scan_ref_deps(args, kwargs) -> List["ObjectRef"]:
+    """Top-level ObjectRef args a task must wait on before leasing."""
+    deps = [a for a in args if isinstance(a, ObjectRef)]
+    deps += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+    return deps
+
+
+def _lease_err_transient(e: BaseException) -> bool:
+    """Scheduling errors that resolve themselves as the cluster churns
+    (saturation, worker spawn lag, agent restart) vs. ones every retry
+    would hit identically (infeasible shape, refusal, hop limit)."""
+    if isinstance(e, rpc.RpcError):
+        return True
+    msg = str(e)
+    return "lease timeout" in msg or "no worker available" in msg
 
 
 @dataclass
@@ -295,21 +325,23 @@ class LeasePool:
                 if not self._hand_slot(sp, lw):
                     break
         except Exception as e:  # noqa: BLE001 — propagate to parked waiters
-            if "infeasible" in str(e):
-                # Cluster-wide terminal (the agent already grace-polled
-                # for joining nodes): every waiter would fail the same way.
-                while sp.waiters:
-                    fut = sp.waiters.popleft()
-                    if not fut.done():
-                        fut.set_exception(e)
+            if _lease_err_transient(e):
+                # Transient (lease timeout / no worker yet / agent
+                # hiccup): queued tasks wait for resources indefinitely —
+                # matching the reference, where a pending lease request
+                # never turns into a task failure (raylet keeps it
+                # queued). Pause so a saturated agent isn't hammered,
+                # then the finally block re-requests for the remaining
+                # waiters.
+                await asyncio.sleep(1.0)
             else:
-                # Transient (timeout / no worker): fail only one waiter —
-                # other in-flight requests may be about to succeed.
+                # Terminal for this shape (infeasible / lease refused /
+                # spillback hop limit): every waiter would fail the same
+                # way — surface instead of looping forever.
                 while sp.waiters:
                     fut = sp.waiters.popleft()
                     if not fut.done():
                         fut.set_exception(e)
-                        break
         finally:
             sp.pending_leases -= 1
             if sp.waiters:
@@ -415,6 +447,7 @@ class CoreContext:
             retry_backoff_s=self.config.rpc_retry_backoff_s)
         self.server = rpc.RpcServer({
             "fetch_object": self._handle_fetch_object,
+            "reconstruct_object": self._handle_reconstruct_object,
             "ping": self._handle_ping,
         })
         self.addr: Optional[Tuple[str, int]] = None
@@ -427,6 +460,11 @@ class CoreContext:
         self._actor_pump_live: Dict[ActorID, bool] = {}
         self._actor_inflight: Dict[ActorID, set] = {}
         self._actor_mc: Dict[ActorID, int] = {}
+        from collections import OrderedDict
+        self._lineage: "OrderedDict[ObjectID, tuple]" = OrderedDict()
+        self._lineage_task_bytes: Dict[tuple, int] = {}
+        self._lineage_bytes = 0
+        self._recovering: Dict[ObjectID, asyncio.Future] = {}
         self._task_queues: Dict[tuple, dict] = {}
 
     async def start(self, host: str = "127.0.0.1"):
@@ -500,8 +538,14 @@ class CoreContext:
         if single:
             refs = [refs]
         try:
-            values = await asyncio.gather(
+            # The outer wait_for bounds the WHOLE path — resolve, pull,
+            # and any lineage recovery — by the caller's budget.
+            coro = asyncio.gather(
                 *[self._get_one(r, timeout) for r in refs])
+            if timeout is not None:
+                values = await asyncio.wait_for(coro, timeout)
+            else:
+                values = await coro
         except asyncio.TimeoutError:
             raise GetTimeoutError(f"get() timed out after {timeout}s")
         return values[0] if single else values
@@ -524,7 +568,7 @@ class CoreContext:
         if kind == "error":
             raise self._loads_error(r["frame"])
         if kind == "shm":
-            return await self._read_shm(ref.oid)
+            return await self._read_shm(ref.oid, ref.owner_addr)
         if kind == "timeout":
             raise GetTimeoutError(f"object {ref.oid} not ready")
         raise ObjectLostError(f"{ref.oid}: owner replied {r}")
@@ -538,7 +582,7 @@ class CoreContext:
         if e.status == ERROR:
             raise self._loads_error(e.error_frame)
         if e.status == IN_SHM:
-            return await self._read_shm(ref.oid)
+            return await self._read_shm(ref.oid, ref.owner_addr)
         raise ObjectLostError(f"{ref.oid} in unexpected state {e.status}")
 
     def _loads_value(self, frame: bytes):
@@ -550,19 +594,140 @@ class CoreContext:
             return payload
         return TaskError(str(payload))
 
-    async def _read_shm(self, oid: ObjectID):
-        r = await self.pool.call(self.agent_addr, "resolve_object", oid=oid,
-                                 timeout=120.0)
-        seg = r.get("segname")
-        if seg is None:
-            raise ObjectLostError(f"{oid} not found in any object store")
-        # Read-only view: deserialized numpy arrays alias the node-wide
-        # object store; a writable view would let any consumer silently
-        # corrupt the sealed object for every other reader (the reference
-        # makes plasma buffers read-only for the same reason).
-        mv = self.shm_reader.read(
-            seg, r["size"], r.get("offset", 0)).toreadonly()
-        return loads_oob(mv)
+    async def _read_shm(self, oid: ObjectID, owner_addr=None):
+        for _attempt in range(3):
+            r = await self.pool.call(self.agent_addr, "resolve_object",
+                                     oid=oid, timeout=120.0)
+            seg = r.get("segname")
+            if seg is not None:
+                # Read-only view: deserialized numpy arrays alias the
+                # node-wide object store; a writable view would let any
+                # consumer silently corrupt the sealed object for every
+                # other reader (the reference makes plasma buffers
+                # read-only for the same reason).
+                mv = self.shm_reader.read(
+                    seg, r["size"], r.get("offset", 0)).toreadonly()
+                return loads_oob(mv)
+            # Lost (producing node died): recover via lineage — owner
+            # re-executes the producing task (reference:
+            # object_recovery_manager.h:41); borrowers ask the owner.
+            if oid in self._lineage:
+                await self._recover_object(oid)
+                # Re-execution may have resolved inline, or with the
+                # task's real error — surface those instead of looping
+                # (and re-running a deterministically failing task).
+                e = self.store.get_entry(oid)
+                if e is not None and e.status == READY:
+                    return self._loads_value(e.frame)
+                if e is not None and e.status == ERROR:
+                    raise self._loads_error(e.error_frame)
+                continue
+            if owner_addr is not None and tuple(owner_addr) != self.addr:
+                try:
+                    rr = await self.pool.call(
+                        tuple(owner_addr), "reconstruct_object",
+                        oid=oid, timeout=300.0)
+                except rpc.RpcError:
+                    break
+                if rr.get("ok"):
+                    kind = rr.get("kind")
+                    if kind == "ready":
+                        return self._loads_value(rr["frame"])
+                    if kind == "error":
+                        raise self._loads_error(rr["frame"])
+                    continue
+            break
+        raise ObjectLostError(f"{oid} not found in any object store")
+
+    async def _recover_object(self, oid: ObjectID):
+        """Re-execute the producing task (deduped across concurrent
+        readers) and wait until the owner-side entry resolves again."""
+        fut = self._recovering.get(oid)
+        if fut is None:
+            fut = asyncio.get_running_loop().create_future()
+            self._recovering[oid] = fut
+            asyncio.ensure_future(self._drive_recovery(oid, fut))
+        await asyncio.shield(fut)
+
+    async def _drive_recovery(self, oid: ObjectID, fut: asyncio.Future):
+        try:
+            key, s = self._lineage[oid]
+            for o in s.oids:
+                e = self.store.get_entry(o)
+                # Only shm-resident outputs lost their backing store;
+                # inline siblings stay final — resetting them would break
+                # try_get_local's lock-free monotonic-state fast path.
+                if e is None or e.status in (PENDING, IN_SHM):
+                    self.store.reset_pending(o)
+            spec = _TaskSpec(TaskID.generate(), s.digest, s.args_frame,
+                             s.oids, s.retries)
+            # Same dependency gating as the submission path: re-executed
+            # tasks must not take a lease while blocked on arg refs.
+            try:
+                args, kwargs = loads_oob(s.args_frame)
+                deps = _scan_ref_deps(args, kwargs)
+            except Exception:
+                deps = []
+            if deps:
+                await self._enqueue_after_deps(key, spec, deps)
+            else:
+                self._enqueue_task(key, spec)
+            await self.store.wait_ready(oid, 300.0)
+            fut.set_result(True)
+        except BaseException as e:  # noqa: BLE001 — surface to readers
+            if not fut.done():
+                fut.set_exception(
+                    ObjectLostError(f"recovery of {oid} failed: {e}"))
+        finally:
+            self._recovering.pop(oid, None)
+
+    def _register_lineage(self, key: tuple, s: "_TaskSpec"):
+        """Byte accounting is keyed by the task's oid tuple — stable
+        across recoveries (which re-execute under a fresh spec object
+        but the same return oids) — so re-registration never
+        double-counts."""
+        tkey = tuple(s.oids)
+        if tkey not in self._lineage_task_bytes:
+            self._lineage_task_bytes[tkey] = len(s.args_frame)
+            self._lineage_bytes += len(s.args_frame)
+        for oid in s.oids:
+            self._lineage[oid] = (key, s)
+        self._evict_lineage()
+
+    def _drop_lineage(self, oid: ObjectID):
+        """Per-oid: freeing one return ref must not destroy
+        recoverability of still-live sibling refs; the task's bytes are
+        released when its last oid goes."""
+        ent = self._lineage.pop(oid, None)
+        if ent is None:
+            return
+        _key, s = ent
+        if not any(o in self._lineage for o in s.oids):
+            self._lineage_bytes -= self._lineage_task_bytes.pop(
+                tuple(s.oids), 0)
+
+    def _evict_lineage(self):
+        """Bound owner-side lineage memory (the reference bounds lineage
+        by bytes too, task_manager.h max_lineage_bytes); evicted objects
+        simply lose recoverability."""
+        while self._lineage_bytes > LINEAGE_MAX_BYTES and self._lineage:
+            self._drop_lineage(next(iter(self._lineage)))
+
+    async def _handle_reconstruct_object(self, oid: ObjectID):
+        if oid not in self._lineage:
+            return {"ok": False, "error": "no lineage for object"}
+        try:
+            await self._recover_object(oid)
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "error": str(e)}
+        # Tell the borrower how the re-execution resolved so it can
+        # surface an inline value / the task's real error directly.
+        e = self.store.get_entry(oid)
+        if e is not None and e.status == READY:
+            return {"ok": True, "kind": "ready", "frame": e.frame}
+        if e is not None and e.status == ERROR:
+            return {"ok": True, "kind": "error", "frame": e.error_frame}
+        return {"ok": True, "kind": "shm"}
 
     async def _handle_fetch_object(self, oid: ObjectID,
                                    wait_timeout: Optional[float] = None):
@@ -671,8 +836,7 @@ class CoreContext:
         # raylet/dependency_manager.h). Otherwise a task blocking on its
         # args inside a worker pins the lease its producer needs —
         # deadlock under load.
-        deps = [a for a in args if isinstance(a, ObjectRef)]
-        deps += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+        deps = _scan_ref_deps(args, kwargs)
         if deps:
             self.loop.call_soon_threadsafe(
                 self._spawn, self._enqueue_after_deps(key, spec, deps))
@@ -742,6 +906,13 @@ class CoreContext:
                         lw = await self.leases.acquire(
                             resources, pg, policy)
                     except Exception as e:  # noqa: BLE001
+                        if _lease_err_transient(e):
+                            # Same wait-indefinitely semantics as the
+                            # pooled path: spread tasks queue through
+                            # saturation rather than fail.
+                            q.append(spec)
+                            await asyncio.sleep(1.0)
+                            continue
                         self._fail_all(spec.oids, e if isinstance(
                             e, RayTpuError) else WorkerCrashedError(
                             f"lease failed: {e}"))
@@ -755,15 +926,14 @@ class CoreContext:
                 try:
                     lw = await self.leases.acquire(resources, pg, policy)
                 except Exception as e:  # noqa: BLE001 — scheduling failure
+                    # The lease pool absorbs transient errors internally
+                    # (waiting tasks stay queued); anything surfacing
+                    # here is terminal for the whole shape.
                     err = (e if isinstance(e, RayTpuError)
                            else WorkerCrashedError(f"lease failed: {e}"))
-                    if "infeasible" in str(e):  # terminal for the shape
-                        while q:
-                            self._fail_all(q.popleft().oids, err)
-                        return
-                    if q:  # transient: fail one task, keep pumping
+                    while q:
                         self._fail_all(q.popleft().oids, err)
-                    continue
+                    return
                 if not q:
                     await self.leases.release_slot(lw)
                     return
@@ -828,6 +998,15 @@ class CoreContext:
                 redo.append(s)
             else:
                 self._apply_result(s.oids, res)
+                # Lineage: shm-resident results can be regenerated by
+                # re-executing the producing task if their node dies
+                # (reference: object_recovery_manager.h:41 +
+                # task_manager lineage pinning). Only tasks with a retry
+                # budget are recoverable, matching max_retries semantics.
+                if s.retries > 0 and any(
+                        rr.get("kind") == "shm"
+                        for rr in res.get("results", [])):
+                    self._register_lineage(key, s)
         if redo:
             # Worker restarted behind a reused address: re-ship payloads.
             await self._send_task_batch(key, st, lw, redo,
@@ -1059,6 +1238,7 @@ class CoreContext:
         oids = [r.oid for r in refs]
         for oid in oids:
             self.store.delete(oid)
+            self._drop_lineage(oid)
         try:
             await self.pool.call(self.agent_addr, "free_objects", oids=oids)
         except Exception:

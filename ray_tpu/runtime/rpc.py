@@ -139,7 +139,9 @@ class RpcServer:
         if self._server is not None:
             self._server.close()
             try:
-                await self._server.wait_closed()
+                # 3.12's wait_closed also waits for in-flight handlers
+                # (which may be parked in long polls) — bound it.
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
             except Exception:
                 pass
         for w in list(self._conns):
