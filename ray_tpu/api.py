@@ -87,6 +87,38 @@ def is_initialized() -> bool:
     return _g.initialized
 
 
+def _norm_addr(address: str) -> tuple:
+    """(resolved-ip, port) so 'localhost:6379' == '127.0.0.1:6379'."""
+    import socket
+    host, port = address.rsplit(":", 1)
+    try:
+        host = socket.gethostbyname(host)
+    except OSError:
+        pass
+    return (host, port)
+
+
+def _local_cli_node(address: str) -> Optional[dict]:
+    """Info for a `ray-tpu start`ed node on this host joined to the
+    cluster at `address`, or None. The session dir is this host's record
+    of its own node processes, so a hit proves same-machine shm access."""
+    import json
+
+    from ray_tpu.scripts import _node_files
+    target = _norm_addr(address)
+    for f in reversed(_node_files()):
+        try:
+            with open(f) as fh:
+                info = json.load(fh)
+            if (_norm_addr(info.get("address", "")) == target
+                    and "agent_addr" in info):
+                os.kill(info["pid"], 0)  # still running?
+                return info
+        except (OSError, ValueError, KeyError):
+            continue
+    return None
+
+
 def init(address: Optional[str] = None, *,
          num_cpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
@@ -137,6 +169,42 @@ def init(address: Optional[str] = None, *,
             if existing:
                 sid = existing.decode()
             await pool.close()
+            # A `ray-tpu start`ed node on THIS host serving this cluster?
+            # Attach to its agent (the reference driver attaches to the
+            # local raylet) instead of booting a second agent that would
+            # double-count the machine's resources. Only when the caller
+            # didn't ask for specific node resources — those need an
+            # agent of our own to advertise them.
+            local = None
+            if num_cpus is None and not resources and not labels:
+                local = _local_cli_node(address)
+            if local is not None:
+                try:
+                    pool = rpc.ConnectionPool()
+                    try:
+                        host, port = local["agent_addr"].rsplit(":", 1)
+                        agent_addr = (host, int(port))
+                        await pool.call(agent_addr, "ping",
+                                        timeout=cfg.rpc_connect_timeout_s)
+                    finally:
+                        await pool.close()
+                    from ray_tpu.runtime.ids import NodeID
+                    ctx = CoreContext(
+                        head_addr, agent_addr,
+                        NodeID(bytes.fromhex(local["node_id"])),
+                        sid, config=cfg, is_driver=True)
+                    await ctx.start()
+                    job_id = JobID.generate()
+                    await ctx.pool.call(head_addr, "register_job",
+                                        job_id=job_id,
+                                        metadata={"driver_pid": os.getpid()})
+                    _g.job_id = job_id
+                    return ctx
+                except Exception:
+                    # Stale session record (killed node, recycled pid):
+                    # fall through to booting our own agent, the
+                    # pre-attach behavior.
+                    pass
         agent = NodeAgent(head_addr, resources=res, labels=labels,
                           config=cfg, session_id=sid,
                           env_extra={"PYTHONPATH": _driver_pythonpath()})
@@ -157,7 +225,7 @@ def init(address: Optional[str] = None, *,
     _g.ctx = _g.elt.run(_boot(), timeout=120)
     atexit.register(shutdown)
     return {"address": f"{_g.ctx.head_addr[0]}:{_g.ctx.head_addr[1]}",
-            "session_id": session_id, "node_id": _g.ctx.node_id}
+            "session_id": _g.ctx.session_id, "node_id": _g.ctx.node_id}
 
 
 def _attach_existing(ctx: CoreContext) -> None:
@@ -374,6 +442,14 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         ctx = _require_init()
         opts = self._opts
+        retries = opts.get("max_task_retries", 0)
+        if opts.get("get_if_exists") and opts.get("name"):
+            try:
+                h = get_actor(opts["name"], opts.get("namespace"))
+                h._max_task_retries = retries
+                return h
+            except ValueError:
+                pass  # not there yet — create it below
         resources = dict(opts.get("resources") or {})
         if opts.get("num_cpus") is not None:
             resources["CPU"] = float(opts["num_cpus"])
@@ -384,18 +460,27 @@ class ActorClass:
         scheduling = {}
         if opts.get("labels"):
             scheduling["labels"] = opts["labels"]
-        actor_id = _run(ctx.create_actor(
-            self._cls, args, kwargs,
-            name=opts.get("name"),
-            namespace=opts.get("namespace", _g.namespace),
-            resources=resources,
-            max_restarts=opts.get("max_restarts", 0),
-            max_concurrency=opts.get("max_concurrency", 1),
-            pg=_pg_tuple(opts),
-            scheduling=scheduling or None,
-            lifetime=opts.get("lifetime")))
-        return ActorHandle(actor_id,
-                           max_task_retries=opts.get("max_task_retries", 0))
+        try:
+            actor_id = _run(ctx.create_actor(
+                self._cls, args, kwargs,
+                name=opts.get("name"),
+                namespace=opts.get("namespace", _g.namespace),
+                resources=resources,
+                max_restarts=opts.get("max_restarts", 0),
+                max_concurrency=opts.get("max_concurrency", 1),
+                pg=_pg_tuple(opts),
+                scheduling=scheduling or None,
+                lifetime=opts.get("lifetime")))
+        except Exception as e:
+            # get_if_exists race: another creator won between our lookup
+            # miss and this create — adopt theirs.
+            if (opts.get("get_if_exists") and opts.get("name")
+                    and "taken" in str(e)):
+                h = get_actor(opts["name"], opts.get("namespace"))
+                h._max_task_retries = retries
+                return h
+            raise
+        return ActorHandle(actor_id, max_task_retries=retries)
 
     def __call__(self, *a, **kw):
         raise TypeError(

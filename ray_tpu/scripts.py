@@ -1,0 +1,220 @@
+"""``ray-tpu`` command line: start/stop nodes, inspect a live cluster.
+
+The deployment analog of the reference's CLI (reference:
+python/ray/scripts/scripts.py `ray start/stop/status`, and
+python/ray/util/state/state_cli.py for `list`): `start` daemonizes a
+`ray_tpu.node` process and records it in a per-host session dir;
+`stop` signals every recorded process; `status`/`list` are thin views
+over the control service's existing RPCs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Optional
+
+def session_dir() -> str:
+    return (os.environ.get("RAY_TPU_SESSION_DIR")
+            or os.path.join(tempfile.gettempdir(), "ray_tpu_sessions"))
+
+
+def _call_head(address: str, method: str, timeout: float = 10.0, **kw):
+    """One-shot RPC from a short-lived CLI process."""
+    import asyncio
+
+    from ray_tpu.runtime import rpc
+
+    async def go():
+        pool = rpc.ConnectionPool()
+        try:
+            host, port = address.rsplit(":", 1)
+            return await pool.call((host, int(port)), method,
+                                   timeout=timeout, **kw)
+        finally:
+            await pool.close()
+
+    return asyncio.run(go())
+
+
+def _node_files():
+    sd = session_dir()
+    if not os.path.isdir(sd):
+        return []
+    return sorted(os.path.join(sd, f)
+                  for f in os.listdir(sd) if f.endswith(".json"))
+
+
+def cmd_start(args) -> int:
+    sd = session_dir()
+    os.makedirs(sd, exist_ok=True)
+    info_file = os.path.join(
+        sd, f"node-{int(time.time()*1000)}-{os.getpid()}.json")
+    cmd = [sys.executable, "-m", "ray_tpu.node", "--info-file", info_file]
+    if args.head:
+        cmd += ["--head", "--host", args.host, "--port", str(args.port)]
+    else:
+        cmd += ["--address", args.address]
+    if args.node_host:
+        cmd += ["--node-host", args.node_host]
+    if args.num_cpus is not None:
+        cmd += ["--num-cpus", str(args.num_cpus)]
+    if args.resources:
+        cmd += ["--resources", args.resources]
+    if args.labels:
+        cmd += ["--labels", args.labels]
+    if args.system_config:
+        cmd += ["--system-config", args.system_config]
+
+    if args.block:
+        return subprocess.call(cmd)
+
+    log = open(os.path.join(
+        sd, os.path.basename(info_file)[:-5] + ".log"), "ab")
+    proc = subprocess.Popen(cmd, stdout=log, stderr=log,
+                            start_new_session=True)
+    deadline = time.time() + args.start_timeout
+    while time.time() < deadline:
+        if os.path.exists(info_file):
+            with open(info_file) as f:
+                info = json.load(f)
+            print(f"node up: address={info['address']} "
+                  f"node_id={info['node_id']} pid={info['pid']}")
+            if args.head:
+                print("connect other nodes with:\n  "
+                      f"ray-tpu start --address={info['address']}\n"
+                      "or from Python:\n  "
+                      f"ray_tpu.init(address=\"{info['address']}\")")
+            return 0
+        if proc.poll() is not None:
+            print(f"node process exited rc={proc.returncode}; see "
+                  f"{log.name}", file=sys.stderr)
+            return 1
+        time.sleep(0.1)
+    print("timed out waiting for node to come up", file=sys.stderr)
+    proc.terminate()
+    return 1
+
+
+def cmd_stop(args) -> int:
+    n = 0
+    for f in _node_files():
+        try:
+            with open(f) as fh:
+                info = json.load(fh)
+            os.kill(info["pid"], signal.SIGTERM)
+            n += 1
+        except (OSError, ValueError, KeyError):
+            pass
+        if not args.keep_files:
+            try:
+                os.unlink(f)
+            except OSError:
+                pass
+    print(f"signalled {n} node process(es)")
+    return 0
+
+
+def _default_address() -> Optional[str]:
+    for f in reversed(_node_files()):
+        try:
+            with open(f) as fh:
+                return json.load(fh)["address"]
+        except (OSError, ValueError, KeyError):
+            continue
+    return None
+
+
+def _resolve_address(args) -> str:
+    addr = args.address or os.environ.get(
+        "RAY_TPU_ADDRESS") or _default_address()
+    if not addr:
+        print("no --address given and no local session found",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return addr
+
+
+def cmd_status(args) -> int:
+    addr = _resolve_address(args)
+    nodes = _call_head(addr, "get_nodes")
+    alive = [n for n in nodes if n.get("alive")]
+    print(f"cluster at {addr}: {len(alive)}/{len(nodes)} nodes alive")
+    totals, avail = {}, {}
+    for n in alive:
+        for k, v in (n.get("resources_total") or {}).items():
+            totals[k] = totals.get(k, 0) + v
+        for k, v in (n.get("resources_available") or {}).items():
+            avail[k] = avail.get(k, 0) + v
+    for k in sorted(totals):
+        print(f"  {k}: {avail.get(k, 0):g}/{totals[k]:g} available")
+    return 0
+
+
+def cmd_list(args) -> int:
+    addr = _resolve_address(args)
+    method = {"nodes": "get_nodes", "actors": "list_actors",
+              "jobs": "list_jobs", "pgs": "list_pgs"}[args.what]
+    rows = _call_head(addr, method)
+    if args.json:
+        print(json.dumps(rows, default=str, indent=2))
+        return 0
+    for r in rows:
+        if args.what == "nodes":
+            print(f"{r['node_id']}  alive={r['alive']}  addr={r['addr']}  "
+                  f"resources={r.get('resources_total')}")
+        elif args.what == "actors":
+            print(f"{r.get('actor_id')}  state={r.get('state')}  "
+                  f"name={r.get('name') or '-'}  node={r.get('node_id')}")
+        else:
+            print(json.dumps(r, default=str))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("start", help="start a head or worker node")
+    ps.add_argument("--head", action="store_true")
+    ps.add_argument("--address", help="head host:port to join")
+    ps.add_argument("--host", default="127.0.0.1")
+    ps.add_argument("--node-host", default=None)
+    ps.add_argument("--port", type=int, default=6379)
+    ps.add_argument("--num-cpus", type=float, default=None)
+    ps.add_argument("--resources")
+    ps.add_argument("--labels")
+    ps.add_argument("--system-config")
+    ps.add_argument("--block", action="store_true",
+                    help="run in the foreground")
+    ps.add_argument("--start-timeout", type=float, default=30.0)
+    ps.set_defaults(fn=cmd_start)
+
+    pt = sub.add_parser("stop", help="stop nodes started on this host")
+    pt.add_argument("--keep-files", action="store_true")
+    pt.set_defaults(fn=cmd_stop)
+
+    pu = sub.add_parser("status", help="cluster resource summary")
+    pu.add_argument("--address")
+    pu.set_defaults(fn=cmd_status)
+
+    pl = sub.add_parser("list", help="list cluster state")
+    pl.add_argument("what", choices=["nodes", "actors", "jobs", "pgs"])
+    pl.add_argument("--address")
+    pl.add_argument("--json", action="store_true")
+    pl.set_defaults(fn=cmd_list)
+
+    args = p.parse_args(argv)
+    if args.cmd == "start" and not args.head and not args.address:
+        p.error("one of --head / --address is required")
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
