@@ -68,7 +68,8 @@ class Config:
     # --- observability ---
     event_buffer_size: int = 65536
     metrics_export_interval_s: float = 5.0
-    log_dir: str = ""                       # "" = <session>/logs
+    metrics_port: int = -1                  # -1 off, 0 ephemeral, >0 fixed
+    log_dir: str = ""                       # "" = workers inherit stdio
 
     extra: dict = field(default_factory=dict)
 

@@ -49,6 +49,13 @@ async def _amain(args) -> int:
     cfg = Config.from_env()
     if args.system_config:
         cfg.update(json.loads(args.system_config))
+    if args.metrics_port is not None:
+        cfg.metrics_port = args.metrics_port
+    if not cfg.log_dir and args.info_file:
+        # CLI-started nodes log workers beside their session record.
+        cfg.log_dir = os.path.join(
+            os.path.dirname(args.info_file), "logs",
+            os.path.splitext(os.path.basename(args.info_file))[0])
 
     stop_ev = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -93,6 +100,11 @@ async def _amain(args) -> int:
         "pid": os.getpid(),
         "resources": agent.resources_total,
     }
+    ma = getattr(agent, "metrics_addr", None)
+    if ma is not None:
+        info["metrics_addr"] = f"{ma[0]}:{ma[1]}"
+    if cfg.log_dir:
+        info["log_dir"] = cfg.log_dir
     if args.info_file:
         tmp = args.info_file + ".tmp"
         with open(tmp, "w") as f:
@@ -134,6 +146,8 @@ def main(argv=None) -> int:
     p.add_argument("--resources", help="JSON dict of extra resources")
     p.add_argument("--labels", help="JSON dict of node labels")
     p.add_argument("--system-config", help="JSON config overrides")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="Prometheus /metrics port (0 = ephemeral)")
     p.add_argument("--info-file", help="write node info JSON here when up")
     args = p.parse_args(argv)
     if not args.head and not args.address:

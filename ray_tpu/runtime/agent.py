@@ -120,6 +120,13 @@ class NodeAgent:
             labels=self.labels)
         assert r.get("ok"), r
         self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+        from ray_tpu.util import metrics as _m
+        self._collector = self._render_metrics
+        _m.register_collector(self._collector)
+        if self.config.metrics_port >= 0:
+            self.metrics_addr = await _m.acquire_shared_server(
+                host, self.config.metrics_port)
+            self._metrics_held = True
         for _ in range(self.config.num_workers_prestart):
             asyncio.ensure_future(self._spawn_worker())
         return self.addr
@@ -128,11 +135,45 @@ class NodeAgent:
         self._stopping = True
         if self._hb_task:
             self._hb_task.cancel()
+        from ray_tpu.util import metrics as _m
+        if getattr(self, "_collector", None) is not None:
+            _m.unregister_collector(self._collector)
+        if getattr(self, "_metrics_held", False):
+            self._metrics_held = False
+            await _m.release_shared_server()
         for w in list(self.workers.values()):
             await self._kill_worker(w)
         await self.server.stop()
         await self.pool.close()
         self.store.shutdown()
+
+    def _render_metrics(self) -> str:
+        """Scrape-time node gauges in Prometheus text (reference exports
+        the raylet's equivalents via stats/metric_defs.h)."""
+        from ray_tpu.util.metrics import _fmt_labels, _labels_key
+        nid = self.node_id.hex()[:12]
+        out = []
+
+        def g(name, val, **labels):
+            labels["node"] = nid
+            out.append(f"ray_tpu_{name}"
+                       f"{_fmt_labels(_labels_key(labels))} {val:g}")
+
+        for k, v in self.resources_total.items():
+            g("node_resource_total", v, resource=k)
+        for k, v in self.available.items():
+            g("node_resource_available", v, resource=k)
+        by_state: Dict[str, int] = {}
+        for w in self.workers.values():
+            by_state[w.state] = by_state.get(w.state, 0) + 1
+        for st, n in by_state.items():
+            g("node_workers", n, state=st)
+        g("node_lease_queue_depth", len(self._wait_queue))
+        st = self.store.stats()
+        g("object_store_objects", st["objects"])
+        g("object_store_bytes_used", st["used_bytes"])
+        g("object_store_bytes_capacity", st["capacity_bytes"])
+        return "\n".join(out)
 
     async def ping(self):
         return "pong"
@@ -180,8 +221,22 @@ class NodeAgent:
             "RAY_TPU_NODE_ID": self.node_id.hex(),
             "RAY_TPU_SESSION": self.session_id,
         })
-        proc = await asyncio.create_subprocess_exec(
-            sys.executable, "-m", "ray_tpu.runtime.worker", env=env)
+        stdout = stderr = None
+        if self.config.log_dir:
+            # Worker stdio goes to per-worker files (reference: workers
+            # log under the session dir, tailed by log_monitor.py). The
+            # fd is handed to the child and closed here after spawn.
+            os.makedirs(self.config.log_dir, exist_ok=True)
+            logpath = os.path.join(self.config.log_dir,
+                                   f"worker-{wid.hex()[:12]}.log")
+            stdout = stderr = open(logpath, "ab", buffering=0)
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "ray_tpu.runtime.worker", env=env,
+                stdout=stdout, stderr=stderr)
+        finally:
+            if stdout is not None:
+                stdout.close()
         w = WorkerHandle(worker_id=wid, proc=proc)
         self.workers[wid] = w
         asyncio.ensure_future(self._reap_worker(w))

@@ -159,13 +159,46 @@ class ControlService:
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self.addr = await self.server.start(host, port)
         self._health_task = asyncio.ensure_future(self._health_loop())
+        from ray_tpu.util import metrics as _m
+        self._collector = self._render_metrics
+        _m.register_collector(self._collector)
+        if self.config.metrics_port >= 0:
+            self.metrics_addr = await _m.acquire_shared_server(
+                host, self.config.metrics_port)
+            self._metrics_held = True
         return self.addr
 
     async def stop(self):
         if self._health_task:
             self._health_task.cancel()
+        from ray_tpu.util import metrics as _m
+        if getattr(self, "_collector", None) is not None:
+            _m.unregister_collector(self._collector)
+        if getattr(self, "_metrics_held", False):
+            self._metrics_held = False
+            await _m.release_shared_server()
         await self.server.stop()
         await self.pool.close()
+
+    def _render_metrics(self) -> str:
+        """Cluster-level gauges (reference: gcs metrics in
+        stats/metric_defs.h, surfaced on the dashboard)."""
+        from ray_tpu.util.metrics import _fmt_labels, _labels_key
+        out = []
+        alive = sum(1 for n in self.nodes.values() if n.alive)
+        out.append(f"ray_tpu_cluster_nodes_alive {alive}")
+        out.append(f"ray_tpu_cluster_nodes_total {len(self.nodes)}")
+        by_state: Dict[str, int] = {}
+        for a in self.actors.values():
+            by_state[a.state] = by_state.get(a.state, 0) + 1
+        for st, n in by_state.items():
+            lbl = _fmt_labels(_labels_key({"state": st}))
+            out.append(f"ray_tpu_cluster_actors{lbl} {n}")
+        out.append(f"ray_tpu_cluster_placement_groups {len(self.pgs)}")
+        running = sum(1 for j in self.jobs.values()
+                      if j.get("state") == "RUNNING")
+        out.append(f"ray_tpu_cluster_jobs_running {running}")
+        return "\n".join(out)
 
     async def ping(self):
         return "pong"

@@ -30,6 +30,12 @@ from ray_tpu.runtime.object_store import SharedStoreReader
 from ray_tpu.runtime.serialization import (FunctionCache, Serialized,
                                            dumps_oob, loads_oob)
 
+def _M_TASKS():
+    from ray_tpu.util.metrics import core_metric
+    return core_metric("counter", "ray_tpu_tasks_submitted_total",
+                       "Tasks submitted by this process")
+
+
 PIPELINE_DEPTH = 2          # in-flight tasks per leased worker
 MAX_SPILLBACK_HOPS = 4
 LEASE_IDLE_RETURN_S = 2.0
@@ -823,6 +829,7 @@ class CoreContext:
         retries = (max_retries if max_retries is not None
                    else self.config.default_max_task_retries)
         task_id = TaskID.generate()
+        _M_TASKS().inc()
         oids = [ObjectID.generate() for _ in range(num_returns)]
         for oid in oids:
             self.store.create_pending(oid)

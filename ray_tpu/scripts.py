@@ -71,6 +71,8 @@ def cmd_start(args) -> int:
         cmd += ["--labels", args.labels]
     if args.system_config:
         cmd += ["--system-config", args.system_config]
+    if args.metrics_port is not None:
+        cmd += ["--metrics-port", str(args.metrics_port)]
 
     if args.block:
         return subprocess.call(cmd)
@@ -177,6 +179,61 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    """Show worker logs from nodes started on this host."""
+    files = []
+    for f in _node_files():
+        try:
+            with open(f) as fh:
+                info = json.load(fh)
+            ld = info.get("log_dir")
+            if ld and os.path.isdir(ld):
+                files += [os.path.join(ld, x) for x in sorted(os.listdir(ld))]
+        except (OSError, ValueError):
+            continue
+    if args.filename:
+        matches = [f for f in files if args.filename in f]
+        if not matches:
+            print(f"no log file matching {args.filename!r}",
+                  file=sys.stderr)
+            return 1
+        for m in matches:
+            with open(m, errors="replace") as fh:
+                if args.tail:
+                    from collections import deque
+                    sys.stdout.writelines(deque(fh, maxlen=args.tail))
+                else:
+                    for line in fh:
+                        sys.stdout.write(line)
+        return 0
+    for f in files:
+        print(f)
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """Fetch /metrics from a node's Prometheus endpoint."""
+    import urllib.request
+    addr = args.endpoint
+    if not addr:
+        for f in reversed(_node_files()):
+            try:
+                with open(f) as fh:
+                    addr = json.load(fh).get("metrics_addr")
+                if addr:
+                    break
+            except (OSError, ValueError):
+                continue
+    if not addr:
+        print("no metrics endpoint (start nodes with --metrics-port)",
+              file=sys.stderr)
+        return 1
+    with urllib.request.urlopen(f"http://{addr}/metrics",
+                                timeout=10) as r:
+        sys.stdout.write(r.read().decode())
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -191,6 +248,8 @@ def main(argv=None) -> int:
     ps.add_argument("--resources")
     ps.add_argument("--labels")
     ps.add_argument("--system-config")
+    ps.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus /metrics (0 = ephemeral port)")
     ps.add_argument("--block", action="store_true",
                     help="run in the foreground")
     ps.add_argument("--start-timeout", type=float, default=30.0)
@@ -209,6 +268,17 @@ def main(argv=None) -> int:
     pl.add_argument("--address")
     pl.add_argument("--json", action="store_true")
     pl.set_defaults(fn=cmd_list)
+
+    pg = sub.add_parser("logs", help="list / show worker logs on this host")
+    pg.add_argument("filename", nargs="?",
+                    help="substring of a log file to print")
+    pg.add_argument("--tail", type=int, default=0,
+                    help="print only the last N lines")
+    pg.set_defaults(fn=cmd_logs)
+
+    pm = sub.add_parser("metrics", help="dump a node's /metrics")
+    pm.add_argument("--endpoint", help="host:port (default: latest local)")
+    pm.set_defaults(fn=cmd_metrics)
 
     args = p.parse_args(argv)
     if args.cmd == "start" and not args.head and not args.address:

@@ -1,0 +1,264 @@
+"""Metrics: user-facing Counter/Gauge/Histogram + Prometheus export.
+
+Analog of the reference's metrics pipeline (reference:
+python/ray/util/metrics.py for the user API, _private/metrics_agent.py +
+OpenCensus export for the scrape path), collapsed to one dependency-free
+layer: metrics live in a process-global registry; an asyncio HTTP
+endpoint renders the Prometheus text format on demand. Components can
+also register scrape-time collectors (e.g. the node agent contributes
+live lease/object-store gauges without bookkeeping on the hot path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_LOCK = threading.Lock()
+_REGISTRY: Dict[str, "Metric"] = {}
+_COLLECTORS: List[Callable[[], str]] = []
+
+
+def _labels_key(labels: Optional[dict]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _fmt_labels(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{str(v).replace(chr(34), chr(39))}"'
+                     for k, v in key)
+    return "{" + inner + "}"
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: Dict[tuple, float] = {}
+        with _LOCK:
+            existing = _REGISTRY.get(name)
+            if existing is not None:
+                if type(existing) is not type(self):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}")
+                # Same name+type from another module: share storage so
+                # neither instance's increments are lost.
+                self._values = existing._values
+            _REGISTRY[name] = self
+
+    def _set(self, key: tuple, value: float):
+        with _LOCK:
+            self._values[key] = value
+
+    def _add(self, key: tuple, delta: float):
+        with _LOCK:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.description}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with _LOCK:
+            items = list(self._values.items())
+        for key, v in items:
+            lines.append(f"{self.name}{_fmt_labels(key)} {v:g}")
+        return "\n".join(lines)
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None):
+        self._add(_labels_key(tags), value)
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[dict] = None):
+        self._set(_labels_key(tags), float(value))
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None):
+        self._add(_labels_key(tags), value)
+
+    def dec(self, value: float = 1.0, tags: Optional[dict] = None):
+        self._add(_labels_key(tags), -value)
+
+
+class Histogram(Metric):
+    """Fixed-boundary histogram rendered in Prometheus cumulative form."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (.005, .01, .025, .05, .1,
+                                                .25, .5, 1, 2.5, 5, 10),
+                 tag_keys: Sequence[str] = ()):
+        with _LOCK:
+            existing = _REGISTRY.get(name)
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(sorted(boundaries))
+        self._counts: Dict[tuple, List[int]] = {}
+        self._sums: Dict[tuple, float] = {}
+        if isinstance(existing, Histogram) \
+                and existing.boundaries == self.boundaries:
+            self._counts = existing._counts
+            self._sums = existing._sums
+
+    def observe(self, value: float, tags: Optional[dict] = None):
+        key = _labels_key(tags)
+        with _LOCK:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            i = 0
+            while i < len(self.boundaries) and value > self.boundaries[i]:
+                i += 1
+            counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.description}",
+                 f"# TYPE {self.name} histogram"]
+        with _LOCK:
+            items = [(k, list(c), self._sums.get(k, 0.0))
+                     for k, c in self._counts.items()]
+        for key, counts, total in items:
+            cum = 0
+            for b, c in zip(self.boundaries, counts):
+                cum += c
+                lk = key + (("le", f"{b:g}"),)
+                lines.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+            cum += counts[-1]
+            lk = key + (("le", "+Inf"),)
+            lines.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+            lines.append(f"{self.name}_sum{_fmt_labels(key)} {total:g}")
+            lines.append(f"{self.name}_count{_fmt_labels(key)} {cum}")
+        return "\n".join(lines)
+
+
+def register_collector(fn: Callable[[], str]) -> None:
+    """Add a scrape-time text producer (already Prometheus-formatted)."""
+    with _LOCK:
+        _COLLECTORS.append(fn)
+
+
+def unregister_collector(fn: Callable[[], str]) -> None:
+    with _LOCK:
+        try:
+            _COLLECTORS.remove(fn)
+        except ValueError:
+            pass
+
+
+def render_all() -> str:
+    with _LOCK:
+        metrics = list(_REGISTRY.values())
+        collectors = list(_COLLECTORS)
+    parts = [m.render() for m in metrics]
+    for fn in collectors:
+        try:
+            parts.append(fn())
+        except Exception as e:  # noqa: BLE001 — one bad collector
+            parts.append(f"# collector error: {e!r}")
+    return "\n".join(p for p in parts if p) + "\n"
+
+
+def reset() -> None:
+    """Test hook: drop all metrics and collectors."""
+    with _LOCK:
+        _REGISTRY.clear()
+        _COLLECTORS.clear()
+
+
+class MetricsServer:
+    """Minimal asyncio HTTP endpoint serving /metrics (and /healthz)."""
+
+    def __init__(self):
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.addr: Optional[Tuple[str, int]] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = await asyncio.start_server(self._on_conn, host, port)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+        return self.addr
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except Exception:
+                pass
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter):
+        try:
+            req = await asyncio.wait_for(reader.readline(), 10.0)
+            path = req.split()[1].decode() if len(req.split()) > 1 else "/"
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 10.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            if path.startswith("/metrics"):
+                body = render_all().encode()
+                ctype = "text/plain; version=0.0.4"
+                code = "200 OK"
+            elif path.startswith("/healthz"):
+                body, ctype, code = b"ok\n", "text/plain", "200 OK"
+            else:
+                body, ctype, code = b"not found\n", "text/plain", \
+                    "404 Not Found"
+            writer.write(
+                f"HTTP/1.1 {code}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + body)
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+# One MetricsServer per process: render_all() serves the process-global
+# registry, so control service + agent(s) sharing a process share one
+# endpoint (a fixed port would otherwise EADDRINUSE on the head node).
+_SRV: Optional[MetricsServer] = None
+_SRV_REFS = 0
+
+
+async def acquire_shared_server(host: str, port: int) -> Tuple[str, int]:
+    global _SRV, _SRV_REFS
+    if _SRV is None:
+        srv = MetricsServer()
+        await srv.start(host, port)
+        _SRV = srv
+    _SRV_REFS += 1
+    return _SRV.addr
+
+
+async def release_shared_server() -> None:
+    global _SRV, _SRV_REFS
+    _SRV_REFS -= 1
+    if _SRV_REFS <= 0 and _SRV is not None:
+        srv, _SRV, _SRV_REFS = _SRV, None, 0
+        await srv.stop()
+
+
+def core_metric(kind: str, name: str, desc: str) -> Metric:
+    """Get-or-create a runtime-internal metric (idempotent across
+    re-inits, safe after a test `reset()`)."""
+    m = _REGISTRY.get(name)
+    if m is None:
+        cls = {"counter": Counter, "gauge": Gauge,
+               "histogram": Histogram}[kind]
+        m = cls(name, desc)
+    return m
