@@ -406,6 +406,12 @@ class ActorMethod:
                 "max_task_retries", self._handle._max_task_retries))
         return refs[0] if num_returns == 1 else refs
 
+    def bind(self, *args):
+        """Add this actor method as a node in a (to-be-compiled) DAG;
+        see ray_tpu.dag (reference: dag/class_node.py bind API)."""
+        from ray_tpu.dag import MethodNode
+        return MethodNode(self._handle, self._name, args)
+
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, max_task_retries: int = 0):

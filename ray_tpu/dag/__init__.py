@@ -1,0 +1,275 @@
+"""Compiled actor DAGs: pinned pipelines over shared-memory channels.
+
+The compiled-graph analog (reference: python/ray/dag/compiled_dag_node.py:805,
+dag/input_node.py, experimental/channel/shared_memory_channel.py): build a
+static graph of actor method calls with `.bind()`, `compile()` it once —
+every edge gets a pre-allocated SPSC shm ring, every actor enters a pinned
+execution loop — then `execute()` streams items through with all stages
+overlapped and bounded buffering for backpressure.
+
+    with InputNode() as inp:
+        h = stage1.fwd.bind(inp)
+        out = stage2.fwd.bind(h)
+    cd = compile(out)
+    futs = [cd.execute(batch) for batch in batches]   # pipelined
+    results = [f.get() for f in futs]
+    cd.teardown()
+
+Channels are intra-host (POSIX shm) — the right transport for a TPU
+host driving multi-stage inference; cross-host tensor movement belongs
+to jit'd collectives over ICI, not the object plane.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.channel import (DATA, ERROR, STOP, ChannelTimeout,
+                                 ShmRingChannel)
+from ray_tpu.runtime.serialization import dumps_oob, loads_oob, serialize
+
+__all__ = ["InputNode", "MethodNode", "compile", "CompiledDag",
+           "DagFuture"]
+
+
+class InputNode:
+    """Placeholder for the value passed to execute()."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class MethodNode:
+    def __init__(self, handle, method: str, args: tuple):
+        self.handle = handle
+        self.method = method
+        self.args = args
+
+    def experimental_compile(self, **kw) -> "CompiledDag":
+        return compile(self, **kw)
+
+
+class DagFuture:
+    def __init__(self, dag: "CompiledDag", seq: int):
+        self._dag = dag
+        self._seq = seq
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        return self._dag._result(self._seq, timeout)
+
+
+class CompiledDag:
+    def __init__(self, sink: MethodNode, *, nslots: int, slot_bytes: int,
+                 zero_copy: bool = False):
+        if not isinstance(sink, MethodNode):
+            raise TypeError("compile() expects the dag's output node")
+        self._nslots = nslots
+        self._slot_bytes = slot_bytes
+        self._zero_copy = zero_copy
+        self._nodes: List[MethodNode] = []
+        self._topo(sink, set())
+        self._validate()
+        self._channels: List[ShmRingChannel] = []
+        # edge channels: producer node -> list of (consumer, arg position)
+        self._in_chans: Dict[int, List[dict]] = {}   # node idx -> specs
+        self._templates: Dict[int, list] = {}
+        self._out_chans: Dict[int, List[dict]] = {}
+        self._input_chans: List[ShmRingChannel] = []
+        self._build(sink)
+        self._loops = []
+        self._start()
+        self._next_seq = 0
+        self._read_seq = 0
+        self._results: Dict[int, tuple] = {}
+        self._lock = threading.Lock()
+        self._torn_down = False
+
+    # --- graph wiring ---------------------------------------------------
+
+    def _topo(self, node, seen):
+        if id(node) in seen or not isinstance(node, MethodNode):
+            return
+        seen.add(id(node))
+        for a in node.args:
+            self._topo(a, seen)
+        self._nodes.append(node)  # post-order == topological
+
+    def _validate(self):
+        """Reject dag shapes that would hang opaquely at runtime."""
+        from ray_tpu.api import _require_init, _run
+        ctx = _require_init()
+        seen_actors = set()
+        for n in self._nodes:
+            aid = n.handle._actor_id
+            if aid in seen_actors:
+                # One pinned loop holds the actor's lock + executor
+                # thread for its lifetime; a second would never start.
+                raise ValueError(
+                    "compiled dags pin one exec loop per actor — use a "
+                    "distinct actor for each dag node")
+            seen_actors.add(aid)
+            _run(ctx.pool.call(ctx.head_addr, "wait_actor_alive",
+                               actor_id=aid, wait_timeout=60.0))
+            info = _run(ctx.pool.call(ctx.head_addr, "get_actor",
+                                      actor_id=aid))
+            if info and info.get("node_id") not in (None, ctx.node_id):
+                # Channels are POSIX shm — same-host only.
+                raise ValueError(
+                    "compiled dags require all actors on the driver's "
+                    "host (shm channels); schedule them with node labels "
+                    f"(actor {aid} is on {info['node_id']})")
+
+    def _new_chan(self) -> ShmRingChannel:
+        ch = ShmRingChannel(create=True, nslots=self._nslots,
+                            slot_bytes=self._slot_bytes)
+        self._channels.append(ch)
+        return ch
+
+    def _build(self, sink: MethodNode):
+        idx = {id(n): i for i, n in enumerate(self._nodes)}
+        for i, n in enumerate(self._nodes):
+            self._in_chans[i] = []
+            self._out_chans[i] = []
+            self._templates[i] = []
+        for i, n in enumerate(self._nodes):
+            for a in n.args:
+                if isinstance(a, InputNode):
+                    ch = self._new_chan()
+                    self._input_chans.append(ch)
+                    self._in_chans[i].append(ch.spec())
+                    self._templates[i].append(("chan", None))
+                elif isinstance(a, MethodNode):
+                    ch = self._new_chan()
+                    self._out_chans[idx[id(a)]].append(ch.spec())
+                    self._in_chans[i].append(ch.spec())
+                    self._templates[i].append(("chan", None))
+                else:
+                    self._templates[i].append(("const", dumps_oob(a)))
+        # sink -> driver
+        self._sink_chan = self._new_chan()
+        self._out_chans[idx[id(sink)]].append(self._sink_chan.spec())
+
+    def _start(self):
+        from ray_tpu.api import ActorMethod
+        for i, n in enumerate(self._nodes):
+            spec = {"method": n.method,
+                    "in_channels": self._in_chans[i],
+                    "arg_template": self._templates[i],
+                    "out_channels": self._out_chans[i],
+                    "zero_copy": self._zero_copy}
+            # retries pinned to 0: a replayed loop would attach a second
+            # consumer to SPSC rings and race on the sequence counters.
+            m = ActorMethod(n.handle, "__dag_exec_loop__",
+                            max_task_retries=0)
+            self._loops.append(m.remote(spec))
+
+    # --- execution ------------------------------------------------------
+
+    def execute(self, value: Any,
+                timeout: Optional[float] = None) -> DagFuture:
+        """Feed one item; returns a future. When the input ring is full,
+        completed results are drained off the sink while waiting — so
+        submitting arbitrarily many items ahead of get() can't deadlock
+        the pipeline (driver blocked on full input ↔ stages blocked on
+        an unread sink)."""
+        if self._torn_down:
+            raise RuntimeError("dag torn down")
+        ser = serialize(value)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # Wait for space on ALL input rings BEFORE writing any: a partial
+        # write followed by a timeout would leave fan-in channels skewed,
+        # silently pairing mismatched items forever after. Space only
+        # grows (the consumers are the stages), so write-after-check
+        # cannot block.
+        while not all(ch.has_space() for ch in self._input_chans):
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeout("input ring full")
+            with self._lock:
+                self._pump_sink(blocking=False)
+            time.sleep(200e-6)
+        for ch in self._input_chans:
+            ch.write(ser, DATA)
+        seq = self._next_seq
+        self._next_seq += 1
+        return DagFuture(self, seq)
+
+    def _pump_sink(self, blocking: bool, timeout: Optional[float] = None):
+        """Move any completed frames sink -> _results. Caller holds
+        self._lock."""
+        while True:
+            try:
+                kind, payload = self._sink_chan.read_bytes(
+                    timeout if blocking else 0.0)
+            except ChannelTimeout:
+                if blocking:
+                    raise
+                return
+            if kind == STOP:
+                raise RuntimeError("dag torn down mid-stream")
+            self._results[self._read_seq] = (kind, payload)
+            self._read_seq += 1
+            if blocking:
+                return
+
+    def _result(self, seq: int, timeout: Optional[float]) -> Any:
+        with self._lock:
+            while seq not in self._results:
+                self._pump_sink(blocking=True, timeout=timeout)
+        kind, payload = self._results.pop(seq)
+        if kind == ERROR:
+            err = loads_oob(payload)
+            raise err if isinstance(err, BaseException) else \
+                RuntimeError(str(err))
+        return loads_oob(payload)
+
+    def teardown(self, timeout: float = 30.0):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        deadline = time.monotonic() + timeout
+        from ray_tpu import api
+        for ch in self._input_chans:
+            try:
+                ch.write(b"", STOP, timeout=timeout)
+            except ChannelTimeout:
+                pass
+        # Drain the sink until STOP flows out: stages blocked writing
+        # results into a full sink must unblock to ever see the STOP —
+        # otherwise their loops would spin (holding the actor's executor
+        # thread) against channels we are about to unlink.
+        while time.monotonic() < deadline:
+            try:
+                kind, _ = self._sink_chan.read_bytes(timeout=1.0)
+            except ChannelTimeout:
+                continue
+            if kind == STOP:
+                break
+        try:
+            api.get(self._loops,
+                    timeout=max(1.0, deadline - time.monotonic()))
+        except Exception:
+            pass
+        for ch in self._channels:
+            ch.close()
+            ch.unlink()
+
+    def __del__(self):
+        try:
+            self.teardown(timeout=1.0)
+        except Exception:
+            pass
+
+
+def compile(sink: MethodNode, *, nslots: int = 8,
+            slot_bytes: int = 4 << 20,
+            zero_copy: bool = False) -> CompiledDag:
+    """zero_copy=True deserializes single-input stage args directly from
+    the ring slot (no copy) — only safe when stage methods do NOT retain
+    references to their array arguments past the call."""
+    return CompiledDag(sink, nslots=nslots, slot_bytes=slot_bytes,
+                       zero_copy=zero_copy)

@@ -1,0 +1,130 @@
+"""Worker-side pinned execution loop for compiled DAGs.
+
+The analog of the reference's compiled-graph executor loop (reference:
+python/ray/dag/compiled_dag_node.py:805 _execute_until / the per-actor
+do_exec_tasks loop): each pinned actor blocks on its input channels,
+runs its bound method, and pushes the result downstream — no RPC, no
+scheduler, no driver round-trip per item.
+
+jax.Array results are staged to host (np.asarray) before entering the
+channel — the seed of the tensor-transport path (reference:
+experimental/rdt/tensor_transport_manager.py:37); device-to-device over
+ICI belongs to jit'd collectives, not the object plane.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from ray_tpu.dag.channel import DATA, ERROR, STOP, ShmRingChannel
+from ray_tpu.runtime.serialization import dumps_oob, loads_oob, serialize
+
+
+def _stage_to_host(value):
+    if "jax" in sys.modules:
+        import jax
+        if isinstance(value, jax.Array):
+            return np.asarray(value)
+    return value
+
+
+class _Stop(Exception):
+    pass
+
+
+class _Upstream(Exception):
+    """An ERROR frame arrived; carry it downstream unchanged."""
+
+    def __init__(self, frame: bytes):
+        self.frame = frame
+
+
+def exec_loop(instance, spec: dict) -> dict:
+    """Runs inside the actor's executor thread until a STOP frame.
+
+    spec:
+      method: attribute name on the actor instance
+      in_channels: list of channel specs (one per bound upstream arg)
+      arg_template: list where each element is ("chan", idx) or
+        ("const", frame) — positional args in order
+      out_channels: list of channel specs (broadcast to every consumer)
+    """
+    method = getattr(instance, spec["method"])
+    ins: List[ShmRingChannel] = [
+        ShmRingChannel.attach(s) for s in spec["in_channels"]]
+    outs: List[ShmRingChannel] = [
+        ShmRingChannel.attach(s) for s in spec["out_channels"]]
+    template = [loads_oob(frame) if k == "const" else None
+                for k, frame in spec["arg_template"]]
+    chan_pos = [i for i, (k, _) in enumerate(spec["arg_template"])
+                if k == "chan"]
+    # Zero-copy is opt-in (compile(zero_copy=True)): args alias the ring
+    # slot, which is only safe when the method does not retain them.
+    single = len(ins) == 1 and spec.get("zero_copy")
+
+    def _take_copy(kind, mv):
+        """Deserialize from a copy — the slot is released on return, so
+        zero-copy views must not escape this window."""
+        if kind == DATA:
+            return loads_oob(bytes(mv))
+        raise _Stop() if kind == STOP else _Upstream(bytes(mv))
+
+    def _run_in_window(kind, mv):
+        """Zero-copy fast path: the method consumes the frame AND the
+        result is serialized downstream INSIDE the slot window, so
+        deserialization is zero-copy (arrays alias the ring slot —
+        even a method returning a view of its input stays safe, since
+        the slot is released only after the downstream copy)."""
+        if kind != DATA:
+            raise _Stop() if kind == STOP else _Upstream(bytes(mv))
+        args = list(template)
+        args[chan_pos[0]] = loads_oob(mv)
+        ser = serialize(_stage_to_host(method(*args)))
+        for out in outs:
+            out.write(ser, DATA)
+
+    processed = 0
+    try:
+        while True:
+            try:
+                if single:
+                    ins[0].read_with(_run_in_window)
+                else:
+                    args = list(template)
+                    pending: Optional[BaseException] = None
+                    for pos, ch in zip(chan_pos, ins):
+                        # Drain every input each round even after a
+                        # stop/error so the channels stay in lockstep.
+                        try:
+                            args[pos] = ch.read_with(_take_copy)
+                        except (_Stop, _Upstream) as e:
+                            pending = pending or e
+                    if pending is not None:
+                        raise pending
+                    ser = serialize(_stage_to_host(method(*args)))
+                    for out in outs:
+                        out.write(ser, DATA)
+            except _Stop:
+                for out in outs:
+                    out.write(b"", STOP)
+                break
+            except _Upstream as e:
+                for out in outs:
+                    out.write(e.frame, ERROR)
+            except BaseException as e:  # noqa: BLE001 — ship downstream
+                try:
+                    frame = dumps_oob(e)
+                except Exception:  # unpicklable exception payload
+                    frame = dumps_oob(RuntimeError(
+                        f"{type(e).__name__}: {e}"))
+                for out in outs:
+                    out.write(frame, ERROR)
+            else:
+                processed += 1
+    finally:
+        for ch in ins + outs:
+            ch.close()
+    return {"processed": processed}

@@ -224,7 +224,16 @@ class WorkerExecutor:
                 TaskError(f"actor {actor_id} not hosted here"), return_oids)
         try:
             args, kwargs = await self._resolve_args(args_frame)
-            fn = getattr(hosted.instance, method)
+            if method == "__dag_exec_loop__":
+                # Compiled-dag pinned loop (see ray_tpu/dag/runtime.py):
+                # a long-running sync loop over shm channels, dispatched
+                # specially so user classes need no dag-specific methods.
+                from functools import partial
+
+                from ray_tpu.dag.runtime import exec_loop
+                fn = partial(exec_loop, hosted.instance)
+            else:
+                fn = getattr(hosted.instance, method)
             if hosted.lock is not None and not \
                     inspect.iscoroutinefunction(fn):
                 async with hosted.lock:
