@@ -31,7 +31,7 @@ def __getattr__(name):
                 f"ray_tpu.{name} is unavailable: {e}") from e
         return getattr(api, name)
     if name in ("util", "train", "data", "serve", "tune", "models", "ops",
-                "parallel", "api", "runtime", "dag"):
+                "parallel", "api", "runtime", "dag", "llm"):
         import importlib
         try:
             return importlib.import_module(f"ray_tpu.{name}")

@@ -1,0 +1,285 @@
+"""Continuous-batching LLM engine: token-level scheduling over jitted steps.
+
+The serving engine the reference delegates to vLLM for (reference:
+python/ray/llm/_internal/serve/deployments/llm/llm_server.py wrapping a
+vLLM engine; python/ray/llm/_internal/serve/deployments/llm/vllm/*),
+rebuilt TPU-native:
+
+- requests join and leave a fixed set of decode SLOTS at token
+  granularity (continuous batching — no waiting for the batch to drain),
+- every decode step is ONE jitted call over all slots (static shapes:
+  the MXU sees the same batched matmuls every step, zero recompiles),
+- prompts prefill into a shared static KV cache through shape buckets
+  (one compile per bucket), admitted before each decode step for low
+  time-to-first-token.
+
+The engine is asyncio-native so it drops straight into a Serve replica;
+device steps run on an executor thread to keep the event loop live.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.llm import model as lm
+from ray_tpu.models.llama import LlamaConfig
+
+
+@dataclass
+class _Request:
+    tokens: List[int]                       # prompt (token ids)
+    max_new_tokens: int
+    temperature: float
+    eos_id: Optional[int]
+    out: List[int] = field(default_factory=list)
+    fut: Optional[asyncio.Future] = None
+    stream: Optional[asyncio.Queue] = None
+    submitted: float = field(default_factory=time.monotonic)
+    first_token_at: Optional[float] = None
+
+
+class LLMEngine:
+    def __init__(self, cfg: LlamaConfig, params, *, max_slots: int = 8,
+                 max_len: int = 1024,
+                 prefill_buckets: Sequence[int] = (64, 128, 256, 512),
+                 cache_dtype="bfloat16", seed: int = 0,
+                 steps_per_sync: int = 8,
+                 detokenize: Optional[Callable[[List[int]], str]] = None):
+        import jax.numpy as jnp
+        self.cfg = cfg
+        self.params = params
+        self.max_slots = max_slots
+        self.max_len = max_len
+        self.buckets = tuple(sorted(b for b in prefill_buckets
+                                    if b <= max_len)) or (max_len,)
+        self.detokenize = detokenize
+        import jax
+        self._cache = lm.init_cache(cfg, max_slots, max_len,
+                                    dtype=jnp.dtype(cache_dtype))
+        self._slots: List[Optional[_Request]] = [None] * max_slots
+        self._waiting: "asyncio.Queue[_Request]" = asyncio.Queue()
+        self._rng = np.random.default_rng(seed)
+        self._key = jax.random.PRNGKey(seed)
+        self._step = 0
+        # Decode block size per host sync: throughput lever when the
+        # device link is latency-bound. Kept power-of-2-bucketed so XLA
+        # compiles at most log2(steps_per_sync)+1 block variants.
+        self.steps_per_sync = max(1, steps_per_sync)
+        self._loop_task: Optional[asyncio.Task] = None
+        self._stopped = False
+        self.stats = {"requests": 0, "tokens_generated": 0,
+                      "ttft_sum": 0.0, "ttft_count": 0}
+
+    # --- public API -----------------------------------------------------
+
+    async def generate(self, tokens: Sequence[int], *,
+                       max_new_tokens: int = 64,
+                       temperature: float = 0.0,
+                       eos_id: Optional[int] = None) -> dict:
+        r = self._submit(tokens, max_new_tokens, temperature, eos_id)
+        r.fut = asyncio.get_running_loop().create_future()
+        await r.fut
+        return self._result(r)
+
+    async def generate_stream(self, tokens: Sequence[int], *,
+                              max_new_tokens: int = 64,
+                              temperature: float = 0.0,
+                              eos_id: Optional[int] = None):
+        """Async generator of token ids as they are produced."""
+        r = self._submit(tokens, max_new_tokens, temperature, eos_id)
+        r.stream = asyncio.Queue()
+        while True:
+            t = await r.stream.get()
+            if t is None:
+                return
+            if isinstance(t, BaseException):
+                raise t
+            yield t
+
+    def _submit(self, tokens, max_new_tokens, temperature, eos_id):
+        if self._stopped:
+            raise RuntimeError("engine is stopped")
+        tokens = list(map(int, tokens))
+        if not tokens:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(tokens) > self.buckets[-1]:
+            raise ValueError(
+                f"prompt of {len(tokens)} tokens exceeds the largest "
+                f"prefill bucket {self.buckets[-1]}")
+        if len(tokens) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt+generation ({len(tokens)}+{max_new_tokens}) "
+                f"exceeds max_len {self.max_len}")
+        r = _Request(tokens, max_new_tokens, temperature, eos_id)
+        self._waiting.put_nowait(r)
+        self.stats["requests"] += 1
+        self._ensure_loop()
+        return r
+
+    def _result(self, r: _Request) -> dict:
+        out = {"tokens": r.out,
+               "ttft_s": (r.first_token_at or 0) - r.submitted}
+        if self.detokenize is not None:
+            out["text"] = self.detokenize(r.out)
+        return out
+
+    async def stop(self):
+        self._stopped = True
+        if self._loop_task is not None:
+            # The loop may be parked awaiting new work — cancel wakes it.
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    # --- scheduler loop -------------------------------------------------
+
+    def _ensure_loop(self):
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.ensure_future(self._run())
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    async def _run(self):
+        loop = asyncio.get_running_loop()
+        try:
+            while not self._stopped:
+                # 1) admit waiting requests into free slots (prefill) —
+                #    BEFORE the decode step, for low TTFT.
+                for slot in range(self.max_slots):
+                    if self._slots[slot] is not None or \
+                            self._waiting.empty():
+                        continue
+                    r = self._waiting.get_nowait()
+                    tok = await loop.run_in_executor(
+                        None, self._admit_sync, slot, r)
+                    self._emit_token(r, tok, slot)
+                active = [i for i, r in enumerate(self._slots)
+                          if r is not None]
+                if not active:
+                    if self._waiting.empty():
+                        # idle: park until work arrives
+                        r = await self._waiting.get()
+                        self._waiting.put_nowait(r)
+                    continue
+                # 2) a BLOCK of decode steps for every active slot, one
+                # host sync per block. Sampling is on-device
+                # (lm.sample); only token ids come back. Block size is
+                # bounded by each slot's remaining budget so no request
+                # over-runs max_new_tokens or the cache.
+                # A slot hitting eos mid-block wastes its remaining
+                # steps (discarded at emit, slot freed at the sync) —
+                # the batch's throughput is worth more than the waste,
+                # and headroom bounds below keep its cache writes legal.
+                block = self.steps_per_sync
+                for i in active:
+                    r = self._slots[i]
+                    block = min(block,
+                                r.max_new_tokens - len(r.out),
+                                self.max_len - len(r.tokens)
+                                - len(r.out))
+                block = 1 << (max(1, block).bit_length() - 1)  # pow2 dn
+                tokens = np.zeros((self.max_slots,), np.int32)
+                temps = np.zeros((self.max_slots,), np.float32)
+                for i in active:
+                    tokens[i] = self._slots[i].out[-1]
+                    temps[i] = self._slots[i].temperature
+                out = await loop.run_in_executor(
+                    None, self._decode_sync, tokens, temps, block)
+                for step in range(block):
+                    for i in active:
+                        r = self._slots[i]
+                        if r is None:  # finished earlier in this block
+                            continue
+                        self._emit_token(r, int(out[step, i]), i)
+                await asyncio.sleep(0)
+        except BaseException as e:  # noqa: BLE001 — fail all requests
+            for i, r in enumerate(self._slots):
+                if r is not None:
+                    self._fail(r, i, e)
+            while not self._waiting.empty():
+                self._fail(self._waiting.get_nowait(), None, e)
+            raise
+        finally:
+            for i, r in enumerate(self._slots):
+                if r is not None:
+                    self._finish(r, i)
+
+    def _admit_sync(self, slot: int, r: _Request) -> int:
+        """Prefill (executor thread): pad to bucket, fill cache slot.
+        Returns the first sampled token."""
+        import jax.numpy as jnp
+        n = len(r.tokens)
+        b = self._bucket_for(n)
+        padded = np.zeros((b,), np.int32)
+        padded[:n] = r.tokens
+        logits, kv = lm.prefill(self.params, jnp.asarray(padded),
+                                jnp.int32(n), self.cfg, self.max_len)
+        self._cache = lm.write_prefill_to_cache(
+            self._cache, kv, slot, jnp.int32(n))
+        self._slots[slot] = r
+        return self._sample_one(np.asarray(logits), r)
+
+    def _decode_sync(self, tokens: np.ndarray, temps: np.ndarray,
+                     block: int) -> np.ndarray:
+        """Returns (block, slots) int32 sampled tokens."""
+        import jax
+        import jax.numpy as jnp
+        self._step += block
+        key = jax.random.fold_in(self._key, self._step)
+        out, self._cache = lm.decode_steps(
+            self.params, self._cache, jnp.asarray(tokens),
+            jnp.asarray(temps), key, self.cfg, block)
+        return np.asarray(out)
+
+    def _sample_one(self, logits: np.ndarray, r: _Request) -> int:
+        if r.temperature <= 0:
+            return int(np.argmax(logits))
+        z = logits.astype(np.float64) / r.temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    def _emit_token(self, r: _Request, tok: int, slot: int):
+        """Append one sampled token; finish the request if done."""
+        if r.first_token_at is None:
+            r.first_token_at = time.monotonic()
+            self.stats["ttft_sum"] += r.first_token_at - r.submitted
+            self.stats["ttft_count"] += 1
+        r.out.append(tok)
+        self.stats["tokens_generated"] += 1
+        if r.stream is not None:
+            r.stream.put_nowait(tok)
+        if (len(r.out) >= r.max_new_tokens
+                or (r.eos_id is not None and tok == r.eos_id)):
+            self._finish(r, slot)
+
+    def _finish(self, r: _Request, slot: Optional[int]):
+        if slot is not None and self._slots[slot] is r:
+            self._slots[slot] = None
+        if r.stream is not None:
+            r.stream.put_nowait(None)
+        if r.fut is not None and not r.fut.done():
+            r.fut.set_result(True)
+
+    def _fail(self, r: _Request, slot: Optional[int], e: BaseException):
+        err = RuntimeError(f"llm engine failed: {e}")
+        if slot is not None and self._slots[slot] is r:
+            self._slots[slot] = None
+        if r.stream is not None:
+            r.stream.put_nowait(err)  # raised by generate_stream
+        if r.fut is not None and not r.fut.done():
+            r.fut.set_exception(err)

@@ -1,0 +1,203 @@
+"""Cache-aware llama forwards for inference: prefill + single-token decode.
+
+The model side of the LLM serving stack (reference:
+python/ray/llm/_internal/serve/... wraps vLLM; here the engine is native:
+the training model in models/llama.py is reused — same params, same
+config — with two inference-shaped entry points that XLA compiles once
+per shape bucket):
+
+- `prefill`: full-sequence forward that also emits per-layer K/V, written
+  into a static-shape slot cache (TPU rule: no dynamic shapes — prompts
+  are padded to a bucket, the cache is (layers, slots, max_len, kvh, hd)).
+- `decode_step`: one token for every active slot, attending against the
+  cache with a position mask. Batch dimension = slots, so the MXU sees
+  one batched matmul per layer regardless of how many requests are live.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.llama import (LlamaConfig, _rmsnorm, _rope,
+                                  _rope_tables)
+
+
+def init_cache(cfg: LlamaConfig, slots: int, max_len: int,
+               dtype=jnp.bfloat16) -> dict:
+    shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+            "length": jnp.zeros((slots,), jnp.int32)}
+
+
+def _qkv(y, lp, cfg: LlamaConfig):
+    b, s = y.shape[:2]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    q = (y @ lp["wq"]).reshape(b, s, h, hd)
+    k = (y @ lp["wk"]).reshape(b, s, kvh, hd)
+    v = (y @ lp["wv"]).reshape(b, s, kvh, hd)
+    return q, k, v
+
+
+def _gqa_attend_cached(q, cache_k, cache_v, lengths, cfg: LlamaConfig):
+    """q: (b, h, hd) current-token queries; cache_k/v: (b, L, kvh, hd);
+    lengths: (b,) valid cache entries per slot (incl. current token)."""
+    b = q.shape[0]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    g = h // kvh
+    qg = q.reshape(b, kvh, g, hd).astype(jnp.float32)
+    kf = cache_k.astype(jnp.float32)
+    scores = jnp.einsum("bkgd,blkd->bkgl", qg, kf) / jnp.sqrt(hd)
+    mask = jnp.arange(cache_k.shape[1])[None] < lengths[:, None]  # (b, L)
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgl,blkd->bkgd", probs,
+                     cache_v.astype(jnp.float32))
+    return out.reshape(b, h * hd)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_len"))
+def prefill(params: dict, tokens: jax.Array, length: jax.Array,
+            cfg: LlamaConfig, max_len: int) -> Tuple[jax.Array, dict]:
+    """One padded prompt. tokens: (s,) int32 (padded to a bucket);
+    length: () actual prompt length. Returns (last-token logits (vocab,),
+    per-layer kv padded to max_len: k/v (layers, max_len, kvh, hd))."""
+    s = tokens.shape[0]
+    x = jnp.take(params["embed"], tokens[None], axis=0)  # (1, s, emb)
+    positions = jnp.arange(s, dtype=jnp.int32)[None]
+    rc, rs = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer(x, lp):
+        y = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(y, lp, cfg)
+        q, k = _rope(q, rc, rs), _rope(k, rc, rs)
+        # causal reference attention (prompt lengths are modest; the
+        # pallas flash path stays on the training side)
+        h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        g = h // kvh
+        qg = q[0].reshape(s, kvh, g, hd).astype(jnp.float32)
+        kf = k[0].astype(jnp.float32)  # (s, kvh, hd)
+        scores = jnp.einsum("skgd,lkd->kgsl", qg, kf) / jnp.sqrt(hd)
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        valid = jnp.arange(s)[None, :] < length  # keys within prompt
+        m = causal & valid
+        scores = jnp.where(m[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("kgsl,lkd->skgd", probs,
+                       v[0].astype(jnp.float32))
+        o = o.reshape(1, s, h * hd).astype(x.dtype)
+        x = x + o @ lp["wo"]
+        y = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + ((jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"]))
+                 @ lp["w_down"])
+        return x, (k[0], v[0])
+
+    x, (ks, vs) = lax.scan(layer, x, params["layers"])
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take(x[0], length - 1, axis=0)
+    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    # pad kv (layers, s, kvh, hd) -> (layers, max_len, kvh, hd)
+    pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
+    return logits, {"k": jnp.pad(ks, pad), "v": jnp.pad(vs, pad)}
+
+
+def sample(logits: jax.Array, temps: jax.Array,
+           key: jax.Array) -> jax.Array:
+    """Per-slot sampling ON DEVICE: greedy where temp<=0, else
+    temperature-scaled categorical. Keeping sampling inside the jitted
+    step means each decode ships 4 bytes per slot to the host instead of
+    the full vocab logits — the device->host link (PCIe, or a network
+    tunnel in this environment) must never carry O(vocab) per token."""
+    b = logits.shape[0]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    keys = jax.random.split(key, b)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    drawn = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
+    return jnp.where(temps <= 0, greedy, drawn)
+
+
+def _decode_core(params: dict, cache: dict, tokens: jax.Array,
+                 temps: jax.Array, key: jax.Array,
+                 cfg: LlamaConfig) -> Tuple[jax.Array, dict]:
+    """One token for every slot. tokens: (slots,) int32 (last sampled
+    token per slot); temps: (slots,) f32 sampling temperatures; key: rng
+    for this step; cache["length"]: (slots,) current lengths (cache
+    position of `tokens` = length, appended here). Returns
+    (sampled next tokens (slots,) int32, updated cache)."""
+    b = tokens.shape[0]
+    positions = cache["length"]  # (b,) where the new token goes
+    x = jnp.take(params["embed"], tokens[:, None], axis=0)  # (b, 1, emb)
+    rc, rs = _rope_tables(positions[:, None], cfg.head_dim, cfg.rope_theta)
+
+    def layer(carry, xs):
+        x = carry
+        lp, ck, cv = xs  # ck/cv: (b, L, kvh, hd) this layer's cache
+        y = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(y, lp, cfg)  # (b, 1, ...)
+        q, k = _rope(q, rc, rs), _rope(k, rc, rs)
+        ck = ck.at[jnp.arange(b), positions].set(
+            k[:, 0].astype(ck.dtype))
+        cv = cv.at[jnp.arange(b), positions].set(
+            v[:, 0].astype(cv.dtype))
+        o = _gqa_attend_cached(q[:, 0], ck, cv, positions + 1, cfg)
+        x = x + (o.astype(x.dtype) @ lp["wo"])[:, None]
+        y = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + ((jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"]))
+                 @ lp["w_down"])
+        return x, (ck, cv)
+
+    x, (nk, nv) = lax.scan(layer, x, (params["layers"],
+                                      cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    out = sample(logits, temps, key)
+    return out, {"k": nk, "v": nv, "length": cache["length"] + 1}
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def decode_step(params: dict, cache: dict, tokens: jax.Array,
+                temps: jax.Array, key: jax.Array,
+                cfg: LlamaConfig) -> Tuple[jax.Array, dict]:
+    return _decode_core(params, cache, tokens, temps, key, cfg)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n"), donate_argnums=(1,))
+def decode_steps(params: dict, cache: dict, tokens: jax.Array,
+                 temps: jax.Array, key: jax.Array, cfg: LlamaConfig,
+                 n: int) -> Tuple[jax.Array, dict]:
+    """n chained decode steps in ONE dispatch (lax.scan on device).
+    Amortizes the host<->device roundtrip — essential when the link is
+    a network tunnel (each sync costs a full RTT) and still worthwhile
+    on PCIe. Returns (tokens (n, slots) int32, updated cache). Slots
+    whose request finishes mid-block produce discardable garbage; the
+    caller masks on eos and bounds n by cache headroom."""
+    def body(carry, i):
+        cache, toks = carry
+        out, cache = _decode_core(params, cache, toks, temps,
+                                  jax.random.fold_in(key, i), cfg)
+        return (cache, out), out
+
+    (cache, _), outs = lax.scan(body, (cache, tokens),
+                                jnp.arange(n, dtype=jnp.int32))
+    return outs, cache
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def write_prefill_to_cache(cache: dict, kv: dict, slot: jax.Array,
+                           length: jax.Array) -> dict:
+    """Install a prefilled request's KV into `slot`. The cache is
+    donated so XLA updates it in place instead of copying the full
+    (layers, slots, max_len, ...) buffers per admission."""
+    zero = jnp.int32(0)
+    k = lax.dynamic_update_slice(
+        cache["k"], kv["k"][:, None].astype(cache["k"].dtype),
+        (zero, slot, zero, zero, zero))
+    v = lax.dynamic_update_slice(
+        cache["v"], kv["v"][:, None].astype(cache["v"].dtype),
+        (zero, slot, zero, zero, zero))
+    return {"k": k, "v": v,
+            "length": cache["length"].at[slot].set(length)}

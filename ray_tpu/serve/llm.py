@@ -1,0 +1,91 @@
+"""serve.llm: deploy a continuous-batching LLM engine as a deployment.
+
+Analog of the reference's `ray.serve.llm` entry point (reference:
+python/ray/llm/_internal/serve/builders/application_builders.py
+`build_llm_deployment`, deployments/llm/llm_server.py LLMServer) with
+the vLLM engine replaced by the native jax engine in ray_tpu.llm.
+
+    from ray_tpu.serve.llm import LLMConfig, build_llm_deployment
+    app = build_llm_deployment(LLMConfig(model="tiny", max_slots=4))
+    h = serve.run(app, name="llm")
+    out = h.generate.remote([1, 2, 3], max_new_tokens=16).result()
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu.serve.api import Application, deployment
+
+
+@dataclass
+class LLMConfig:
+    """What to serve and how to batch it.
+
+    `model` names a config constructor in ray_tpu.models.llama (e.g.
+    "tiny", "llama2_7b") or is a LlamaConfig; `checkpoint` optionally
+    points at an orbax dir of params — absent, params are randomly
+    initialized (useful for shape/perf work and tests).
+    """
+    model: object = "tiny"
+    model_overrides: dict = field(default_factory=dict)
+    checkpoint: Optional[str] = None
+    max_slots: int = 8
+    max_len: int = 1024
+    prefill_buckets: tuple = (64, 128, 256, 512)
+    cache_dtype: str = "bfloat16"
+    seed: int = 0
+    num_replicas: object = 1
+    max_ongoing_requests: int = 64
+
+
+class _LLMServer:
+    """One engine per replica; requests ride serve's router + the
+    engine's own continuous batching."""
+
+    def __init__(self, cfg: LLMConfig):
+        import jax
+
+        from ray_tpu.llm.engine import LLMEngine
+        from ray_tpu.models import llama
+        model_cfg = cfg.model
+        if isinstance(model_cfg, str):
+            model_cfg = getattr(llama, model_cfg)(**cfg.model_overrides)
+        if cfg.checkpoint:
+            import orbax.checkpoint as ocp
+            params = ocp.StandardCheckpointer().restore(cfg.checkpoint)
+        else:
+            params = llama.init_params(
+                jax.random.PRNGKey(cfg.seed), model_cfg)
+        self.engine = LLMEngine(
+            model_cfg, params, max_slots=cfg.max_slots,
+            max_len=cfg.max_len, prefill_buckets=cfg.prefill_buckets,
+            cache_dtype=cfg.cache_dtype, seed=cfg.seed)
+
+    async def generate(self, tokens, max_new_tokens: int = 64,
+                       temperature: float = 0.0,
+                       eos_id: Optional[int] = None) -> dict:
+        return await self.engine.generate(
+            tokens, max_new_tokens=max_new_tokens,
+            temperature=temperature, eos_id=eos_id)
+
+    async def stats(self) -> dict:
+        return dict(self.engine.stats)
+
+    async def __call__(self, request: dict) -> dict:
+        """HTTP/JSON entry: {"tokens": [...], "max_new_tokens": N}."""
+        return await self.generate(
+            request["tokens"],
+            max_new_tokens=int(request.get("max_new_tokens", 64)),
+            temperature=float(request.get("temperature", 0.0)),
+            eos_id=request.get("eos_id"))
+
+
+def build_llm_deployment(cfg: LLMConfig,
+                         name: str = "LLMServer") -> Application:
+    dep = deployment(
+        _LLMServer, name=name, num_replicas=cfg.num_replicas,
+        max_ongoing_requests=cfg.max_ongoing_requests,
+        route_prefix=f"/{name}")
+    return dep.bind(cfg)
