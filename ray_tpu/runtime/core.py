@@ -779,8 +779,13 @@ class CoreContext:
         finally:
             for t in tasks:
                 t.cancel()
-        ready = [r for r in refs if id(r) in ready_set]
-        pending = [r for r in refs if id(r) not in ready_set]
+        # Exactly num_returns in `ready` even when more resolved in the
+        # same wakeup — callers rely on the reference's contract that
+        # len(ready) <= num_returns; surplus completions stay "pending"
+        # and return instantly on the next wait().
+        ready = [r for r in refs if id(r) in ready_set][:num_returns]
+        ready_ids = {id(r) for r in ready}
+        pending = [r for r in refs if id(r) not in ready_ids]
         return ready, pending
 
     async def _await_ready(self, ref: ObjectRef) -> None:

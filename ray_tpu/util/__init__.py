@@ -1,1 +1,13 @@
-"""Utilities: events/timeline, actor pool, queue, collectives, tpu helpers."""
+"""Utilities: events/timeline, metrics, actor pool, queue, tpu helpers."""
+
+
+def __getattr__(name):
+    # Submodules import lazily so `import ray_tpu.util` stays cheap.
+    if name in ("events", "metrics", "tpu", "queue", "actor_pool",
+                "multiprocessing"):
+        import importlib
+        return importlib.import_module(f"ray_tpu.util.{name}")
+    if name == "ActorPool":
+        from ray_tpu.util.actor_pool import ActorPool
+        return ActorPool
+    raise AttributeError(f"module 'ray_tpu.util' has no attribute {name!r}")

@@ -1,0 +1,103 @@
+"""ActorPool / Queue / multiprocessing.Pool integrations.
+
+Reference shape: python/ray/tests/test_actor_pool.py, test_queue.py,
+test_multiprocessing.py — the library surfaces users reach for first.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class _Doubler:
+    def work(self, x):
+        return x * 2
+
+
+def test_actor_pool_ordered(cluster):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.work.remote(v), range(8)))
+    assert out == [i * 2 for i in range(8)]
+
+
+def test_actor_pool_unordered_and_reuse(cluster):
+    pool = ActorPool([_Doubler.remote() for _ in range(2)])
+    out = sorted(pool.map_unordered(
+        lambda a, v: a.work.remote(v), range(8)))
+    assert out == sorted(i * 2 for i in range(8))
+    # pool is reusable after a full drain
+    assert list(pool.map(lambda a, v: a.work.remote(v), [10])) == [20]
+
+
+def test_actor_pool_submit_get(cluster):
+    pool = ActorPool([_Doubler.remote()])
+    pool.submit(lambda a, v: a.work.remote(v), 3)
+    pool.submit(lambda a, v: a.work.remote(v), 4)  # queued (1 actor)
+    assert pool.has_next()
+    assert pool.get_next(timeout=30) == 6
+    assert pool.get_next(timeout=30) == 8
+    assert not pool.has_next()
+
+
+def test_queue_fifo_across_processes(cluster):
+    q = Queue(maxsize=4)
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return [q.get(timeout=30) for _ in range(n)]
+
+    p = producer.remote(q, 6)
+    c = consumer.remote(q, 6)
+    assert ray_tpu.get(c, timeout=60) == list(range(6))
+    assert ray_tpu.get(p, timeout=60)
+    assert q.empty()
+    q.shutdown()
+
+
+def test_queue_timeouts(cluster):
+    q = Queue(maxsize=1)
+    q.put(1)
+    with pytest.raises(Full):
+        q.put(2, timeout=0.2)
+    with pytest.raises(Full):
+        q.put_nowait(2)
+    assert q.get() == 1
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+def test_mp_pool(cluster):
+    with Pool(processes=4) as p:
+        assert p.map(_sq, range(10)) == [i * i for i in range(10)]
+        assert p.apply(_sq, (7,)) == 49
+        ar = p.apply_async(_sq, (9,))
+        assert ar.get(timeout=30) == 81
+        assert p.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+        assert sorted(p.imap_unordered(_sq, range(5))) == \
+            [0, 1, 4, 9, 16]
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
