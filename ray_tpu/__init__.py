@@ -31,7 +31,8 @@ def __getattr__(name):
                 f"ray_tpu.{name} is unavailable: {e}") from e
         return getattr(api, name)
     if name in ("util", "train", "data", "serve", "tune", "models", "ops",
-                "parallel", "api", "runtime", "dag", "llm"):
+                "parallel", "api", "runtime", "dag", "llm",
+                "job_submission"):
         import importlib
         try:
             return importlib.import_module(f"ray_tpu.{name}")

@@ -55,6 +55,7 @@ class _Global:
         self.owns_loop = False      # driver owns elt; workers reuse theirs
         self.job_id: Optional[JobID] = None
         self.namespace = "default"
+        self.job_runtime_env = None  # init(runtime_env=...) job default
         self.ctx_loop = None        # worker mode: the process's asyncio loop
 
     @property
@@ -123,6 +124,7 @@ def init(address: Optional[str] = None, *,
          num_cpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
          labels: Optional[Dict[str, str]] = None,
+         runtime_env: Optional[dict] = None,
          namespace: str = "default",
          config: Optional[Config] = None,
          system_config: Optional[dict] = None,
@@ -137,6 +139,8 @@ def init(address: Optional[str] = None, *,
     cfg.update(system_config)
     set_config(cfg)
     _g.namespace = namespace
+    from ray_tpu.runtime import runtime_env as _rt
+    _g.job_runtime_env = _rt.validate(runtime_env)
     _g.elt = rpc.EventLoopThread()
     _g.owns_loop = True
     session_id = uuid.uuid4().hex[:16]
@@ -331,6 +335,29 @@ def free(refs: Sequence[ObjectRef]) -> None:
 
 # --- tasks ------------------------------------------------------------------
 
+def _resolve_runtime_env(opts: dict):
+    """Task/actor env over the inherited default (the reference layers
+    job -> parent -> child the same way). Validation does filesystem
+    checks, so callers cache the result per RemoteFunction/ActorClass
+    instead of re-resolving on the hot path."""
+    from ray_tpu.runtime import runtime_env as rt
+    override = rt.validate(opts.get("runtime_env"))
+    return rt.merge(_inherited_runtime_env(), override)
+
+
+def _inherited_runtime_env():
+    """Driver: init(runtime_env=...). Worker: the env it was spawned
+    with (RAY_TPU_RT_ENV), so nested tasks inherit the parent's env."""
+    if _g.job_runtime_env is not None:
+        return _g.job_runtime_env
+    blob = os.environ.get("RAY_TPU_RT_ENV")
+    if blob:
+        import json
+        _g.job_runtime_env = json.loads(blob)
+        return _g.job_runtime_env
+    return None
+
+
 def _norm_resources(opts: dict) -> dict:
     res = dict(opts.get("resources") or {})
     if opts.get("num_cpus") is not None:
@@ -357,6 +384,7 @@ class RemoteFunction:
     def __init__(self, fn: Callable, **default_opts):
         self._fn = fn
         self._opts = default_opts
+        self._rt_env, self._rt_resolved = None, False
         self.__name__ = getattr(fn, "__name__", "remote_fn")
 
     def options(self, **opts) -> "RemoteFunction":
@@ -374,8 +402,18 @@ class RemoteFunction:
             resources=_norm_resources(opts),
             max_retries=opts.get("max_retries"),
             pg=_pg_tuple(opts),
-            policy=opts.get("scheduling_strategy", "default"))
+            policy=opts.get("scheduling_strategy", "default"),
+            runtime_env=self._cached_runtime_env())
         return refs[0] if num_returns == 1 else refs
+
+    def _cached_runtime_env(self):
+        # validate() hits the filesystem; resolve once per instance,
+        # not per .remote() (hot path). A plain flag, not an identity
+        # sentinel — these objects cross pickling into workers.
+        if not self._rt_resolved:
+            self._rt_env = _resolve_runtime_env(self._opts)
+            self._rt_resolved = True
+        return self._rt_env
 
     def __call__(self, *a, **kw):
         raise TypeError(
@@ -438,12 +476,19 @@ class ActorClass:
     def __init__(self, cls, **default_opts):
         self._cls = cls
         self._opts = default_opts
+        self._rt_env, self._rt_resolved = None, False
         self.__name__ = getattr(cls, "__name__", "Actor")
 
     def options(self, **opts) -> "ActorClass":
         merged = dict(self._opts)
         merged.update(opts)
         return ActorClass(self._cls, **merged)
+
+    def _cached_runtime_env(self):
+        if not self._rt_resolved:
+            self._rt_env = _resolve_runtime_env(self._opts)
+            self._rt_resolved = True
+        return self._rt_env
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         ctx = _require_init()
@@ -476,7 +521,8 @@ class ActorClass:
                 max_concurrency=opts.get("max_concurrency", 1),
                 pg=_pg_tuple(opts),
                 scheduling=scheduling or None,
-                lifetime=opts.get("lifetime")))
+                lifetime=opts.get("lifetime"),
+                runtime_env=self._cached_runtime_env()))
         except Exception as e:
             # get_if_exists race: another creator won between our lookup
             # miss and this create — adopt theirs.

@@ -39,6 +39,7 @@ class WorkerHandle:
     actor_resources: Optional[dict] = None
     actor_pg: Optional[tuple] = None           # (pg_id, bundle_index)
     lease_id: Optional[str] = None
+    env_hash: str = ""                         # runtime-env pool key
     ready: asyncio.Event = field(default_factory=asyncio.Event)
 
 
@@ -75,6 +76,7 @@ class NodeAgent:
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self.leases: Dict[str, _Lease] = {}
         self._lease_seq = 0
+        self._worker_claims: Dict[str, int] = {}  # env_hash -> claims
         self._wait_queue: List[Tuple[dict, asyncio.Future]] = []
         self.cluster_view: Dict[NodeID, dict] = {}
         self._view_version = 0
@@ -208,10 +210,18 @@ class NodeAgent:
 
     # --- worker pool ---------------------------------------------------------
 
-    async def _spawn_worker(self) -> Optional[WorkerHandle]:
+    async def _spawn_worker(self, runtime_env: Optional[dict] = None,
+                            env_hash: str = "") -> Optional[WorkerHandle]:
+        from ray_tpu.runtime.runtime_env import apply_to_env
         wid = WorkerID.generate()
         env = dict(os.environ)
         env.update(self.env_extra)
+        env = apply_to_env(runtime_env, env)
+        if runtime_env:
+            # Nested tasks submitted FROM this worker inherit its env
+            # (reference: runtime_env inheritance parent -> child).
+            import json as _json
+            env["RAY_TPU_RT_ENV"] = _json.dumps(runtime_env)
         env.update({
             "RAY_TPU_AGENT_HOST": self.addr[0],
             "RAY_TPU_AGENT_PORT": str(self.addr[1]),
@@ -237,7 +247,7 @@ class NodeAgent:
         finally:
             if stdout is not None:
                 stdout.close()
-        w = WorkerHandle(worker_id=wid, proc=proc)
+        w = WorkerHandle(worker_id=wid, proc=proc, env_hash=env_hash)
         self.workers[wid] = w
         asyncio.ensure_future(self._reap_worker(w))
         try:
@@ -290,40 +300,53 @@ class NodeAgent:
         w.ready.set()
         return {"ok": True}
 
-    def _pop_idle(self) -> Optional[WorkerHandle]:
+    def _pop_idle(self, env_hash: str = "") -> Optional[WorkerHandle]:
         for w in self.workers.values():
-            if w.state == IDLE and w.addr is not None:
+            if w.state == IDLE and w.addr is not None \
+                    and w.env_hash == env_hash:
                 return w
         return None
 
-    _worker_claims = 0
-
-    async def _get_worker(self) -> Optional[WorkerHandle]:
-        """Pop an idle worker, else spawn — but claim a worker already
-        mid-boot before spawning an (n+1)th: process startup pays a ~2s
-        interpreter+plugin import, and concurrent spawns contend on CPU
-        (the reference's worker pool likewise prefers its starting
-        workers, raylet/worker_pool.cc PopWorker)."""
-        self._worker_claims += 1
+    async def _get_worker(self, runtime_env: Optional[dict] = None,
+                          env_hash: str = "") -> Optional[WorkerHandle]:
+        """Pop an idle worker (from this runtime env's pool), else spawn
+        — but claim a worker already mid-boot before spawning an (n+1)th:
+        process startup pays a ~2s interpreter+plugin import, and
+        concurrent spawns contend on CPU (the reference's worker pool
+        likewise prefers its starting workers and keys pools by
+        runtime_env_hash, raylet/worker_pool.cc PopWorker)."""
+        self._worker_claims[env_hash] = \
+            self._worker_claims.get(env_hash, 0) + 1
         try:
             loop = asyncio.get_running_loop()
             deadline = loop.time() + self.config.worker_start_timeout_s
             while True:
-                w = self._pop_idle()
+                w = self._pop_idle(env_hash)
                 if w is not None:
                     return w
                 live = [x for x in self.workers.values()
                         if x.state != DEAD]
                 if len(live) >= self.config.max_workers_per_node:
-                    return None
-                starting = sum(1 for x in live if x.state == STARTING)
-                if starting < self._worker_claims:
-                    return await self._spawn_worker()
+                    # Pool saturated: evict an idle worker of ANOTHER
+                    # runtime env — otherwise an env-A-full node could
+                    # never serve env-B work (the reference's pool kills
+                    # idle workers to make room the same way).
+                    victim = next(
+                        (x for x in live if x.state == IDLE
+                         and x.env_hash != env_hash), None)
+                    if victim is None:
+                        return None
+                    await self._kill_worker(victim)
+                    continue
+                starting = sum(1 for x in live if x.state == STARTING
+                               and x.env_hash == env_hash)
+                if starting < self._worker_claims.get(env_hash, 0):
+                    return await self._spawn_worker(runtime_env, env_hash)
                 if loop.time() > deadline:
                     return None
                 await asyncio.sleep(0.02)
         finally:
-            self._worker_claims -= 1
+            self._worker_claims[env_hash] -= 1
 
     # --- leases (task scheduling) --------------------------------------------
 
@@ -392,7 +415,8 @@ class NodeAgent:
     async def request_lease(self, resources: dict, pg_id=None,
                             bundle_index=None, policy: str = "default",
                             allow_spillback: bool = True,
-                            timeout: Optional[float] = None):
+                            timeout: Optional[float] = None,
+                            runtime_env: Optional[dict] = None):
         """Grant a worker lease (reference: NodeManager::
         HandleRequestWorkerLease -> ClusterLeaseManager). Reply is one of
         {granted, spillback, error}."""
@@ -430,7 +454,8 @@ class NodeAgent:
                     fut, timeout or self.config.lease_timeout_s)
             except asyncio.TimeoutError:
                 return {"error": "lease timeout"}
-        w = await self._get_worker()
+        from ray_tpu.runtime.runtime_env import env_hash as _ehash
+        w = await self._get_worker(runtime_env, _ehash(runtime_env))
         if w is None:
             self._release_res(resources, pg_id, bundle_index)
             self._drain_queue()
@@ -487,7 +512,8 @@ class NodeAgent:
     # --- actors ---------------------------------------------------------------
 
     async def start_actor(self, actor_id: ActorID, creation_spec: bytes,
-                          resources: dict):
+                          resources: dict,
+                          runtime_env: Optional[dict] = None):
         resources = dict(resources or {})
         pg_id = None
         bundle_index = None
@@ -508,7 +534,8 @@ class NodeAgent:
                 return {"ok": False,
                         "error": f"insufficient resources for actor "
                                  f"{resources} (timed out queued)"}
-        w = await self._get_worker()
+        from ray_tpu.runtime.runtime_env import env_hash as _ehash
+        w = await self._get_worker(runtime_env, _ehash(runtime_env))
         if w is None:
             self._release_res(resources, pg_id, bundle_index)
             return {"ok": False, "error": "no worker available"}

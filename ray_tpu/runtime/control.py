@@ -56,6 +56,7 @@ class ActorInfo:
     namespace: str = "default"
     pg: Optional[tuple] = None                 # (pg_id, bundle_index)
     max_concurrency: int = 1                   # callers batch iff == 1
+    runtime_env: Optional[dict] = None
 
 
 @dataclass
@@ -114,6 +115,7 @@ class ControlService:
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
         self.kv: Dict[str, bytes] = {}
         self.jobs: Dict[JobID, dict] = {}
+        self.submitted_jobs: Dict[str, dict] = {}
         self.pgs: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         # object directory: oid -> {node_id: size}
         self.object_locations: Dict[ObjectID, Dict[NodeID, int]] = {}
@@ -144,6 +146,11 @@ class ControlService:
             "register_job": self.register_job,
             "finish_job": self.finish_job,
             "list_jobs": self.list_jobs,
+            "submit_job": self.submit_job,
+            "get_submitted_job": self.get_submitted_job,
+            "list_submitted_jobs": self.list_submitted_jobs,
+            "stop_submitted_job": self.stop_submitted_job,
+            "submitted_job_logs": self.submitted_job_logs,
             "create_pg": self.create_pg,
             "remove_pg": self.remove_pg,
             "get_pg": self.get_pg,
@@ -313,7 +320,8 @@ class ControlService:
                              creation_spec: bytes, namespace: str = "default",
                              scheduling: Optional[dict] = None,
                              pg: Optional[tuple] = None,
-                             max_concurrency: int = 1):
+                             max_concurrency: int = 1,
+                             runtime_env: Optional[dict] = None):
         if name:
             key = (namespace, name)
             if key in self.named_actors:
@@ -327,7 +335,8 @@ class ControlService:
                          max_restarts=max_restarts,
                          creation_spec=creation_spec, namespace=namespace,
                          pg=tuple(pg) if pg else None,
-                         max_concurrency=int(max_concurrency))
+                         max_concurrency=int(max_concurrency),
+                         runtime_env=runtime_env)
         self.actors[actor_id] = info
         node = await self._schedule_actor(info, scheduling or {})
         if node is None:
@@ -392,7 +401,7 @@ class ControlService:
             r = await self.pool.call(
                 node.addr, "start_actor", timeout=120.0,
                 actor_id=info.actor_id, creation_spec=info.creation_spec,
-                resources=resources)
+                resources=resources, runtime_env=info.runtime_env)
             if not r.get("ok"):
                 await self._on_actor_death(
                     info, r.get("error", "agent failed to start actor"))
@@ -517,6 +526,105 @@ class ControlService:
 
     async def list_jobs(self):
         return list(self.jobs.values())
+
+    # --- job submission (entrypoint jobs) -----------------------------------
+    # The head runs submitted entrypoints as driver subprocesses, tracks
+    # their lifecycle, and captures logs (reference:
+    # dashboard/modules/job/job_manager.py:62 JobManager.submit_job —
+    # REST replaced by the same RPC plane everything else uses).
+
+    async def submit_job(self, entrypoint: str, submission_id=None,
+                         runtime_env: Optional[dict] = None):
+        import os
+        import tempfile
+        import uuid as _uuid
+
+        from ray_tpu.runtime.runtime_env import apply_to_env
+        sub_id = submission_id or f"rtjob-{_uuid.uuid4().hex[:10]}"
+        if sub_id in self.submitted_jobs and \
+                self.submitted_jobs[sub_id]["status"] in (
+                    "PENDING", "RUNNING"):
+            return {"ok": False, "error": f"job {sub_id!r} already active"}
+        log_dir = self.config.log_dir or tempfile.gettempdir()
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, f"job-{sub_id}.log")
+        env = apply_to_env(runtime_env, dict(os.environ))
+        # Entrypoints can import what the head can (ray_tpu itself,
+        # notably) — python puts the SCRIPT's dir on sys.path, not cwd.
+        import sys
+        entries = [p if p else os.getcwd() for p in sys.path]
+        prev = env.get("PYTHONPATH", "")  # user py_modules stay first
+        env["PYTHONPATH"] = os.pathsep.join(
+            dict.fromkeys(([prev] if prev else []) + entries))
+        env["RAY_TPU_ADDRESS"] = f"{self.addr[0]}:{self.addr[1]}"
+        env["RAY_TPU_SUBMISSION_ID"] = sub_id
+        cwd = (runtime_env or {}).get("working_dir")
+        logf = open(log_path, "ab", buffering=0)
+        try:
+            proc = await asyncio.create_subprocess_shell(
+                entrypoint, env=env, cwd=cwd or None,
+                stdout=logf, stderr=logf,
+                start_new_session=True)
+        except OSError as e:
+            logf.close()
+            return {"ok": False, "error": f"spawn failed: {e}"}
+        finally:
+            logf.close()
+        job = {"submission_id": sub_id, "entrypoint": entrypoint,
+               "status": "RUNNING", "pid": proc.pid,
+               "log_path": log_path, "start_time": time.time()}
+        self.submitted_jobs[sub_id] = job
+        asyncio.ensure_future(self._watch_job(job, proc))
+        return {"ok": True, "submission_id": sub_id}
+
+    async def _watch_job(self, job: dict, proc):
+        rc = await proc.wait()
+        # The watcher is the single writer of terminal states: a stop
+        # request only marks intent, so a job that happened to exit 0
+        # before the signal landed still reports SUCCEEDED.
+        if rc == 0:
+            job["status"] = "SUCCEEDED"
+        elif job.get("stop_requested"):
+            job["status"] = "STOPPED"
+        else:
+            job["status"] = "FAILED"
+        job["returncode"] = rc
+        job["end_time"] = time.time()
+
+    async def get_submitted_job(self, submission_id: str):
+        return self.submitted_jobs.get(submission_id)
+
+    async def list_submitted_jobs(self):
+        return list(self.submitted_jobs.values())
+
+    async def stop_submitted_job(self, submission_id: str):
+        import signal
+        job = self.submitted_jobs.get(submission_id)
+        if job is None:
+            return {"ok": False, "error": "no such job"}
+        if job["status"] in ("SUCCEEDED", "FAILED", "STOPPED"):
+            return {"ok": True, "status": job["status"]}
+        job["stop_requested"] = True
+        try:
+            import os
+            os.killpg(job["pid"], signal.SIGTERM)
+        except (OSError, ProcessLookupError):
+            pass
+        return {"ok": True, "status": "STOPPING"}
+
+    async def submitted_job_logs(self, submission_id: str,
+                                 tail_bytes: int = 1 << 20):
+        job = self.submitted_jobs.get(submission_id)
+        if job is None:
+            return None
+        try:
+            with open(job["log_path"], "rb") as f:
+                f.seek(0, 2)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
 
     # --- placement groups ---------------------------------------------------
 

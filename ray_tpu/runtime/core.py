@@ -245,21 +245,24 @@ class LeasePool:
         self._reaper: Optional[asyncio.Task] = None
 
     @staticmethod
-    def shape_key(resources: dict, pg, policy: str = "default") -> tuple:
+    def shape_key(resources: dict, pg, policy: str = "default",
+                  env_key=None) -> tuple:
         pg_part = (pg[0], pg[1]) if pg else None
-        return (tuple(sorted(resources.items())), pg_part, policy)
+        return (tuple(sorted(resources.items())), pg_part, policy,
+                env_key)
 
     async def acquire(self, resources: dict,
                       pg: Optional[tuple] = None,
-                      policy: str = "default") -> _LeasedWorker:
+                      policy: str = "default",
+                      env_key=None) -> _LeasedWorker:
         if self._reaper is None:
             self._reaper = asyncio.ensure_future(self._reap_loop())
-        key = self.shape_key(resources, pg, policy)
+        key = self.shape_key(resources, pg, policy, env_key)
         sp = self._pools.setdefault(key, _ShapePool())
         if policy == "spread":
             # True spreading: one fresh lease per task, rotated by the
             # agents' round-robin — no reuse that would pin one node.
-            lw = await self._lease_now(resources, pg, policy)
+            lw = await self._lease_now(resources, pg, policy, env_key)
             lw.key = key
             lw.inflight = 1
             sp.workers.append(lw)
@@ -278,7 +281,9 @@ class LeasePool:
         self._maybe_request_leases(key, sp)
         return await fut
 
-    async def _lease_now(self, resources, pg, policy) -> _LeasedWorker:
+    async def _lease_now(self, resources, pg, policy,
+                         env_key=None) -> _LeasedWorker:
+        from ray_tpu.runtime.runtime_env import from_key
         addr = self.ctx.agent_addr
         pg_id = pg[0] if pg else None
         bundle_index = pg[1] if pg else None
@@ -287,6 +292,7 @@ class LeasePool:
                 addr, "request_lease", resources=resources,
                 pg_id=pg_id, bundle_index=bundle_index, policy=policy,
                 allow_spillback=(hop == 0),
+                runtime_env=from_key(env_key),
                 timeout=self.ctx.config.lease_timeout_s + 30.0)
             if "spillback" in r:
                 addr = tuple(r["spillback"])
@@ -310,8 +316,9 @@ class LeasePool:
 
     async def _request_lease(self, key: tuple, sp: _ShapePool):
         resources, pg, policy = dict(key[0]), key[1], key[2]
+        env_key = key[3] if len(key) > 3 else None
         try:
-            lw = await self._lease_now(resources, pg, policy)
+            lw = await self._lease_now(resources, pg, policy, env_key)
             lw.key = key
             # Demand may have drained while this request was queued at the
             # agent: a surplus lease would sit idle holding resources until
@@ -824,7 +831,9 @@ class CoreContext:
                          resources: Optional[dict] = None,
                          max_retries: Optional[int] = None,
                          pg: Optional[tuple] = None,
-                         policy: str = "default") -> List[ObjectRef]:
+                         policy: str = "default",
+                         runtime_env: Optional[dict] = None
+                         ) -> List[ObjectRef]:
         """Thread-safe submission from the sync API: serialization runs on
         the caller's thread (off the event loop), then scheduling hops to
         the loop with one call_soon_threadsafe — no per-call round trip
@@ -842,7 +851,9 @@ class CoreContext:
         digest = self.fn_cache.digest_for(fn)
         args_frame = dumps_oob((args, kwargs))
         spec = _TaskSpec(task_id, digest, args_frame, oids, retries)
-        key = LeasePool.shape_key(resources, pg, policy)
+        from ray_tpu.runtime.runtime_env import to_key
+        key = LeasePool.shape_key(resources, pg, policy,
+                                  to_key(runtime_env))
         # Dependency resolution happens owner-side BEFORE the task takes a
         # lease (reference: task dependency manager gates scheduling,
         # raylet/dependency_manager.h). Otherwise a task blocking on its
@@ -905,6 +916,7 @@ class CoreContext:
     async def _task_pump(self, key: tuple, st: dict):
         q = st["q"]
         resources, pg, policy = dict(key[0]), key[1], key[2]
+        env_key = key[3] if len(key) > 3 else None
         try:
             while q:
                 if policy == "spread":
@@ -916,7 +928,7 @@ class CoreContext:
                     spec = q.popleft()
                     try:
                         lw = await self.leases.acquire(
-                            resources, pg, policy)
+                            resources, pg, policy, env_key)
                     except Exception as e:  # noqa: BLE001
                         if _lease_err_transient(e):
                             # Same wait-indefinitely semantics as the
@@ -936,7 +948,8 @@ class CoreContext:
                         st["sending"] -= 1
                     continue
                 try:
-                    lw = await self.leases.acquire(resources, pg, policy)
+                    lw = await self.leases.acquire(resources, pg, policy,
+                                                   env_key)
                 except Exception as e:  # noqa: BLE001 — scheduling failure
                     # The lease pool absorbs transient errors internally
                     # (waiting tasks stay queued); anything surfacing
@@ -1051,7 +1064,9 @@ class CoreContext:
                            max_concurrency: int = 1,
                            pg: Optional[tuple] = None,
                            scheduling: Optional[dict] = None,
-                           lifetime: Optional[str] = None) -> "ActorID":
+                           lifetime: Optional[str] = None,
+                           runtime_env: Optional[dict] = None
+                           ) -> "ActorID":
         import cloudpickle
         actor_id = ActorID.generate()
         resources = dict(resources if resources is not None else {"CPU": 1.0})
@@ -1068,7 +1083,7 @@ class CoreContext:
             resources=resources, max_restarts=max_restarts,
             creation_spec=creation_spec, namespace=namespace,
             scheduling=scheduling, pg=pg,
-            max_concurrency=max_concurrency)
+            max_concurrency=max_concurrency, runtime_env=runtime_env)
         self._actor_mc[actor_id] = max_concurrency
         if not r.get("ok"):
             raise ActorError(r.get("error", "actor registration failed"))

@@ -1,0 +1,124 @@
+"""Runtime environments: per-task/actor worker environment isolation.
+
+Scoped analog of the reference's runtime_env plugin system (reference:
+python/ray/_private/runtime_env/plugin.py, runtime_env/agent/main.py):
+supported fields are `env_vars`, `working_dir` (a local path the worker
+chdirs into), and `py_modules` (paths prepended to PYTHONPATH). Workers
+are pooled PER runtime env — a task never executes in a worker carrying
+another env's variables (reference keys its worker pool the same way,
+raylet/worker_pool.cc runtime_env_hash). Network-dependent fields (pip,
+conda, container, uv) are rejected up front: this runtime targets
+hermetic TPU pods where images carry the deps.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional
+
+SUPPORTED = ("env_vars", "working_dir", "py_modules")
+UNSUPPORTED = ("pip", "conda", "container", "uv", "java_jars")
+
+
+def validate(runtime_env: Optional[dict]) -> Optional[dict]:
+    """Normalize + validate; returns a canonical dict or None."""
+    if not runtime_env:
+        return None
+    bad = [k for k in runtime_env if k in UNSUPPORTED]
+    if bad:
+        raise ValueError(
+            f"runtime_env fields {bad} are not supported (no package "
+            f"installation at task time — bake dependencies into the "
+            f"image); supported: {list(SUPPORTED)}")
+    unknown = [k for k in runtime_env if k not in SUPPORTED]
+    if unknown:
+        raise ValueError(f"unknown runtime_env fields {unknown}; "
+                         f"supported: {list(SUPPORTED)}")
+    out = {}
+    ev = runtime_env.get("env_vars")
+    if ev:
+        if not all(isinstance(k, str) and isinstance(v, str)
+                   for k, v in ev.items()):
+            raise ValueError("env_vars must be Dict[str, str]")
+        out["env_vars"] = dict(sorted(ev.items()))
+    wd = runtime_env.get("working_dir")
+    if wd:
+        wd = os.path.abspath(wd)
+        if not os.path.isdir(wd):
+            raise ValueError(f"working_dir {wd!r} is not a directory")
+        out["working_dir"] = wd
+    mods = runtime_env.get("py_modules")
+    if mods:
+        mods = [os.path.abspath(m) for m in mods]
+        for m in mods:
+            if not os.path.exists(m):
+                raise ValueError(f"py_modules path {m!r} does not exist")
+        out["py_modules"] = sorted(mods)
+    return out or None
+
+
+def merge(base: Optional[dict], override: Optional[dict]) -> Optional[dict]:
+    """Job-level base + task-level override (override's env_vars win)."""
+    if not base:
+        return override
+    if not override:
+        return base
+    out = dict(base)
+    for k, v in override.items():
+        if k == "env_vars":
+            out["env_vars"] = {**base.get("env_vars", {}), **v}
+        else:
+            out[k] = v
+    return out
+
+
+def to_key(runtime_env: Optional[dict]):
+    """Hashable form for lease-pool shape keys."""
+    if not runtime_env:
+        return None
+    return tuple(
+        (k, tuple(v.items()) if isinstance(v, dict)
+         else tuple(v) if isinstance(v, list) else v)
+        for k, v in sorted(runtime_env.items()))
+
+
+def from_key(key) -> Optional[dict]:
+    if key is None:
+        return None
+    out = {}
+    for k, v in key:
+        if k == "env_vars":
+            out[k] = dict(v)
+        elif k == "py_modules":
+            out[k] = list(v)
+        else:
+            out[k] = v
+    return out
+
+
+def env_hash(runtime_env: Optional[dict]) -> str:
+    """Stable worker-pool key ('' = plain base environment)."""
+    if not runtime_env:
+        return ""
+    blob = json.dumps(runtime_env, sort_keys=True).encode()
+    return hashlib.sha1(blob).hexdigest()[:16]
+
+
+def apply_to_env(runtime_env: Optional[dict], env: dict) -> dict:
+    """Fold a runtime env into a worker's process environment."""
+    if not runtime_env:
+        return env
+    env = dict(env)
+    env.update(runtime_env.get("env_vars", {}))
+    paths = list(runtime_env.get("py_modules", []))
+    wd = runtime_env.get("working_dir")
+    if wd:
+        paths.append(wd)
+        env["RAY_TPU_RT_WORKING_DIR"] = wd
+    if paths:
+        prev = env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = os.pathsep.join(
+            paths + ([prev] if prev else []))
+    return env

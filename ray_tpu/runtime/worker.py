@@ -307,6 +307,13 @@ class WorkerExecutor:
 
 
 async def _amain():
+    wd = os.environ.get("RAY_TPU_RT_WORKING_DIR")
+    if wd:
+        # working_dir is NOT synced across nodes (no shared-fs
+        # assumption): create it empty where absent rather than
+        # crash-looping the worker on a remote node.
+        os.makedirs(wd, exist_ok=True)
+        os.chdir(wd)
     head = (os.environ["RAY_TPU_HEAD_HOST"],
             int(os.environ["RAY_TPU_HEAD_PORT"]))
     agent = (os.environ["RAY_TPU_AGENT_HOST"],

@@ -234,6 +234,39 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_job(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+    addr = _resolve_address(args)
+    with JobSubmissionClient(addr) as client:
+        if args.job_cmd == "submit":
+            runtime_env = json.loads(args.runtime_env) \
+                if args.runtime_env else None
+            sid = client.submit_job(entrypoint=" ".join(args.entrypoint),
+                                    runtime_env=runtime_env,
+                                    submission_id=args.submission_id)
+            print(sid)
+            if args.wait:
+                st = client.wait_until_finish(sid, timeout=args.timeout)
+                print(st)
+                sys.stdout.write(client.get_job_logs(sid))
+                return 0 if st == "SUCCEEDED" else 1
+            return 0
+        if args.job_cmd == "status":
+            print(client.get_job_status(args.submission_id))
+            return 0
+        if args.job_cmd == "logs":
+            sys.stdout.write(client.get_job_logs(args.submission_id))
+            return 0
+        if args.job_cmd == "stop":
+            ok = client.stop_job(args.submission_id)
+            print("stopped" if ok else "failed")
+            return 0 if ok else 1
+        for j in client.list_jobs():
+            print(f"{j['submission_id']}  {j['status']}  "
+                  f"{j['entrypoint']!r}")
+        return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -279,6 +312,28 @@ def main(argv=None) -> int:
     pm = sub.add_parser("metrics", help="dump a node's /metrics")
     pm.add_argument("--endpoint", help="host:port (default: latest local)")
     pm.set_defaults(fn=cmd_metrics)
+
+    pj = sub.add_parser("job", help="submit / inspect entrypoint jobs")
+    jsub = pj.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("entrypoint", nargs="+",
+                    help="shell command, e.g. -- python train.py")
+    js.add_argument("--address")
+    js.add_argument("--runtime-env", dest="runtime_env",
+                    help="JSON: env_vars / working_dir")
+    js.add_argument("--submission-id", dest="submission_id")
+    js.add_argument("--wait", action="store_true",
+                    help="block until the job finishes; print logs")
+    js.add_argument("--timeout", type=float, default=600.0)
+    js.set_defaults(fn=cmd_job)
+    for name in ("status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("submission_id")
+        jp.add_argument("--address")
+        jp.set_defaults(fn=cmd_job)
+    jl = jsub.add_parser("list")
+    jl.add_argument("--address")
+    jl.set_defaults(fn=cmd_job)
 
     args = p.parse_args(argv)
     if args.cmd == "start" and not args.head and not args.address:

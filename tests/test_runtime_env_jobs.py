@@ -1,0 +1,152 @@
+"""Runtime environments + job submission.
+
+Reference shape: python/ray/tests/test_runtime_env.py (env_vars,
+working_dir, per-env worker isolation) and
+dashboard/modules/job/tests/test_job_manager.py (submit/status/logs/stop).
+"""
+
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime.runtime_env import env_hash, merge, validate
+
+
+def test_validate_and_merge(tmp_path):
+    with pytest.raises(ValueError, match="not supported"):
+        validate({"pip": ["requests"]})
+    with pytest.raises(ValueError, match="unknown"):
+        validate({"envvars": {}})
+    with pytest.raises(ValueError, match="Dict\\[str, str\\]"):
+        validate({"env_vars": {"A": 1}})
+    assert validate(None) is None
+    assert validate({}) is None
+    rt = validate({"env_vars": {"B": "2", "A": "1"},
+                   "working_dir": str(tmp_path)})
+    assert rt == {"env_vars": {"A": "1", "B": "2"},
+                  "working_dir": str(tmp_path)}
+    m = merge(rt, {"env_vars": {"A": "9"}})
+    assert m["env_vars"] == {"A": "9", "B": "2"}
+    assert m["working_dir"] == str(tmp_path)
+    assert env_hash(rt) != env_hash(m) != ""
+    assert env_hash(None) == ""
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=6)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_env_vars_and_isolation(cluster):
+    @ray_tpu.remote
+    def read(k):
+        return os.environ.get(k)
+
+    a = read.options(
+        runtime_env={"env_vars": {"RT_TEST_FLAG": "alpha"}}).remote(
+            "RT_TEST_FLAG")
+    b = read.options(
+        runtime_env={"env_vars": {"RT_TEST_FLAG": "beta"}}).remote(
+            "RT_TEST_FLAG")
+    plain = read.remote("RT_TEST_FLAG")
+    assert ray_tpu.get([a, b, plain], timeout=120) == \
+        ["alpha", "beta", None]
+
+
+def test_working_dir_and_py_modules(cluster, tmp_path):
+    mod = tmp_path / "rt_env_probe_mod.py"
+    mod.write_text("VALUE = 'from-py-module'\n")
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    (wd / "data.txt").write_text("wd-file")
+
+    @ray_tpu.remote
+    def probe():
+        import rt_env_probe_mod
+        with open("data.txt") as f:
+            return rt_env_probe_mod.VALUE, f.read(), os.getcwd()
+
+    v, data, cwd = ray_tpu.get(probe.options(runtime_env={
+        "working_dir": str(wd),
+        "py_modules": [str(tmp_path)]}).remote(), timeout=120)
+    assert v == "from-py-module"
+    assert data == "wd-file"
+    assert cwd == str(wd)
+
+
+def test_actor_runtime_env(cluster):
+    @ray_tpu.remote
+    class EnvActor:
+        def read(self, k):
+            return os.environ.get(k)
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"ACTOR_ENV": "yes"}}).remote()
+    assert ray_tpu.get(a.read.remote("ACTOR_ENV"), timeout=120) == "yes"
+
+
+def test_unsupported_runtime_env_raises(cluster):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="not supported"):
+        f.options(runtime_env={"pip": ["x"]}).remote()
+
+
+def test_job_submission_end_to_end(tmp_path):
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    try:
+        script = tmp_path / "driver.py"
+        script.write_text(textwrap.dedent("""
+            import os
+            import ray_tpu
+            ray_tpu.init(address=os.environ["RAY_TPU_ADDRESS"])
+
+            @ray_tpu.remote
+            def sq(x):
+                return x * x
+
+            print("RESULT:", ray_tpu.get([sq.remote(i) for i in range(4)],
+                                         timeout=60))
+            print("MODE:", os.environ.get("JOB_MODE"))
+            ray_tpu.shutdown()
+        """))
+        with JobSubmissionClient(c.address) as client:
+            sid = client.submit_job(
+                entrypoint=f"{sys.executable} {script}",
+                runtime_env={"env_vars": {"JOB_MODE": "prod",
+                                          "PYTHONPATH":
+                                          os.pathsep.join(sys.path)}})
+            st = client.wait_until_finish(sid, timeout=180)
+            logs = client.get_job_logs(sid)
+            assert st == "SUCCEEDED", logs
+            assert "RESULT: [0, 1, 4, 9]" in logs
+            assert "MODE: prod" in logs
+            assert any(j["submission_id"] == sid
+                       for j in client.list_jobs())
+
+            # stop a long-running job
+            sid2 = client.submit_job(
+                entrypoint=f"{sys.executable} -c 'import time; "
+                           f"time.sleep(600)'")
+            time.sleep(0.5)
+            assert client.stop_job(sid2)
+            deadline = time.time() + 30
+            while time.time() < deadline and \
+                    client.get_job_status(sid2) not in (
+                        "STOPPED", "FAILED"):
+                time.sleep(0.2)
+            assert client.get_job_status(sid2) in ("STOPPED", "FAILED")
+    finally:
+        c.shutdown()
