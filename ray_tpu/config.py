@@ -44,6 +44,7 @@ class Config:
     scheduler_policy: str = "hybrid"        # hybrid | spread | random
     hybrid_local_threshold: float = 0.5     # pack locally until this utilization
     lease_timeout_s: float = 30.0
+    infeasible_wait_window_s: float = 10.0  # grace for joining/scaled nodes
 
     # --- object plane ---
     inline_object_max_bytes: int = 100 * 1024   # small objects ride RPC replies
@@ -64,6 +65,11 @@ class Config:
     default_max_task_retries: int = 3
     default_max_actor_restarts: int = 0
     actor_call_queue_depth: int = 10_000
+
+    # --- memory monitor (0 = disabled) ---
+    memory_monitor_interval_s: float = 0.0
+    memory_usage_threshold: float = 0.95    # node-wide usage fraction
+    worker_rss_limit_bytes: int = 0         # per-worker hard cap
 
     # --- observability ---
     event_buffer_size: int = 65536

@@ -131,12 +131,17 @@ class NodeAgent:
             self._metrics_held = True
         for _ in range(self.config.num_workers_prestart):
             asyncio.ensure_future(self._spawn_worker())
+        if self.config.memory_monitor_interval_s > 0:
+            self._mem_task = asyncio.ensure_future(
+                self._memory_monitor_loop())
         return self.addr
 
     async def stop(self):
         self._stopping = True
         if self._hb_task:
             self._hb_task.cancel()
+        if getattr(self, "_mem_task", None):
+            self._mem_task.cancel()
         from ray_tpu.util import metrics as _m
         if getattr(self, "_collector", None) is not None:
             _m.unregister_collector(self._collector)
@@ -177,6 +182,95 @@ class NodeAgent:
         g("object_store_bytes_capacity", st["capacity_bytes"])
         return "\n".join(out)
 
+    # --- memory monitor (OOM killer) ------------------------------------
+    # Analog of the reference's memory_monitor + worker killing policy
+    # (reference: src/ray/common/memory_monitor.h,
+    # raylet/worker_killing_policy.cc): sample worker RSS from /proc;
+    # enforce an optional per-worker cap, and under node-wide memory
+    # pressure kill the largest retriable consumer instead of letting
+    # the kernel OOM-killer take down the agent.
+
+    @staticmethod
+    def _rss_bytes(pid: int) -> int:
+        """Private resident memory: statm resident minus shared pages,
+        so zero-copy reads of the shared object store don't count
+        against the worker (the reference's killing policy likewise
+        excludes shm, memory_monitor.h)."""
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                parts = f.read().split()
+            return (int(parts[1]) - int(parts[2])) * \
+                os.sysconf("SC_PAGE_SIZE")
+        except (OSError, IndexError, ValueError):
+            return 0
+
+    @staticmethod
+    def _node_memory_usage() -> float:
+        """Usage fraction against the tighter of the host and the
+        cgroup limit — inside a memory-limited container the host
+        numbers never trip while the cgroup OOM killer would (the
+        reference reads cgroup limits first for the same reason)."""
+        best = 0.0
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, v = line.split(":", 1)
+                    info[k] = int(v.strip().split()[0]) * 1024
+            best = 1.0 - info["MemAvailable"] / info["MemTotal"]
+        except (OSError, KeyError, ValueError, ZeroDivisionError):
+            pass
+        for cur_p, max_p in (
+                ("/sys/fs/cgroup/memory.current",
+                 "/sys/fs/cgroup/memory.max"),
+                ("/sys/fs/cgroup/memory/memory.usage_in_bytes",
+                 "/sys/fs/cgroup/memory/memory.limit_in_bytes")):
+            try:
+                with open(max_p) as f:
+                    raw = f.read().strip()
+                if raw == "max":
+                    continue
+                limit = int(raw)
+                with open(cur_p) as f:
+                    cur = int(f.read().strip())
+                if 0 < limit < (1 << 60):
+                    best = max(best, cur / limit)
+                break
+            except (OSError, ValueError, ZeroDivisionError):
+                continue
+        return best
+
+    async def _memory_monitor_loop(self):
+        from ray_tpu.util import events
+        while not self._stopping:
+            await asyncio.sleep(self.config.memory_monitor_interval_s)
+            try:
+                victims = []
+                cap = self.config.worker_rss_limit_bytes
+                live = [(w, self._rss_bytes(w.proc.pid))
+                        for w in self.workers.values()
+                        if w.proc is not None and w.state != DEAD]
+                if cap > 0:
+                    victims += [(w, r) for w, r in live if r > cap]
+                thr = self.config.memory_usage_threshold
+                if not victims and 0 < thr < 1 \
+                        and self._node_memory_usage() > thr:
+                    # Prefer killing LEASED task workers (retriable)
+                    # over actors; largest RSS first.
+                    ranked = sorted(
+                        (x for x in live if x[0].state in (LEASED, IDLE)),
+                        key=lambda x: -x[1]) or sorted(
+                        live, key=lambda x: -x[1])
+                    if ranked:
+                        victims = ranked[:1]
+                for w, rss in victims:
+                    events.record(
+                        "memory", "oom_kill", worker=w.worker_id.hex(),
+                        rss=rss, node=self.node_id.hex())
+                    await self._kill_worker(w)
+            except Exception:
+                pass
+
     async def ping(self):
         return "pong"
 
@@ -198,7 +292,10 @@ class NodeAgent:
                 r = await self.pool.call(
                     self.head_addr, "heartbeat", node_id=self.node_id,
                     resources_available=self.available,
-                    version=self._view_version, timeout=10.0)
+                    version=self._view_version,
+                    pending_demand=[req["resources"]
+                                    for req, _ in self._wait_queue],
+                    timeout=10.0)
                 if r.get("view"):
                     self.cluster_view = r["view"]
                 # Reap allocations whose producer died between alloc and
@@ -471,19 +568,31 @@ class NodeAgent:
                             "worker_id": w.worker_id}}
 
     async def _await_feasible_peer(self, resources: dict,
-                                   window_s: float = 10.0):
+                                   window_s: Optional[float] = None):
         """Poll the synced cluster view for a capacity-feasible peer; the
         view refreshes via heartbeat piggyback, so a fresh node sees peers
-        within one heartbeat period."""
-        deadline = asyncio.get_running_loop().time() + min(
-            window_s, self.config.lease_timeout_s)
-        while asyncio.get_running_loop().time() < deadline:
-            await asyncio.sleep(0.2)
-            target = (self._spillback_target(resources)
-                      or self._capacity_target(resources))
-            if target is not None:
-                return target
-        return None
+        within one heartbeat period. While polling, the shape rides the
+        heartbeat's pending_demand so an autoscaler can see demand no
+        current node can fit and launch capacity into the window."""
+        entry = ({"resources": resources}, None)
+        self._wait_queue.append(entry)
+        try:
+            if window_s is None:
+                window_s = self.config.infeasible_wait_window_s
+            deadline = asyncio.get_running_loop().time() + min(
+                window_s, self.config.lease_timeout_s)
+            while asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.2)
+                target = (self._spillback_target(resources)
+                          or self._capacity_target(resources))
+                if target is not None:
+                    return target
+            return None
+        finally:
+            try:
+                self._wait_queue.remove(entry)
+            except ValueError:
+                pass
 
     async def release_lease(self, lease_id: str, worker_died: bool = False):
         lease = self.leases.pop(lease_id, None)
@@ -500,6 +609,9 @@ class NodeAgent:
     def _drain_queue(self):
         still = []
         for req, fut in self._wait_queue:
+            if fut is None:  # demand marker (feasibility poll), not a waiter
+                still.append((req, fut))
+                continue
             if fut.done():
                 continue
             if self._try_acquire(req["resources"], req["pg_id"],

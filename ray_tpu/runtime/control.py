@@ -38,6 +38,8 @@ class NodeInfo:
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
     version: int = 0                   # resource-view version (syncer)
+    pending_demand: List[dict] = field(default_factory=list)
+    drained: bool = False              # deliberate removal: never resurrect
 
 
 @dataclass
@@ -225,7 +227,7 @@ class ControlService:
         return {"ok": True}
 
     async def heartbeat(self, node_id: NodeID, resources_available=None,
-                        version: int = 0):
+                        version: int = 0, pending_demand=None):
         """Liveness + resource-view sync in one beat (reference splits these
         across GcsHealthCheckManager and ray_syncer; one RPC suffices at
         TPU-pod node counts). Reply carries the full cluster resource view
@@ -233,12 +235,17 @@ class ControlService:
         n = self.nodes.get(node_id)
         if n is None:
             return {"ok": False, "unknown": True}
+        if n.drained:
+            # Deliberately removed (scale-down / remove_node): a late
+            # heartbeat from the dying process must not resurrect it.
+            return {"ok": False, "drained": True}
         n.last_heartbeat = time.monotonic()
         if not n.alive:
             n.alive = True  # node came back before we GC'd it
         if resources_available is not None:
             n.resources_available = dict(resources_available)
             n.version = version
+        n.pending_demand = list(pending_demand or [])
         return {"ok": True, "view": self._view()}
 
     def _view(self):
@@ -259,11 +266,15 @@ class ControlService:
             {"node_id": n.node_id, "addr": n.addr, "alive": n.alive,
              "resources_total": n.resources_total,
              "resources_available": n.resources_available,
+             "pending_demand": n.pending_demand,
              "labels": n.labels}
             for n in self.nodes.values()
         ]
 
     async def drain_node(self, node_id: NodeID):
+        n = self.nodes.get(node_id)
+        if n is not None:
+            n.drained = True
         await self._mark_node_dead(node_id, "drained")
         return {"ok": True}
 
