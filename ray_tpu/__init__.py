@@ -32,7 +32,7 @@ def __getattr__(name):
         return getattr(api, name)
     if name in ("util", "train", "data", "serve", "tune", "models", "ops",
                 "parallel", "api", "runtime", "dag", "llm",
-                "job_submission"):
+                "job_submission", "rllib"):
         import importlib
         try:
             return importlib.import_module(f"ray_tpu.{name}")

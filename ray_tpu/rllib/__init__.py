@@ -1,0 +1,11 @@
+"""Minimal RL layer: parallel rollout actors + jitted PPO learner.
+
+Analog of the reference's RLlib core loop (reference: python/ray/rllib/
+algorithms/algorithm.py train() driving env_runner_group + learner_group)
+at the scale of one algorithm done properly on jax.
+"""
+
+from ray_tpu.rllib.env import CartPoleVec, make_env
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+__all__ = ["PPO", "PPOConfig", "CartPoleVec", "make_env"]

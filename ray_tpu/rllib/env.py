@@ -1,0 +1,79 @@
+"""Vectorized environments (no gym dependency).
+
+The env interface mirrors the reference's EnvRunner expectations
+(reference: python/ray/rllib/env/single_agent_env_runner.py): numpy
+in/out, batch-first, auto-reset on termination — the shape that keeps
+the policy's forward pass one batched matmul per step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class CartPoleVec:
+    """Classic cart-pole dynamics, vectorized over `num_envs`.
+
+    Physics per OpenAI's cartpole (public constants); termination at
+    |x|>2.4 or |theta|>12deg or 500 steps; reward 1 per step.
+    """
+
+    OBS_DIM = 4
+    N_ACTIONS = 2
+    MAX_STEPS = 500
+
+    def __init__(self, num_envs: int, seed: int = 0):
+        self.num_envs = num_envs
+        self.rng = np.random.default_rng(seed)
+        self.state = np.zeros((num_envs, 4), np.float32)
+        self.steps = np.zeros(num_envs, np.int32)
+        self.reset_all()
+
+    def reset_all(self) -> np.ndarray:
+        self.state = self.rng.uniform(
+            -0.05, 0.05, size=(self.num_envs, 4)).astype(np.float32)
+        self.steps[:] = 0
+        return self.state.copy()
+
+    def step(self, actions: np.ndarray):
+        """actions: (n,) in {0,1}. Returns (obs, reward, done) with
+        auto-reset: `obs` is the NEXT episode's start where done."""
+        g, mc, mp, length = 9.8, 1.0, 0.1, 0.5
+        force_mag, tau = 10.0, 0.02
+        total_m, pml = mc + mp, mp * length
+
+        x, x_dot, th, th_dot = self.state.T
+        force = np.where(actions == 1, force_mag, -force_mag)
+        cos, sin = np.cos(th), np.sin(th)
+        temp = (force + pml * th_dot ** 2 * sin) / total_m
+        th_acc = (g * sin - cos * temp) / (
+            length * (4.0 / 3.0 - mp * cos ** 2 / total_m))
+        x_acc = temp - pml * th_acc * cos / total_m
+        x = x + tau * x_dot
+        x_dot = x_dot + tau * x_acc
+        th = th + tau * th_dot
+        th_dot = th_dot + tau * th_acc
+        self.state = np.stack([x, x_dot, th, th_dot], axis=1) \
+            .astype(np.float32)
+        self.steps += 1
+
+        done = (np.abs(x) > 2.4) | (np.abs(th) > 12 * np.pi / 180) \
+            | (self.steps >= self.MAX_STEPS)
+        reward = np.ones(self.num_envs, np.float32)
+        if done.any():
+            idx = np.where(done)[0]
+            self.state[idx] = self.rng.uniform(
+                -0.05, 0.05, size=(len(idx), 4)).astype(np.float32)
+            self.steps[idx] = 0
+        return self.state.copy(), reward, done
+
+
+ENVS = {"CartPole-v1": CartPoleVec}
+
+
+def make_env(name: str, num_envs: int, seed: int = 0):
+    try:
+        return ENVS[name](num_envs, seed)
+    except KeyError:
+        raise ValueError(
+            f"unknown env {name!r}; register it in ray_tpu.rllib.env.ENVS")

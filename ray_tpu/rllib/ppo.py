@@ -1,0 +1,269 @@
+"""PPO on jax/optax over runtime rollout actors.
+
+The minimal algorithm slice of the reference's RL layer (reference:
+python/ray/rllib/algorithms/ppo/ppo.py + env_runner_group: N rollout
+workers as actors collect batches in parallel, a learner applies
+clipped-surrogate updates, weights broadcast each iteration), built
+TPU-idiomatically: the policy is a pure-function MLP, GAE and the PPO
+epoch loop are jitted (lax.scan over minibatches), and rollout actors
+run the same jitted policy on their CPUs.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict
+
+import jax
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+
+
+# --- pure-jax policy ----------------------------------------------------
+
+def init_policy(rng, obs_dim: int, n_actions: int, hidden=(64, 64)):
+    params = {}
+    sizes = (obs_dim, *hidden)
+    keys = jax.random.split(rng, len(sizes) + 1)
+    for i in range(len(sizes) - 1):
+        params[f"w{i}"] = jax.random.normal(
+            keys[i], (sizes[i], sizes[i + 1])) * np.sqrt(2 / sizes[i])
+        params[f"b{i}"] = np.zeros(sizes[i + 1], np.float32) + 0.0
+    params["w_pi"] = jax.random.normal(
+        keys[-2], (sizes[-1], n_actions)) * 0.01
+    params["b_pi"] = np.zeros(n_actions, np.float32) + 0.0
+    params["w_v"] = jax.random.normal(keys[-1], (sizes[-1], 1)) * 1.0
+    params["b_v"] = np.zeros(1, np.float32) + 0.0
+    import jax.numpy as jnp
+    return {k: jnp.asarray(v, jnp.float32) for k, v in params.items()}
+
+
+def policy_forward(params, obs):
+    """obs (B, obs_dim) -> (logits (B, A), value (B,))."""
+    import jax.numpy as jnp
+    x = obs
+    i = 0
+    while f"w{i}" in params:
+        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+        i += 1
+    logits = x @ params["w_pi"] + params["b_pi"]
+    value = (x @ params["w_v"] + params["b_v"])[:, 0]
+    return logits, value
+
+
+# --- rollout actor ------------------------------------------------------
+
+@ray_tpu.remote
+class EnvRunner:
+    """Collects fixed-length rollout fragments with the current policy
+    (reference: rllib/env/single_agent_env_runner.py sample())."""
+
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
+                 seed: int):
+        try:
+            # Rollout policy steps are tiny MLP batches issued one at a
+            # time — accelerator dispatch latency dominates any compute
+            # win, so runners pin to the host CPU (the reference's env
+            # runners are CPU-placed for the same reason).
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # backend already initialized in this worker
+            pass
+        self.env = make_env(env_name, num_envs, seed)
+        self.rollout_len = rollout_len
+        self.obs = self.env.reset_all()
+        self.key = jax.random.PRNGKey(seed)
+        self.ep_ret = np.zeros(num_envs, np.float32)
+        self.done_returns = deque(maxlen=100)
+
+        @jax.jit
+        def act(params, obs, key):
+            logits, value = policy_forward(params, obs)
+            a = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[
+                np.arange(obs.shape[0]), a]
+            return a, logp, value
+        self._act = act
+        self._forward = jax.jit(policy_forward)
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        T, N = self.rollout_len, self.env.num_envs
+        out = {k: [] for k in
+               ("obs", "actions", "logp", "rewards", "dones", "values")}
+        for _ in range(T):
+            self.key, k = jax.random.split(self.key)
+            a, logp, v = self._act(params, self.obs, k)
+            a = np.asarray(a)
+            obs2, r, done = self.env.step(a)
+            out["obs"].append(self.obs)
+            out["actions"].append(a)
+            out["logp"].append(np.asarray(logp))
+            out["values"].append(np.asarray(v))
+            out["rewards"].append(r)
+            out["dones"].append(done.astype(np.float32))
+            self.ep_ret += r
+            if done.any():
+                for i in np.where(done)[0]:
+                    self.done_returns.append(float(self.ep_ret[i]))
+                    self.ep_ret[i] = 0.0
+            self.obs = obs2
+        _, last_v = map(np.asarray, self._forward(params, self.obs))
+        batch = {k: np.stack(v) for k, v in out.items()}  # (T, N, ...)
+        batch["last_value"] = np.asarray(last_v)          # (N,)
+        batch["episode_returns"] = np.array(
+            self.done_returns, np.float32)
+        return batch
+
+
+# --- learner ------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("gamma", "lam"))
+def _gae(rewards, values, dones, last_value, gamma, lam):
+    import jax.numpy as jnp
+
+    def step(carry, xs):
+        adv = carry
+        r, v, v_next, d = xs
+        delta = r + gamma * v_next * (1 - d) - v
+        adv = delta + gamma * lam * (1 - d) * adv
+        return adv, adv
+
+    v_next = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    _, advs = jax.lax.scan(
+        step, jnp.zeros_like(last_value),
+        (rewards, values, v_next, dones), reverse=True)
+    return advs, advs + values
+
+
+@partial(jax.jit, static_argnames=("clip", "epochs",
+                                   "minibatches", "lr"))
+def ppo_update(params, opt_state, batch, key, *, lr=3e-4, clip=0.2,
+               epochs=4, minibatches=4, vf_coef=0.5, ent_coef=0.01):
+    import jax.numpy as jnp
+    import optax
+
+    opt = optax.adam(lr)
+
+    obs = batch["obs"].reshape(-1, batch["obs"].shape[-1])
+    acts = batch["actions"].reshape(-1)
+    logp_old = batch["logp"].reshape(-1)
+    advs = batch["advantages"].reshape(-1)
+    rets = batch["returns"].reshape(-1)
+    advs = (advs - advs.mean()) / (advs.std() + 1e-8)
+    n = obs.shape[0]
+    mb = n // minibatches
+
+    def loss_fn(p, idx):
+        logits, value = policy_forward(p, obs[idx])
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(idx.shape[0]), acts[idx]]
+        ratio = jnp.exp(logp - logp_old[idx])
+        unclipped = ratio * advs[idx]
+        clipped = jnp.clip(ratio, 1 - clip, 1 + clip) * advs[idx]
+        pi_loss = -jnp.minimum(unclipped, clipped).mean()
+        v_loss = ((value - rets[idx]) ** 2).mean()
+        probs = jax.nn.softmax(logits)
+        entropy = -(probs * jnp.log(probs + 1e-9)).sum(-1).mean()
+        return pi_loss + vf_coef * v_loss - ent_coef * entropy, \
+            (pi_loss, v_loss, entropy)
+
+    def epoch(carry, k):
+        p, os_ = carry
+        perm = jax.random.permutation(k, n)
+
+        def mini(carry, i):
+            p, os_ = carry
+            idx = jax.lax.dynamic_slice_in_dim(perm, i * mb, mb)
+            (l, aux), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, idx)
+            updates, os_ = opt.update(g, os_, p)
+            p = optax.apply_updates(p, updates)
+            return (p, os_), l
+
+        (p, os_), losses = jax.lax.scan(
+            mini, (p, os_), jnp.arange(minibatches))
+        return (p, os_), losses.mean()
+
+    keys = jax.random.split(key, epochs)
+    (params, opt_state), losses = jax.lax.scan(
+        epoch, (params, opt_state), keys)
+    return params, opt_state, losses.mean()
+
+
+@dataclass
+class PPOConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 8
+    rollout_len: int = 128
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lam: float = 0.95
+    clip: float = 0.2
+    epochs: int = 4
+    minibatches: int = 4
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    runner_options: dict = field(default_factory=dict)
+
+
+class PPO:
+    def __init__(self, config: PPOConfig):
+        import optax
+        self.cfg = config
+        env = make_env(config.env, 1, 0)
+        self.obs_dim, self.n_actions = env.OBS_DIM, env.N_ACTIONS
+        self.params = init_policy(
+            jax.random.PRNGKey(config.seed), self.obs_dim,
+            self.n_actions, config.hidden)
+        self.opt_state = optax.adam(config.lr).init(self.params)
+        self.key = jax.random.PRNGKey(config.seed + 1)
+        self.runners = [
+            EnvRunner.options(**config.runner_options).remote(
+                config.env, config.num_envs_per_runner,
+                config.rollout_len, config.seed + 100 + i)
+            for i in range(config.num_env_runners)]
+        self._iter = 0
+
+    def train(self) -> dict:
+        """One iteration: parallel rollouts -> GAE -> PPO epochs."""
+        import jax.numpy as jnp
+        self._iter += 1
+        host_params = jax.device_get(self.params)
+        batches = ray_tpu.get(
+            [r.sample.remote(host_params) for r in self.runners],
+            timeout=300)
+        cat = {k: np.concatenate([b[k] for b in batches], axis=1)
+               for k in ("obs", "actions", "logp", "rewards", "dones",
+                         "values")}
+        last_v = np.concatenate([b["last_value"] for b in batches])
+        advs, rets = _gae(jnp.asarray(cat["rewards"]),
+                          jnp.asarray(cat["values"]),
+                          jnp.asarray(cat["dones"]),
+                          jnp.asarray(last_v),
+                          self.cfg.gamma, self.cfg.lam)
+        batch = {"obs": jnp.asarray(cat["obs"]),
+                 "actions": jnp.asarray(cat["actions"]),
+                 "logp": jnp.asarray(cat["logp"]),
+                 "advantages": advs, "returns": rets}
+        self.key, k = jax.random.split(self.key)
+        self.params, self.opt_state, loss = ppo_update(
+            self.params, self.opt_state, batch, k,
+            lr=self.cfg.lr, clip=self.cfg.clip, epochs=self.cfg.epochs,
+            minibatches=self.cfg.minibatches)
+        ep = np.concatenate([b["episode_returns"] for b in batches]) \
+            if any(len(b["episode_returns"]) for b in batches) \
+            else np.array([0.0])
+        return {"training_iteration": self._iter,
+                "episode_reward_mean": float(ep.mean()),
+                "loss": float(loss),
+                "timesteps_this_iter": int(
+                    self.cfg.num_env_runners
+                    * self.cfg.num_envs_per_runner
+                    * self.cfg.rollout_len)}
+
+    def get_policy_params(self):
+        return jax.device_get(self.params)

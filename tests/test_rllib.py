@@ -1,0 +1,48 @@
+"""PPO over rollout actors learns CartPole.
+
+Reference shape: python/ray/rllib/algorithms/tests (train loop returns
+growing episode_reward_mean) on the minimal native stack.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import CartPoleVec, PPO, PPOConfig
+
+
+def test_cartpole_vec_dynamics():
+    env = CartPoleVec(4, seed=0)
+    obs = env.reset_all()
+    assert obs.shape == (4, 4)
+    total_done = 0
+    for _ in range(300):
+        obs, r, done = env.step(np.random.default_rng(1).integers(
+            0, 2, size=4))
+        assert r.shape == (4,) and obs.shape == (4, 4)
+        total_done += int(done.sum())
+    assert total_done > 0  # constant-action episodes must terminate
+    assert np.isfinite(obs).all()
+
+
+def test_ppo_learns_cartpole():
+    ray_tpu.init(num_cpus=4)
+    try:
+        algo = PPO(PPOConfig(num_env_runners=2, num_envs_per_runner=8,
+                             rollout_len=128, seed=3))
+        first = None
+        best = -1.0
+        for i in range(18):
+            res = algo.train()
+            assert res["timesteps_this_iter"] == 2 * 8 * 128
+            if first is None and res["episode_reward_mean"] > 0:
+                first = res["episode_reward_mean"]
+            best = max(best, res["episode_reward_mean"])
+        # Random policy scores ~20; a learning one clears 3x that.
+        assert first is not None
+        assert best > max(60.0, 1.5 * first), (first, best)
+        # params are exportable
+        params = algo.get_policy_params()
+        assert any(k.startswith("w") for k in params)
+    finally:
+        ray_tpu.shutdown()
