@@ -55,6 +55,16 @@ class ShmRingChannel:
             self._shm = shared_memory.SharedMemory(name=name)
         self.name = name
         self._seqs = self._shm.buf.cast("Q")  # [0]=write_seq, [8]=read_seq
+        # Native fast path (portable atomics + GIL-free waits + C memcpy);
+        # None -> pure-Python fallback below.
+        from ray_tpu._native import load_ringbuf
+        self._lib = load_ringbuf()
+        self._cbase = None
+        if self._lib is not None:
+            import ctypes
+            self._cbase = ctypes.cast(
+                (ctypes.c_uint8 * size).from_buffer(self._shm.buf),
+                ctypes.POINTER(ctypes.c_uint8))
 
     # seq accessors -----------------------------------------------------
     @property
@@ -81,6 +91,8 @@ class ShmRingChannel:
     def has_space(self) -> bool:
         """True if a write would not block. Only the consumer can change
         this from False to True, so a single producer may rely on it."""
+        if self._lib is not None and self._cbase is not None:
+            return bool(self._lib.rb_has_space(self._cbase, self.nslots))
         return self._wseq - self._rseq < self.nslots
 
     def write(self, payload, kind: int = DATA,
@@ -96,9 +108,35 @@ class ShmRingChannel:
                 f"frame of {n} B exceeds channel slot size "
                 f"{self.slot_bytes} B; compile the dag with a larger "
                 f"slot_bytes")
+        native = self._lib is not None and self._cbase is not None
+        if native and not hasattr(payload, "write_into"):
+            data = bytes(payload)  # n re-derived: a memoryview's len()
+            n = len(data)          # counts items, not bytes
+            if n > self.slot_bytes:
+                raise ValueError(
+                    f"frame of {n} B exceeds channel slot size "
+                    f"{self.slot_bytes} B")
+            rc = self._lib.rb_write(
+                self._cbase, self.nslots, self.slot_bytes,
+                data, n, kind,
+                -1.0 if timeout is None else float(timeout))
+            if rc == -1:
+                raise ChannelTimeout("channel full")
+            return
         seq = self._wseq
-        self._wait(lambda: seq - self._rseq < self.nslots, timeout,
-                   "channel full")
+        if native:
+            # Zero-copy (Serialized) path: block for space in native
+            # code (GIL-free), fill the slot from Python, then publish
+            # WITH a futex wake — a sleeping native reader would
+            # otherwise only notice at its re-check cap.
+            rc = self._lib.rb_wait_space(
+                self._cbase, self.nslots,
+                -1.0 if timeout is None else float(timeout))
+            if rc == -1:
+                raise ChannelTimeout("channel full")
+        else:
+            self._wait(lambda: seq - self._rseq < self.nslots, timeout,
+                       "channel full")
         off = self._slot(seq)
         buf = self._shm.buf
         if hasattr(payload, "write_into"):
@@ -107,13 +145,29 @@ class ShmRingChannel:
             buf[off + SLOT_HDR:off + SLOT_HDR + n] = bytes(payload)
         buf[off:off + 4] = n.to_bytes(4, "little")
         buf[off + 4] = kind
-        self._wseq = seq + 1  # release: makes the slot visible
+        if native:
+            self._lib.rb_publish_write(self._cbase)
+        else:
+            self._wseq = seq + 1  # release: makes the slot visible
 
     # consumer ----------------------------------------------------------
     def read_with(self, fn, timeout: Optional[float] = None):
         """Run fn(kind, memoryview-of-frame) on the next frame WITHOUT
         copying; the slot is released only after fn returns, so the view
         (and anything deserialized zero-copy from it) must not escape."""
+        if self._lib is not None and self._cbase is not None:
+            off = self._lib.rb_wait_readable(  # GIL-free wait
+                self._cbase, self.nslots, self.slot_bytes,
+                -1.0 if timeout is None else float(timeout))
+            if off < 0:
+                raise ChannelTimeout("channel empty")
+            buf = self._shm.buf
+            n = int.from_bytes(buf[off:off + 4], "little")
+            kind = buf[off + 4]
+            try:
+                return fn(kind, buf[off + SLOT_HDR:off + SLOT_HDR + n])
+            finally:
+                self._lib.rb_release(self._cbase)
         seq = self._rseq
         self._wait(lambda: self._wseq > seq, timeout, "channel empty")
         off = self._slot(seq)
@@ -126,6 +180,8 @@ class ShmRingChannel:
             self._rseq = seq + 1  # release the slot for the producer
 
     def read_bytes(self, timeout: Optional[float] = None):
+        # read_with already uses the native GIL-free wait when available
+        # and copies exactly once.
         return self.read_with(lambda k, mv: (k, bytes(mv)), timeout)
 
     @staticmethod
@@ -140,6 +196,7 @@ class ShmRingChannel:
 
     # lifecycle ---------------------------------------------------------
     def close(self):
+        self._cbase = None  # drop the ctypes buffer export first
         try:
             self._seqs.release()
         except Exception:
