@@ -176,8 +176,37 @@ def reset() -> None:
         _COLLECTORS.clear()
 
 
+_DASH_HTML = b"""<!doctype html><html><head><title>ray-tpu</title>
+<style>body{font-family:monospace;margin:2em;background:#111;color:#ddd}
+h1{font-size:1.2em}table{border-collapse:collapse;margin-top:1em}
+td,th{border:1px solid #444;padding:4px 10px;text-align:left}
+th{background:#222}.num{text-align:right}</style></head><body>
+<h1>ray-tpu cluster</h1><div id=t>loading...</div>
+<script>
+async function tick(){
+  const r = await fetch('/metrics'); const text = await r.text();
+  const rows = [];
+  for (const line of text.split('\\n')) {
+    if (!line || line.startsWith('#')) continue;
+    const i = line.lastIndexOf(' ');
+    rows.push([line.slice(0, i), line.slice(i + 1)]);
+  }
+  rows.sort((a, b) => a[0] < b[0] ? -1 : 1);
+  const esc = s => s.replace(/&/g, '&amp;').replace(/</g, '&lt;')
+                    .replace(/>/g, '&gt;');
+  document.getElementById('t').innerHTML =
+    '<table><tr><th>metric</th><th>value</th></tr>' +
+    rows.map(r => `<tr><td>${esc(r[0])}</td>` +
+                  `<td class=num>${esc(r[1])}</td></tr>`)
+        .join('') + '</table>';
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>"""
+
+
 class MetricsServer:
-    """Minimal asyncio HTTP endpoint serving /metrics (and /healthz)."""
+    """Minimal asyncio HTTP endpoint serving /metrics, /healthz, and a
+    live dashboard at /."""
 
     def __init__(self):
         self._server: Optional[asyncio.AbstractServer] = None
@@ -201,6 +230,7 @@ class MetricsServer:
         try:
             req = await asyncio.wait_for(reader.readline(), 10.0)
             path = req.split()[1].decode() if len(req.split()) > 1 else "/"
+            path = path.split("?", 1)[0]
             while True:  # drain headers
                 line = await asyncio.wait_for(reader.readline(), 10.0)
                 if line in (b"\r\n", b"\n", b""):
@@ -211,6 +241,11 @@ class MetricsServer:
                 code = "200 OK"
             elif path.startswith("/healthz"):
                 body, ctype, code = b"ok\n", "text/plain", "200 OK"
+            elif path == "/" or path.startswith("/index"):
+                # Minimal live dashboard (reference ships a full React
+                # dashboard/; this renders the same gauges from
+                # /metrics client-side with zero dependencies).
+                body, ctype, code = _DASH_HTML, "text/html", "200 OK"
             else:
                 body, ctype, code = b"not found\n", "text/plain", \
                     "404 Not Found"
