@@ -1,0 +1,92 @@
+"""State API: programmatic cluster introspection.
+
+Analog of the reference's state API (reference:
+python/ray/util/state/api.py list_actors/list_nodes/list_jobs/
+list_placement_groups + summarize helpers): thin typed views over the
+control service's RPCs, usable from any initialized driver/worker.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def _call(method: str, **kw):
+    from ray_tpu import api
+    ctx = api._require_init()
+    return api._run(ctx.pool.call(ctx.head_addr, method, **kw))
+
+
+def list_nodes() -> List[dict]:
+    out = []
+    for n in _call("get_nodes"):
+        out.append({
+            "node_id": n["node_id"].hex(),
+            "alive": n["alive"],
+            "address": f"{n['addr'][0]}:{n['addr'][1]}",
+            "resources_total": n["resources_total"],
+            "resources_available": n["resources_available"],
+            "pending_demand": n.get("pending_demand", []),
+            "labels": n.get("labels", {}),
+        })
+    return out
+
+
+def list_actors(state: Optional[str] = None) -> List[dict]:
+    out = []
+    for a in _call("list_actors"):
+        row = {
+            "actor_id": a["actor_id"].hex(),
+            "state": a.get("state"),
+            "name": a.get("name"),
+            "class_name": a.get("class_name"),
+            "node_id": a["node_id"].hex()
+            if a.get("node_id") is not None else None,
+            "num_restarts": a.get("num_restarts", 0),
+            "death_cause": a.get("death_cause"),
+        }
+        if state is None or row["state"] == state:
+            out.append(row)
+    return out
+
+
+def list_jobs() -> List[dict]:
+    return [{"job_id": j["job_id"].hex(), "state": j.get("state"),
+             "start_time": j.get("start_time"),
+             "end_time": j.get("end_time")}
+            for j in _call("list_jobs")]
+
+
+def list_placement_groups() -> List[dict]:
+    out = []
+    for pg in _call("list_pgs"):
+        out.append({
+            "pg_id": pg["pg_id"].hex()
+            if hasattr(pg.get("pg_id"), "hex") else str(pg.get("pg_id")),
+            "state": pg.get("state"),
+            "strategy": pg.get("strategy"),
+            "bundles": pg.get("bundles"),
+            "name": pg.get("name"),
+        })
+    return out
+
+
+def cluster_summary() -> dict:
+    """One-call roll-up (reference: `ray summary` CLI shape)."""
+    nodes = list_nodes()
+    actors = list_actors()
+    alive = [n for n in nodes if n["alive"]]
+    totals: dict = {}
+    avail: dict = {}
+    for n in alive:
+        for k, v in n["resources_total"].items():
+            totals[k] = totals.get(k, 0) + v
+        for k, v in n["resources_available"].items():
+            avail[k] = avail.get(k, 0) + v
+    by_state: dict = {}
+    for a in actors:
+        by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+    return {"nodes_alive": len(alive), "nodes_total": len(nodes),
+            "resources_total": totals, "resources_available": avail,
+            "actors_by_state": by_state,
+            "placement_groups": len(list_placement_groups())}

@@ -1,0 +1,97 @@
+"""End-to-end: Dataset shards feed a mesh-sharded train step; and a
+two-slice MEGASCALE simulation boots coordinated workers.
+
+Covers the two paths review called out as untested:
+- streaming_split -> iter_jax_batches(sharding=...) -> sharded
+  make_train_step on the virtual 8-device CPU mesh (reference:
+  data-parallel trainer feeding per-worker data shards),
+- multi-slice coordination env (reference: MEGASCALE vars from
+  _private/accelerators/tpu.py) consumed by gang-scheduled actors.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu import data as rt_data
+from ray_tpu.models import llama
+from ray_tpu.parallel import MeshSpec, make_mesh, make_train_step
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_dataset_shards_feed_sharded_train_step(cluster):
+    """streaming_split shards -> device-resident sharded batches ->
+    GSPMD train step on dp×fsdp×tp mesh; loss decreases."""
+    cfg = llama.tiny(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, ffn_dim=128, dtype="float32",
+                     logits_dtype="float32", attn_impl="reference")
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2, tensor=2, context=1))
+    init_fn, step_fn = make_train_step(cfg, mesh)
+    state = init_fn(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    rows = [{"tokens": rng.integers(1, 255, size=33).astype(np.int32)}
+            for _ in range(64)]
+    ds = rt_data.from_items(rows)
+    shards = ds.streaming_split(2, equal=True)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
+
+    losses = []
+    # interleave the two shards as two data-parallel streams feeding
+    # the same global step (per-host shard -> global array semantics
+    # are exercised by device_put with a mesh sharding)
+    iters = [s.iter_jax_batches(batch_size=4, sharding=batch_sharding)
+             for s in shards]
+    for _ in range(4):
+        for it in iters:
+            b = next(it)
+            tokens = b["tokens"]
+            assert tokens.sharding.is_equivalent_to(
+                batch_sharding, tokens.ndim)
+            batch = {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}
+            state, metrics = step_fn(state, batch)
+            losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_two_slice_megascale_simulation(cluster):
+    """Two simulated slices: each gang gets coherent MEGASCALE env
+    (shared coordinator, distinct slice ids) and all workers see the
+    same world layout."""
+    from ray_tpu.util import tpu
+
+    coordinator = "10.0.0.1"
+
+    @ray_tpu.remote
+    class SliceWorker:
+        def __init__(self, env):
+            import os
+            os.environ.update(env)
+
+        def layout(self):
+            import os
+            return (os.environ["MEGASCALE_COORDINATOR_ADDRESS"],
+                    int(os.environ["MEGASCALE_NUM_SLICES"]),
+                    int(os.environ["MEGASCALE_SLICE_ID"]))
+
+    workers = []
+    for slice_id in range(2):
+        env = tpu.get_megascale_env_vars(coordinator, 2, slice_id)
+        workers += [SliceWorker.remote(env) for _ in range(2)]
+    layouts = ray_tpu.get([w.layout.remote() for w in workers],
+                          timeout=60)
+    coords = {c for c, _, _ in layouts}
+    assert coords == {f"{coordinator}:8081"}
+    assert [n for _, n, _ in layouts] == [2] * 4
+    assert sorted(s for _, _, s in layouts) == [0, 0, 1, 1]

@@ -1,0 +1,70 @@
+"""State API + out-of-jit collective group.
+
+Reference shape: python/ray/util/state/api.py (typed listings) and
+python/ray/util/collective tests (allreduce/allgather across actors).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective, state
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=6)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_state_api_views(cluster):
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return 1
+
+    m = Marker.options(name="state_marker").remote()
+    assert ray_tpu.get(m.ping.remote(), timeout=30) == 1
+
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1 and all(n["alive"] for n in nodes)
+    actors = state.list_actors(state="ALIVE")
+    assert any(a["name"] == "state_marker" for a in actors)
+    jobs = state.list_jobs()
+    assert any(j["state"] == "RUNNING" for j in jobs)
+    s = state.cluster_summary()
+    assert s["nodes_alive"] >= 1
+    assert s["resources_total"].get("CPU", 0) >= 6
+    assert s["actors_by_state"].get("ALIVE", 0) >= 1
+
+
+def test_collective_group_across_actors(cluster):
+    @ray_tpu.remote
+    class Worker:
+        def __init__(self, rank, world):
+            self.g = collective.CollectiveGroup(
+                "testgrp", rank, world, generation="g1")
+            self.rank = rank
+
+        def run(self):
+            s = self.g.allreduce(np.array([self.rank + 1.0]), op="sum")
+            m = self.g.allreduce(np.array([float(self.rank)]),
+                                 op="mean")
+            mx = self.g.allreduce(np.array([self.rank * 2.0]), op="max")
+            gathered = self.g.allgather({"rank": self.rank})
+            got = self.g.broadcast(
+                "hello" if self.rank == 0 else None, root=0)
+            self.g.barrier()
+            return (float(s[0]), float(m[0]), float(mx[0]),
+                    [g["rank"] for g in gathered], got)
+
+    world = 3
+    ws = [Worker.remote(r, world) for r in range(world)]
+    outs = ray_tpu.get([w.run.remote() for w in ws], timeout=120)
+    for s, m, mx, ranks, got in outs:
+        assert s == 6.0          # 1+2+3
+        assert m == 1.0          # (0+1+2)/3
+        assert mx == 4.0         # max(0,2,4)
+        assert ranks == [0, 1, 2]
+        assert got == "hello"
