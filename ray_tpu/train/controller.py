@@ -45,6 +45,7 @@ class TrainController:
         self.metrics_history: List[Dict[str, Any]] = []
         self._workers: List = []
         self._pg = None
+        self._stop_requested = False
 
     # --- scaling policy (reference: scaling_policy/fixed.py, elastic.py) ---
 
@@ -180,10 +181,34 @@ class TrainController:
 
     # --- main loop ---
 
+    def stop(self) -> None:
+        """Cooperative teardown for an interrupted fit(): flag the run
+        loop to exit and release the worker gang + placement group (the
+        runtime has no parent-child fate-sharing to do this on kill)."""
+        self._stop_requested = True
+        self._teardown_group()
+
+    def status(self) -> dict:
+        """Live view for external monitors (the controller runs as a
+        named actor; see trainer.get_controller)."""
+        return {
+            "reports": len(self.metrics_history),
+            "latest_metrics": (self.metrics_history[-1]
+                               if self.metrics_history else {}),
+            "num_workers": len(self._workers),
+        }
+
     def run(self) -> Result:
         failures = 0
         max_failures = self.run_config.failure_config.max_failures
         while True:
+            if self._stop_requested:
+                return Result(
+                    metrics=(self.metrics_history[-1]
+                             if self.metrics_history else {}),
+                    checkpoint=self.ckpt_manager.best(),
+                    metrics_history=list(self.metrics_history),
+                    error=TrainGroupError("stopped"))
             try:
                 n = self._decide_num_workers()
                 self._create_group(n)
@@ -220,6 +245,8 @@ class TrainController:
             polls = ray_tpu.get(
                 [self._workers[i].poll.remote() for i in sorted(pending)],
                 timeout=60)
+            if self._stop_requested:
+                raise TrainGroupError("stop requested")
             for p in polls:
                 for rep in p["reports"]:
                     self._handle_report(p["rank"], rep)

@@ -28,16 +28,61 @@ class BaseTrainer:
         self.datasets = datasets
 
     def fit(self) -> Result:
+        import uuid
+
         import ray_tpu
         if not ray_tpu.is_initialized():
             ray_tpu.init()
-        controller = TrainController(
-            self.train_loop_per_worker,
-            scaling=self.scaling_config,
-            run_config=self.run_config,
-            train_loop_config=self.train_loop_config,
-            datasets=self.datasets)
-        return controller.run()
+        # The controller runs as a NAMED ACTOR (reference:
+        # v2/api/data_parallel_trainer.py:179 launches the controller
+        # actor) so training outlives driver thread churn and can be
+        # monitored from elsewhere via get_controller(name). num_cpus=0:
+        # it must never steal a slot from the worker gang it manages.
+        run_name = self.run_config.name or f"run-{uuid.uuid4().hex[:8]}"
+        # expose the (possibly generated) name so get_controller works
+        # for unnamed runs too
+        self.run_config.name = run_name
+
+        def _create(actor_name):
+            return ray_tpu.remote(TrainController).options(
+                name=actor_name, num_cpus=0,
+                max_concurrency=4).remote(
+                self.train_loop_per_worker,
+                scaling=self.scaling_config,
+                run_config=self.run_config,
+                train_loop_config=self.train_loop_config,
+                datasets=self.datasets)
+
+        try:
+            ctrl = _create(f"__train_ctrl_{run_name}")
+        except Exception as e:
+            if "taken" not in str(e):
+                raise
+            # concurrent run reusing the name: still run, under a
+            # uniquified controller name (monitoring resolves the first)
+            ctrl = _create(
+                f"__train_ctrl_{run_name}-{uuid.uuid4().hex[:6]}")
+        try:
+            return ray_tpu.get(ctrl.run.remote())
+        except BaseException:
+            # Interrupted (Ctrl-C / driver error): give the controller a
+            # chance to tear down its worker gang + placement group —
+            # there is no parent-child fate-sharing, so a hard kill here
+            # would leak the whole group.
+            try:
+                ray_tpu.get(ctrl.stop.remote(), timeout=60)
+            except Exception:
+                pass
+            raise
+        finally:
+            ray_tpu.kill(ctrl)
+
+
+def get_controller(run_name: str):
+    """Handle to a live training run's controller actor (call
+    `.status.remote()` from any driver attached to the cluster)."""
+    import ray_tpu
+    return ray_tpu.get_actor(f"__train_ctrl_{run_name}")
 
 
 class JaxTrainer(BaseTrainer):

@@ -172,3 +172,41 @@ def test_collectives_barrier_broadcast(cluster):
         train_fn, scaling_config=ScalingConfig(num_workers=2)).fit()
     assert res.error is None
     assert res.metrics["got"] == 42
+
+
+def test_controller_is_monitorable_actor(cluster):
+    """fit() runs the controller as a named actor; another thread (or
+    driver) can watch progress via get_controller(name).status."""
+    import threading
+
+    seen = {}
+
+    def train_fn():
+        for step in range(5):
+            train.report({"step": step})
+            time.sleep(0.3)
+
+    t = train.JaxTrainer(
+        train_fn, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="monitored-run"))
+
+    def watch():
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                h = train.get_controller("monitored-run")
+                st = ray_tpu.get(h.status.remote(), timeout=10)
+                if st["reports"] > 0:
+                    seen.update(st)
+                    return
+            except (ValueError, ray_tpu.GetTimeoutError):
+                pass  # controller not registered / not serving yet
+            time.sleep(0.2)
+
+    w = threading.Thread(target=watch)
+    w.start()
+    res = t.fit()
+    w.join(timeout=30)
+    assert res.error is None
+    assert seen.get("reports", 0) > 0
+    assert "step" in seen.get("latest_metrics", {})
