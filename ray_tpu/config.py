@@ -69,7 +69,8 @@ class Config:
     # --- memory monitor (0 = disabled) ---
     memory_monitor_interval_s: float = 0.0
     memory_usage_threshold: float = 0.95    # node-wide usage fraction
-    worker_rss_limit_bytes: int = 0         # per-worker hard cap
+    worker_rss_limit_bytes: int = 0         # per-worker soft cap (monitor)
+    worker_cgroup_memory_bytes: int = 0     # per-worker KERNEL cap (cgroup)
 
     # --- observability ---
     event_buffer_size: int = 65536

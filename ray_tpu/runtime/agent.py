@@ -40,6 +40,7 @@ class WorkerHandle:
     actor_pg: Optional[tuple] = None           # (pg_id, bundle_index)
     lease_id: Optional[str] = None
     env_hash: str = ""                         # runtime-env pool key
+    cgroup: Optional[object] = None            # WorkerCgroup when caged
     ready: asyncio.Event = field(default_factory=asyncio.Event)
 
 
@@ -134,6 +135,10 @@ class NodeAgent:
         if self.config.memory_monitor_interval_s > 0:
             self._mem_task = asyncio.ensure_future(
                 self._memory_monitor_loop())
+        if self.config.worker_cgroup_memory_bytes > 0:
+            from ray_tpu.runtime.cgroup import detect, sweep_stale
+            self._cgroup_version = detect()  # once; spawns reuse it
+            sweep_stale(self._cgroup_version)
         return self.addr
 
     async def stop(self):
@@ -150,6 +155,18 @@ class NodeAgent:
             await _m.release_shared_server()
         for w in list(self.workers.values()):
             await self._kill_worker(w)
+        caged = [w for w in self.workers.values()
+                 if w.cgroup is not None and w.proc is not None]
+        if caged:
+            # rmdir fails while the dying process is still a member;
+            # reap them first (the _reap_worker tasks may be cancelled
+            # when the loop closes right after this). One shared bound,
+            # not 5s per worker.
+            await asyncio.gather(
+                *[asyncio.wait_for(w.proc.wait(), 5) for w in caged],
+                return_exceptions=True)
+            for w in caged:
+                w.cgroup.remove()
         await self.server.stop()
         await self.pool.close()
         self.store.shutdown()
@@ -345,6 +362,21 @@ class NodeAgent:
             if stdout is not None:
                 stdout.close()
         w = WorkerHandle(worker_id=wid, proc=proc, env_hash=env_hash)
+        if self.config.worker_cgroup_memory_bytes > 0:
+            from ray_tpu.runtime.cgroup import WorkerCgroup
+            from ray_tpu.util import events
+            w.cgroup = WorkerCgroup.create(
+                f"{self.session_id[:8]}-{wid.hex()[:12]}",
+                self.config.worker_cgroup_memory_bytes,
+                getattr(self, "_cgroup_version", None))
+            if w.cgroup is None:
+                events.record("cgroup", "unavailable", worker=wid.hex())
+            elif not w.cgroup.add_pid(proc.pid):
+                # worker runs UNCONFINED — surface it, don't hide it
+                events.record("cgroup", "attach_failed",
+                              worker=wid.hex(), path=w.cgroup.path)
+                w.cgroup.remove()
+                w.cgroup = None
         self.workers[wid] = w
         asyncio.ensure_future(self._reap_worker(w))
         try:
@@ -359,6 +391,8 @@ class NodeAgent:
         if w.proc is None:
             return
         await w.proc.wait()
+        if w.cgroup is not None:
+            w.cgroup.remove()
         dead_actor = w.actor_id
         was = w.state
         w.state = DEAD

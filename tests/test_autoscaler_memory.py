@@ -117,3 +117,38 @@ def test_memory_monitor_kills_oversized_worker():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_cgroup_kernel_memory_cap():
+    """With worker_cgroup_memory_bytes set, a runaway worker is
+    OOM-killed by the KERNEL at its own cap (not the node's), surfacing
+    as a worker crash; right-sized work on the node is unaffected."""
+    from ray_tpu.runtime.cgroup import detect
+    if detect() is None:
+        pytest.skip("no writable cgroup memory controller")
+    from ray_tpu.cluster_utils import Cluster
+    cfg = Config.from_env(
+        worker_cgroup_memory_bytes=400 * 1024 * 1024)
+    c = Cluster(config=cfg)
+    c.add_node(num_cpus=2)
+    try:
+        ray_tpu.init(address=c.address, config=cfg)
+
+        @ray_tpu.remote(max_retries=0)
+        def hog():
+            blobs = []
+            for _ in range(40):  # ~1 GB in 25 MB steps, touched
+                blobs.append(np.ones(25 * 1024 * 1024 // 8,
+                                     dtype=np.float64))
+            return sum(b.nbytes for b in blobs)
+
+        @ray_tpu.remote(max_retries=0)
+        def modest():
+            return int(np.ones(1000).sum())
+
+        with pytest.raises(ray_tpu.WorkerCrashedError):
+            ray_tpu.get(hog.remote(), timeout=120)
+        assert ray_tpu.get(modest.remote(), timeout=60) == 1000
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
