@@ -51,6 +51,8 @@ class _Lease:
     resources: Dict[str, float]
     pg_id: Optional[PlacementGroupID] = None
     bundle_index: Optional[int] = None
+    acked: bool = False                      # client confirmed receipt
+    granted_at: float = field(default_factory=time.monotonic)
 
 
 class NodeAgent:
@@ -98,6 +100,7 @@ class NodeAgent:
     def _handlers(self):
         return {
             "request_lease": self.request_lease,
+            "ack_lease": self.ack_lease,
             "release_lease": self.release_lease,
             "start_actor": self.start_actor,
             "kill_actor_worker": self.kill_actor_worker,
@@ -304,6 +307,14 @@ class NodeAgent:
     async def _heartbeat_loop(self):
         period = self.config.health_check_period_s
         while not self._stopping:
+            # Local reaping must run even when the head is unreachable —
+            # partitions are exactly when orphaned grants/allocations
+            # appear.
+            try:
+                self.store.sweep_unsealed(ttl_s=60.0)
+                self._reap_unacked_leases()
+            except Exception:
+                pass
             try:
                 self._view_version += 1
                 r = await self.pool.call(
@@ -315,9 +326,6 @@ class NodeAgent:
                     timeout=10.0)
                 if r.get("view"):
                     self.cluster_view = r["view"]
-                # Reap allocations whose producer died between alloc and
-                # seal — otherwise they pin unevictable capacity forever.
-                self.store.sweep_unsealed(ttl_s=60.0)
             except Exception:
                 pass
             await asyncio.sleep(period)
@@ -627,6 +635,36 @@ class NodeAgent:
                 self._wait_queue.remove(entry)
             except ValueError:
                 pass
+
+    async def ack_lease(self, lease_id: str):
+        """Client confirms it received the grant. Un-acked leases are
+        reaped: if the grant REPLY is lost in transit (connection drop,
+        injected chaos), the client retries and takes a fresh lease — the
+        orphaned grant would otherwise pin its resources forever
+        (reference: raylet reclaims leases when the owning client
+        disconnects; the RPC plane here has no per-client connection
+        identity, so an explicit ack carries the same information)."""
+        lease = self.leases.get(lease_id)
+        if lease is None:
+            return {"ok": False}  # already reaped — caller re-leases
+        lease.acked = True
+        return {"ok": True}
+
+    def _reap_unacked_leases(self, grace_s: float = 60.0):
+        """grace_s must exceed the client's worst-case ack envelope
+        (5s timeout x 5 transport retries + backoff ~= 30s) so only
+        truly orphaned grants are reaped. Reaped workers are KILLED,
+        not returned to the pool: the client may believe it owns the
+        lease and be mid-dispatch — termination is the fence."""
+        now = time.monotonic()
+        stale = [l for l in self.leases.values()
+                 if not l.acked and now - l.granted_at > grace_s]
+        for l in stale:
+            async def _fence(lease=l):
+                await self.release_lease(lease.lease_id,
+                                         worker_died=True)
+                await self._kill_worker(lease.worker)
+            asyncio.ensure_future(_fence())
 
     async def release_lease(self, lease_id: str, worker_died: bool = False):
         lease = self.leases.pop(lease_id, None)

@@ -299,12 +299,38 @@ class LeasePool:
                 continue
             if "granted" in r:
                 g = r["granted"]
-                return _LeasedWorker(
+                lw = _LeasedWorker(
                     lease_id=g["lease_id"], agent_addr=addr,
                     worker_addr=tuple(g["worker_addr"]),
                     worker_id=g["worker_id"])
+                # Confirm receipt so the agent won't reap this grant as
+                # orphaned (fire-and-forget; the pool retries transport
+                # failures, and a lost ack just re-leases later).
+                asyncio.ensure_future(self._ack_lease(lw))
+                return lw
             raise RayTpuError(r.get("error", "lease refused"))
         raise RayTpuError("spillback loop exceeded hop limit")
+
+    async def _ack_lease(self, lw: "_LeasedWorker"):
+        ok = False
+        try:
+            r = await self.ctx.pool.call(lw.agent_addr, "ack_lease",
+                                         lease_id=lw.lease_id,
+                                         timeout=5.0)
+            ok = bool(r.get("ok"))
+        except Exception:
+            ok = False
+        if not ok:
+            # The agent either reaped this grant or is unreachable: the
+            # lease is (or will be) fenced off agent-side, so retire the
+            # worker here too — otherwise parked waiters could still be
+            # handed slots on it.
+            lw.dead = True
+            sp = self._pools.get(lw.key)
+            if sp is not None and lw in sp.workers:
+                sp.workers.remove(lw)
+            if sp is not None and sp.waiters:
+                self._maybe_request_leases(lw.key, sp)
 
     def _maybe_request_leases(self, key: tuple, sp: _ShapePool):
         import math
@@ -362,6 +388,8 @@ class LeasePool:
 
     def _hand_slot(self, sp: _ShapePool, lw: _LeasedWorker) -> bool:
         """Give one execution slot on lw to the oldest live waiter."""
+        if lw.dead:
+            return False
         while sp.waiters:
             fut = sp.waiters.popleft()
             if fut.done():  # cancelled waiter

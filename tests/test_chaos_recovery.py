@@ -1,0 +1,48 @@
+"""Fault injection over the recovery path (separate module: needs its
+own cluster + chaos config, not the shared two_node fixture)."""
+
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+def test_object_reconstruction_under_rpc_chaos():
+    """Lineage recovery with deterministic RPC fault injection layered
+    on top (reference: rpc_chaos.h + test_object_reconstruction
+    combined): injected lease/resolve failures must be absorbed by
+    retries, and a node death mid-stream still recovers the object."""
+    from ray_tpu.config import Config
+    cfg = Config.from_env(
+        testing_rpc_failure="resolve_object=2:0.0:1.0,"
+                            "request_lease=2:0.0:1.0")
+    cluster = Cluster(config=cfg)
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address, config=cfg)
+    try:
+        victim = cluster.add_node(num_cpus=2, labels={"zone": "chaos"})
+        time.sleep(1.0)
+
+        @ray_tpu.remote(max_retries=3, num_returns=2)
+        def produce(i):
+            import os
+            return (np.arange(200_000, dtype=np.int64) * i,
+                    os.environ["RAY_TPU_NODE_ID"])
+
+        pairs = [produce.options(scheduling_strategy="spread").remote(i)
+                 for i in range(8)]
+        nodes = ray_tpu.get([p[1] for p in pairs], timeout=120)
+        on_victim = [(i, pairs[i][0]) for i, v in enumerate(nodes)
+                     if v == victim.node_id.hex()]
+        assert on_victim, "spread never hit the victim node"
+        idx, data_ref = on_victim[0]
+
+        cluster.kill_node(victim)
+        time.sleep(1.5)
+        again = ray_tpu.get(data_ref, timeout=120)
+        assert np.array_equal(again,
+                              np.arange(200_000, dtype=np.int64) * idx)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
