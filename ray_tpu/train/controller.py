@@ -188,6 +188,11 @@ class TrainController:
         self._stop_requested = True
         self._teardown_group()
 
+    def history(self, cursor: int = 0) -> List[Dict[str, Any]]:
+        """Reports from `cursor` on — lets monitors (e.g. tune trials
+        streaming to a scheduler) tail the run incrementally."""
+        return list(self.metrics_history[cursor:])
+
     def status(self) -> dict:
         """Live view for external monitors (the controller runs as a
         named actor; see trainer.get_controller)."""

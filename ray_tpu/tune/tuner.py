@@ -185,15 +185,98 @@ class _Trial:
     stop_requested: bool = False
 
 
+def _trainer_trainable(trainer) -> Callable[[dict], Any]:
+    def run_trial(config: dict):
+        import copy
+        import threading
+        import uuid as _uuid
+
+        import ray_tpu
+        from ray_tpu.train.trainer import get_controller
+
+        t = copy.copy(trainer)
+        t.train_loop_config = {**(trainer.train_loop_config or {}),
+                               **(config or {})}
+        # Unique-but-correlated run name: sweep name + trial suffix, so
+        # get_controller-based monitoring still works per trial.
+        t.run_config = copy.copy(trainer.run_config)
+        base = trainer.run_config.name or "tune"
+        t.run_config.name = f"{base}-{_uuid.uuid4().hex[:6]}"
+
+        box: dict = {}
+
+        def _fit():
+            try:
+                box["res"] = t.fit()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box["err"] = e
+
+        th = threading.Thread(target=_fit, daemon=True)
+        th.start()
+        # Stream the run's reports to the scheduler LIVE (via the
+        # controller actor's history) so ASHA-style early stopping can
+        # actually interrupt training instead of post-hoc replay.
+        reported = 0
+        stopped = False
+        while th.is_alive() and not stopped:
+            th.join(timeout=0.3)
+            try:
+                h = get_controller(t.run_config.name)
+                hist = ray_tpu.get(
+                    h.history.remote(reported), timeout=10)
+            except Exception:
+                continue
+            for m in hist:
+                reported += 1
+                try:
+                    report(m)
+                except TrialStopped:
+                    stopped = True
+                    try:
+                        ray_tpu.get(h.stop.remote(), timeout=60)
+                    except Exception:
+                        pass
+                    break
+        th.join(timeout=300)
+        if stopped:
+            raise TrialStopped()
+        if "err" in box:
+            raise box["err"]
+        res = box.get("res")
+        if res is None:
+            raise RuntimeError("trainer.fit() did not complete")
+        if res.error is not None:
+            raise res.error
+        for m in res.metrics_history[reported:]:
+            report(m)
+        if res.checkpoint is not None:
+            # forward the run's best checkpoint into tune's plane so
+            # grid.get_best_result().checkpoint is recoverable
+            report(dict(res.metrics), checkpoint=res.checkpoint)
+        return res.metrics
+
+    run_trial._nested_trainer = trainer  # Tuner derives resources from it
+    return run_trial
+
+
 class Tuner:
     """Reference: tune/tuner.py:43. ``Tuner(fn, param_space=...,
     tune_config=TuneConfig(...)).fit()`` -> ResultGrid."""
 
-    def __init__(self, trainable: Callable[[dict], Any], *,
+    def __init__(self, trainable, *,
                  param_space: Optional[Dict[str, Any]] = None,
                  tune_config: Optional[TuneConfig] = None):
+        from ray_tpu.train.trainer import BaseTrainer
+        if isinstance(trainable, BaseTrainer):
+            # Tuner(trainer) parity (reference: tuner.py accepts a
+            # Trainer): each trial re-runs the trainer with the sampled
+            # config merged into train_loop_config. Reports flow through
+            # the normal train.report plane; the trial's result is the
+            # run's final metrics.
+            trainable = _trainer_trainable(trainable)
         if not callable(trainable):
-            raise TypeError("trainable must be a callable(config)")
+            raise TypeError(
+                "trainable must be a callable(config) or a Trainer")
         self._fn = trainable
         self._space = dict(param_space or {})
         self._cfg = tune_config or TuneConfig()
@@ -207,9 +290,28 @@ class Tuner:
             scheduler.mode = cfg.mode
         configs = generate_variants(self._space, cfg.num_samples, cfg.seed)
         trials = [_Trial(uuid.uuid4().hex[:8], c) for c in configs]
-        limit = cfg.max_concurrent_trials or max(
-            1, int(ray_tpu.cluster_resources().get("CPU", 4)))
-        resources = cfg.resources_per_trial or {"CPU": 1.0}
+        nested = getattr(self._fn, "_nested_trainer", None)
+        if nested is not None:
+            # Trainer trials: the trial actor only coordinates (the
+            # nested worker gang holds the real resources), so it costs
+            # nothing — and concurrency defaults to how many gangs the
+            # cluster can actually place, not the CPU count.
+            resources = cfg.resources_per_trial or {"CPU": 0.0}
+            if cfg.max_concurrent_trials:
+                limit = cfg.max_concurrent_trials
+            else:
+                res_w = nested.scaling_config.worker_resources()
+                nw = nested.scaling_config.num_workers
+                if isinstance(nw, tuple):
+                    nw = nw[0]
+                key = "TPU" if "TPU" in res_w else "CPU"
+                per_gang = max(1e-9, res_w.get(key, 1.0) * max(1, nw))
+                total = ray_tpu.cluster_resources().get(key, 1.0)
+                limit = max(1, int(total // per_gang))
+        else:
+            limit = cfg.max_concurrent_trials or max(
+                1, int(ray_tpu.cluster_resources().get("CPU", 4)))
+            resources = cfg.resources_per_trial or {"CPU": 1.0}
 
         actor_cls = ray_tpu.remote(_TrialActor).options(
             max_concurrency=4, resources=resources)

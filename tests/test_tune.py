@@ -123,3 +123,60 @@ def test_asha_rung_math():
     assert s.on_result("A", {"training_iteration": 1, "m": 10}) == "CONTINUE"
     assert s.on_result("A", {"training_iteration": 2, "m": 20}) == "CONTINUE"
     assert s.on_result("B", {"training_iteration": 1, "m": 1}) == "STOP"
+
+
+def test_tuner_over_trainer(runtime):
+    """Tuner(trainer) parity: sweep a JaxTrainer's train_loop_config
+    (reference: tuner.py accepting a Trainer trainable)."""
+    from ray_tpu import train
+    from ray_tpu.train import ScalingConfig
+
+    def train_fn(config=None):
+        lr = (config or {}).get("lr", 1.0)
+        for step in range(3):
+            train.report({"loss": lr * (3 - step), "lr": lr})
+
+    trainer = train.JaxTrainer(
+        train_fn, scaling_config=ScalingConfig(num_workers=1))
+    grid = tune.Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([0.1, 1.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    max_concurrent_trials=1),
+    ).fit()
+    assert len(grid) == 2
+    best = grid.get_best_result()
+    assert best.config["lr"] == 0.1
+    assert best.metrics["loss"] == pytest.approx(0.1)
+
+
+def test_asha_interrupts_trainer_trials_live(runtime):
+    """Live report streaming: ASHA must stop a losing TRAINER trial
+    mid-run (before its 20 steps finish), not post-hoc."""
+    from ray_tpu import train
+    from ray_tpu.train import ScalingConfig
+
+    def train_fn(config=None):
+        import time as _t
+        lr = (config or {}).get("lr", 1.0)
+        for step in range(20):
+            train.report({"loss": lr * 100.0 / (step + 1)})
+            _t.sleep(0.25)
+
+    trainer = train.JaxTrainer(
+        train_fn, scaling_config=ScalingConfig(num_workers=1))
+    grid = tune.Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([0.001, 50.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", max_concurrent_trials=2,
+            scheduler=tune.ASHAScheduler(
+                metric="loss", mode="min", grace_period=2,
+                reduction_factor=2, max_t=20)),
+    ).fit()
+    assert len(grid) == 2
+    by_lr = {r.config["lr"]: r for r in grid}
+    assert by_lr[0.001].status == "TERMINATED"
+    loser = by_lr[50.0]
+    assert loser.status == "STOPPED", (loser.status, loser.error)
+    assert len(loser.all_reports) < 20, len(loser.all_reports)
