@@ -137,11 +137,20 @@ class DeploymentHandle:
     """Routes calls to a deployment's replicas (p2c). Picklable — ships
     across actors as a name reference."""
 
-    def __init__(self, deployment_name: str):
+    def __init__(self, deployment_name: str, _pin: bytes = None):
         self.deployment_name = deployment_name
+        self._pin = _pin
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name,))
+        return (DeploymentHandle, (self.deployment_name, self._pin))
+
+    def pinned(self) -> "DeploymentHandle":
+        """A handle bound to ONE replica (picked now) — for stateful
+        call sequences like token streaming, where every call must land
+        on the replica holding the stream."""
+        router = _router_for(self.deployment_name)
+        router.refresh()
+        return DeploymentHandle(self.deployment_name, router.pick())
 
     def __getattr__(self, name):
         if name.startswith("_") or name in ("deployment_name",):
@@ -154,14 +163,20 @@ class DeploymentHandle:
     def _route(self, method: str, args: tuple, kwargs: dict,
                _retries: int = 2):
         router = _router_for(self.deployment_name)
-        router.refresh()
-        rid = router.pick()
+        if self._pin is not None:
+            # Pinned: no table refresh — the stream lives or dies with
+            # its replica, and a mid-rescale empty routing table must
+            # not kill a healthy pinned call.
+            rid = self._pin
+        else:
+            router.refresh()
+            rid = router.pick()
         replica = ActorHandle(ActorID(rid))
         try:
             ref = replica.handle_request.remote(method, args, kwargs)
         except api.RayTpuError:
-            if _retries <= 0:
-                raise
+            if self._pin is not None or _retries <= 0:
+                raise  # pinned state died with its replica — no rerouting
             router.drop(rid)
             return self._route(method, args, kwargs, _retries - 1)
         router.track(rid, ref)

@@ -35,6 +35,7 @@ class LLMConfig:
     max_len: int = 1024
     prefill_buckets: tuple = (64, 128, 256, 512)
     cache_dtype: str = "bfloat16"
+    steps_per_sync: int = 8
     seed: int = 0
     num_replicas: object = 1
     max_ongoing_requests: int = 64
@@ -61,7 +62,9 @@ class _LLMServer:
         self.engine = LLMEngine(
             model_cfg, params, max_slots=cfg.max_slots,
             max_len=cfg.max_len, prefill_buckets=cfg.prefill_buckets,
-            cache_dtype=cfg.cache_dtype, seed=cfg.seed)
+            cache_dtype=cfg.cache_dtype,
+            steps_per_sync=cfg.steps_per_sync, seed=cfg.seed)
+        self._streams: dict = {}
 
     async def generate(self, tokens, max_new_tokens: int = 64,
                        temperature: float = 0.0,
@@ -69,6 +72,70 @@ class _LLMServer:
         return await self.engine.generate(
             tokens, max_new_tokens=max_new_tokens,
             temperature=temperature, eos_id=eos_id)
+
+    # --- streaming (cursor-polling over plain handle calls) -----------
+    # The reference streams via HTTP SSE from the replica; here the
+    # client drains tokens with stream_poll as they are produced, so
+    # time-to-first-token is one decode block, not the full generation.
+
+    async def stream_start(self, tokens, max_new_tokens: int = 64,
+                           temperature: float = 0.0,
+                           eos_id: Optional[int] = None) -> str:
+        import asyncio
+        import time as _time
+        import uuid
+        # GC abandoned streams (client crashed / stopped draining): a
+        # stream unpolled for 5 minutes is dropped. The generation
+        # itself still runs to completion in the engine — only the
+        # buffered record is reclaimed.
+        now = _time.monotonic()
+        for k in [k for k, s in self._streams.items()
+                  if now - s["last_poll"] > 300.0]:
+            del self._streams[k]
+        sid = uuid.uuid4().hex[:12]
+        st = {"tokens": [], "done": False, "error": None,
+              "last_poll": now}
+        self._streams[sid] = st
+
+        async def pump():
+            try:
+                gen = self.engine.generate_stream(
+                    tokens, max_new_tokens=max_new_tokens,
+                    temperature=temperature, eos_id=eos_id)
+                async for tok in gen:
+                    st["tokens"].append(int(tok))
+            except BaseException as e:  # noqa: BLE001 — polled by client
+                st["error"] = f"{type(e).__name__}: {e}"
+            finally:
+                st["done"] = True
+
+        asyncio.ensure_future(pump())
+        return sid
+
+    async def stream_poll(self, sid: str, cursor: int = 0,
+                          wait_s: float = 2.0) -> dict:
+        """Tokens produced since `cursor`; long-polls briefly so clients
+        don't busy-spin. {"tokens": [...], "done": bool, "error": ...}.
+        The stream record is dropped once polled past its end."""
+        import asyncio
+        import time as _time
+        streams = self._streams
+        st = streams.get(sid)
+        if st is not None:
+            st["last_poll"] = _time.monotonic()
+        if st is None:
+            return {"tokens": [], "done": True,
+                    "error": f"unknown stream {sid!r}"}
+        deadline = _time.monotonic() + wait_s
+        while len(st["tokens"]) <= cursor and not st["done"] \
+                and _time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        out = {"tokens": st["tokens"][cursor:], "done": st["done"],
+               "error": st["error"]}
+        if st["done"] and cursor + len(out["tokens"]) >= \
+                len(st["tokens"]):
+            streams.pop(sid, None)  # fully drained
+        return out
 
     async def stats(self) -> dict:
         return dict(self.engine.stats)
@@ -80,6 +147,29 @@ class _LLMServer:
             max_new_tokens=int(request.get("max_new_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),
             eos_id=request.get("eos_id"))
+
+
+def stream_generate(handle, tokens, **kw):
+    """Client-side generator: yields token ids as the replica produces
+    them. `handle` is the deployment handle from serve.run.
+
+        for tok in stream_generate(h, prompt_ids, max_new_tokens=128):
+            ...
+    """
+    import ray_tpu
+    handle = handle.pinned()  # stream state is replica-local
+    sid = ray_tpu.get(handle.stream_start.remote(tokens, **kw),
+                      timeout=300)
+    cursor = 0
+    while True:
+        r = ray_tpu.get(handle.stream_poll.remote(sid, cursor),
+                        timeout=300)
+        if r["error"]:
+            raise RuntimeError(f"stream failed: {r['error']}")
+        yield from r["tokens"]
+        cursor += len(r["tokens"])
+        if r["done"]:
+            return
 
 
 def build_llm_deployment(cfg: LLMConfig,
