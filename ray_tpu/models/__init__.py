@@ -1,3 +1,4 @@
 from ray_tpu.models import llama
+from ray_tpu.models import moe
 
-__all__ = ["llama"]
+__all__ = ["llama", "moe"]

@@ -32,6 +32,7 @@ class MeshAxes:
     fsdp: str = "fsdp"
     tensor: str = "tensor"
     context: str = "context"
+    expert: str = "expert"   # used by the MoE family (models/moe.py)
 
     @property
     def batch(self):
@@ -307,14 +308,13 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     return logits
 
 
-def loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
-            mesh: Optional[Mesh] = None,
-            axes: MeshAxes = MeshAxes()) -> jax.Array:
-    """batch: {"tokens": (b, s), "targets": (b, s), "mask": optional}."""
-    logits = forward(params, batch["tokens"], cfg, mesh, axes)
+def cross_entropy(logits: jax.Array, batch: dict) -> jax.Array:
+    """Masked token cross-entropy, shared by every model family.
+
+    max/exp run in the logits dtype (bf16 when configured — faster VPU
+    rate, half the HBM traffic); accumulation and the final log are f32.
+    """
     targets = batch["targets"]
-    # max/exp run in the logits dtype (bf16 when configured — faster VPU
-    # rate, half the HBM traffic); accumulation and the final log are f32.
     m = jnp.max(logits, axis=-1, keepdims=True)
     sumexp = jnp.sum(jnp.exp(logits - m), axis=-1, dtype=jnp.float32)
     logz = m[..., 0].astype(jnp.float32) + jnp.log(sumexp)
@@ -325,3 +325,11 @@ def loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
         return jnp.mean(nll)
     mask = mask.astype(jnp.float32)
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
+            mesh: Optional[Mesh] = None,
+            axes: MeshAxes = MeshAxes()) -> jax.Array:
+    """batch: {"tokens": (b, s), "targets": (b, s), "mask": optional}."""
+    logits = forward(params, batch["tokens"], cfg, mesh, axes)
+    return cross_entropy(logits, batch)

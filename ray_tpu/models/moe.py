@@ -1,0 +1,264 @@
+"""Mixture-of-Experts decoder family (Mixtral-style), TPU-first.
+
+Expert parallelism is a *mesh axis* (``MeshAxes.expert``), not a process
+group: expert weights are sharded over the ``expert`` axis and the
+dispatch/combine einsums carry GSPMD sharding constraints, so XLA inserts
+the token all-to-alls over ICI. The reference only passes expert
+parallelism through to engine kwargs (reference:
+python/ray/llm/_internal/serve/engines/vllm/vllm_models.py, SURVEY.md
+section 2.3 "Expert parallelism: delegated"); here it is native.
+
+Routing is GShard/Switch-style top-k with per-row capacity: dispatch and
+combine are dense one-hot tensors of shape (batch, seq, experts, capacity)
+feeding batched expert matmuls — everything stays static-shape and lands on
+the MXU. Tokens past an expert's capacity are dropped (standard
+capacity-factor semantics); an auxiliary load-balancing loss keeps the
+router near-uniform so drops stay rare.
+
+Attention blocks are shared with the Llama family (ray_tpu.models.llama):
+RoPE + GQA + flash/ring kernels, identical remat policies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.models import llama
+from ray_tpu.models.llama import MeshAxes, _attend, _rmsnorm, _rope, \
+    _rope_tables
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = True
+    remat_policy: str = "full"
+    logits_dtype: str = "float32"
+    attn_impl: str = "auto"
+    attn_block_q: int = 128
+    attn_block_k: int = 128
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def capacity(self, seq_len: int) -> int:
+        """Per-row expert capacity (tokens per expert per sequence)."""
+        c = int(self.capacity_factor * self.experts_per_token * seq_len
+                / self.n_experts)
+        return max(4, -(-c // 4) * 4)  # round up to a multiple of 4
+
+    def num_params(self) -> int:
+        d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        h, kvh, hd = self.n_heads, self.n_kv_heads, self.head_dim
+        attn = d * h * hd + 2 * d * kvh * hd + h * hd * d
+        moe = d * self.n_experts + 3 * self.n_experts * d * f
+        per_layer = attn + moe + 2 * d
+        return v * d + self.n_layers * per_layer + d + d * v
+
+    def num_active_params(self) -> int:
+        """Params touched per token (top-k experts, not all)."""
+        d, f = self.dim, self.ffn_dim
+        h, kvh, hd = self.n_heads, self.n_kv_heads, self.head_dim
+        attn = d * h * hd + 2 * d * kvh * hd + h * hd * d
+        moe = d * self.n_experts + 3 * self.experts_per_token * d * f
+        per_layer = attn + moe + 2 * d
+        return self.vocab_size * d + self.n_layers * per_layer \
+            + d + d * self.vocab_size
+
+    def flops_per_token(self, seq_len: int) -> float:
+        n_matmul = self.num_active_params() - self.vocab_size * self.dim
+        attn = 12 * self.n_layers * self.dim * seq_len
+        return 6.0 * n_matmul + attn
+
+
+def mixtral_8x7b(**kw) -> MoEConfig:
+    return MoEConfig(**kw)
+
+
+def tiny(**kw) -> MoEConfig:
+    defaults = dict(vocab_size=512, dim=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, ffn_dim=128, n_experts=4,
+                    experts_per_token=2, max_seq_len=128)
+    defaults.update(kw)
+    return MoEConfig(**defaults)
+
+
+# --- params ----------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: MoEConfig) -> dict:
+    dtype = jnp.dtype(cfg.dtype)
+    d, f, E = cfg.dim, cfg.ffn_dim, cfg.n_experts
+    h, kvh, hd, L = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
+    ks = jax.random.split(rng, 10)
+
+    def norm_init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        "embed": norm_init(ks[0], (cfg.vocab_size, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), dtype),
+            "wq": norm_init(ks[1], (L, d, h * hd), d),
+            "wk": norm_init(ks[2], (L, d, kvh * hd), d),
+            "wv": norm_init(ks[3], (L, d, kvh * hd), d),
+            "wo": norm_init(ks[4], (L, h * hd, d), h * hd),
+            "mlp_norm": jnp.ones((L, d), dtype),
+            # router in f32: tiny, and top-k tie-breaks are dtype-sensitive
+            "router": (jax.random.normal(ks[5], (L, d, E), jnp.float32)
+                       * (d ** -0.5)),
+            "w_gate": norm_init(ks[6], (L, E, d, f), d),
+            "w_up": norm_init(ks[7], (L, E, d, f), d),
+            "w_down": norm_init(ks[8], (L, E, f, d), f),
+        },
+        "final_norm": jnp.ones((d,), dtype),
+        "lm_head": norm_init(ks[9], (d, cfg.vocab_size), d),
+    }
+
+
+def param_shardings(cfg: MoEConfig, axes: MeshAxes = MeshAxes()) -> dict:
+    t, fs, ep = axes.tensor, axes.fsdp, axes.expert
+    return {
+        "embed": P(t, fs),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, fs, t),
+            "wk": P(None, fs, t),
+            "wv": P(None, fs, t),
+            "wo": P(None, t, fs),
+            "mlp_norm": P(None, None),
+            "router": P(None, fs, None),
+            "w_gate": P(None, ep, fs, t),
+            "w_up": P(None, ep, fs, t),
+            "w_down": P(None, ep, t, fs),
+        },
+        "final_norm": P(None),
+        "lm_head": P(fs, t),
+    }
+
+
+# --- routing ---------------------------------------------------------------
+
+def _route(y, router, cfg: MoEConfig):
+    """Top-k routing with per-row capacity.
+
+    y: (b, s, d) -> dispatch (b, s, E, C) bool-as-dtype, combine (b, s, E, C)
+    with gate weights, aux load-balance loss (scalar f32).
+    """
+    b, s, _ = y.shape
+    E, k, C = cfg.n_experts, cfg.experts_per_token, cfg.capacity(s)
+
+    logits = (y.astype(jnp.float32) @ router)          # (b, s, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, idx = lax.top_k(probs, k)               # (b, s, k)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((b, s, E, C), jnp.float32)
+    combine = jnp.zeros((b, s, E, C), jnp.float32)
+    used = jnp.zeros((b, 1, E), jnp.float32)           # slots taken per expert
+    for j in range(k):                                 # k is small and static
+        m = jax.nn.one_hot(idx[..., j], E)             # (b, s, E)
+        # position of each token within its expert's queue (row-local,
+        # earlier slots have priority)
+        pos = jnp.cumsum(m, axis=1) - m + used
+        keep = m * (pos < C)
+        pos_oh = jax.nn.one_hot(
+            jnp.clip(pos, 0, C - 1).astype(jnp.int32), C)  # (b, s, E, C)
+        dispatch = dispatch + keep[..., None] * pos_oh
+        combine = combine + (gate_vals[..., j, None] * keep)[..., None] * pos_oh
+        used = used + jnp.sum(keep, axis=1, keepdims=True)
+
+    # Switch-style aux loss: E * sum_e f_e * p_e (minimized at uniform load)
+    f_e = jnp.mean(jax.nn.one_hot(idx, E).sum(axis=2), axis=(0, 1))
+    p_e = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(f_e * p_e)
+    return dispatch, combine, aux
+
+
+def _moe_block(y, lp, cfg: MoEConfig, act_constraint, axes: MeshAxes):
+    """y: (b, s, d) normed hidden -> expert-mixed output (b, s, d)."""
+    dispatch, combine, aux = _route(y, lp["router"], cfg)
+    dt = y.dtype
+    # (b, s, E, C) x (b, s, d) -> (b, E, C, d): the token all-to-all. The
+    # sharding constraint moves the expert dim onto the expert axis; GSPMD
+    # emits the all-to-all over ICI.
+    xd = jnp.einsum("bsec,bsd->becd", dispatch.astype(dt), y)
+    xd = act_constraint(xd, P(axes.batch, axes.expert, None, None))
+    gate = jax.nn.silu(jnp.einsum("becd,edf->becf", xd, lp["w_gate"]))
+    up = jnp.einsum("becd,edf->becf", xd, lp["w_up"])
+    out = jnp.einsum("becf,efd->becd", gate * up, lp["w_down"])
+    out = act_constraint(out, P(axes.batch, axes.expert, None, None))
+    y_out = jnp.einsum("bsec,becd->bsd", combine.astype(dt), out)
+    return y_out, aux
+
+
+# --- forward ---------------------------------------------------------------
+
+def forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
+            mesh: Optional[Mesh] = None,
+            axes: MeshAxes = MeshAxes()):
+    """tokens (b, s) int32 -> (logits (b, s, vocab), aux_loss scalar)."""
+    b, s = tokens.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def act_constraint(x, spec):
+        if mesh is not None:
+            return lax.with_sharding_constraint(
+                x, jax.sharding.NamedSharding(mesh, spec))
+        return x
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+    x = act_constraint(x, P(axes.batch, axes.context, None))
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    rope_cos, rope_sin = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer(x, lp):
+        y = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (y @ lp["wq"]).reshape(b, s, h, hd)
+        k = (y @ lp["wk"]).reshape(b, s, kvh, hd)
+        v = (y @ lp["wv"]).reshape(b, s, kvh, hd)
+        q = _rope(q, rope_cos, rope_sin)
+        k = _rope(k, rope_cos, rope_sin)
+        o = _attend(q, k, v, cfg, mesh, axes).astype(x.dtype)
+        x = x + (o.reshape(b, s, h * hd) @ lp["wo"])
+        x = act_constraint(x, P(axes.batch, axes.context, None))
+        y = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        moe_out, aux = _moe_block(y, lp, cfg, act_constraint, axes)
+        x = x + moe_out
+        x = act_constraint(x, P(axes.batch, axes.context, None))
+        return x, aux
+
+    step = llama._remat(layer, cfg)
+    x, aux = lax.scan(step, x, params["layers"])
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.dtype(cfg.logits_dtype))
+    return logits, jnp.sum(aux)
+
+
+def loss_fn(params: dict, batch: dict, cfg: MoEConfig,
+            mesh: Optional[Mesh] = None,
+            axes: MeshAxes = MeshAxes()) -> jax.Array:
+    """Cross-entropy + weighted load-balance aux loss."""
+    logits, aux = forward(params, batch["tokens"], cfg, mesh, axes)
+    return llama.cross_entropy(logits, batch) + cfg.aux_loss_weight * aux

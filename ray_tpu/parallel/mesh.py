@@ -32,11 +32,14 @@ class MeshSpec:
     fsdp: int = -1
     tensor: int = 1
     context: int = 1
+    expert: int = 1
     axes: MeshAxes = MeshAxes()
 
     def resolve(self, n_devices: int) -> dict[str, int]:
         sizes = {self.axes.data: self.data, self.axes.fsdp: self.fsdp,
-                 self.axes.tensor: self.tensor, self.axes.context: self.context}
+                 self.axes.tensor: self.tensor,
+                 self.axes.context: self.context,
+                 self.axes.expert: self.expert}
         unknown = [a for a, s in sizes.items() if s == -1]
         known = 1
         for s in sizes.values():
@@ -88,18 +91,23 @@ def default_optimizer(learning_rate: float = 3e-4,
     )
 
 
-def make_train_step(cfg: LlamaConfig, mesh: Mesh,
+def make_train_step(cfg, mesh: Mesh,
                     axes: MeshAxes = MeshAxes(),
                     optimizer: Optional[optax.GradientTransformation] = None,
-                    loss_fn: Optional[Callable] = None):
+                    loss_fn: Optional[Callable] = None,
+                    model=llama):
     """Returns (init_fn(rng) -> TrainState, step_fn(state, batch) ->
     (state, metrics)). Both jitted with GSPMD sharding: params per
-    llama.param_shardings, batch over (data+fsdp, context), opt state
-    sharded like params by propagation."""
+    model.param_shardings, batch over (data+fsdp, context), opt state
+    sharded like params by propagation.
+
+    ``model`` is any module exposing the model-family protocol
+    (init_params / param_shardings / loss_fn) — ray_tpu.models.llama
+    (default) or ray_tpu.models.moe."""
     opt = optimizer if optimizer is not None else default_optimizer()
     _loss = loss_fn if loss_fn is not None else (
-        lambda p, b: llama.loss_fn(p, b, cfg, mesh, axes))
-    pspecs = llama.param_shardings(cfg, axes)
+        lambda p, b: model.loss_fn(p, b, cfg, mesh, axes))
+    pspecs = model.param_shardings(cfg, axes)
     pshard = jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs,
                           is_leaf=lambda x: isinstance(x, P))
     batch_spec = NamedSharding(mesh, P(axes.batch, axes.context))
@@ -107,7 +115,7 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh,
     @jax.jit
     def init_fn(rng) -> TrainState:
         params = jax.lax.with_sharding_constraint(
-            llama.init_params(rng, cfg), pshard)
+            model.init_params(rng, cfg), pshard)
         opt_state = opt.init(params)
         return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
 
@@ -125,9 +133,9 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh,
     return init_fn, step_fn
 
 
-def make_eval_step(cfg: LlamaConfig, mesh: Mesh,
-                   axes: MeshAxes = MeshAxes()):
+def make_eval_step(cfg, mesh: Mesh,
+                   axes: MeshAxes = MeshAxes(), model=llama):
     @jax.jit
     def eval_fn(params, batch):
-        return llama.loss_fn(params, batch, cfg, mesh, axes)
+        return model.loss_fn(params, batch, cfg, mesh, axes)
     return eval_fn

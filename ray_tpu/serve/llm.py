@@ -82,16 +82,8 @@ class _LLMServer:
                            temperature: float = 0.0,
                            eos_id: Optional[int] = None) -> str:
         import asyncio
-        import time as _time
         import uuid
-        # GC abandoned streams (client crashed / stopped draining): a
-        # stream unpolled for 5 minutes is dropped. The generation
-        # itself still runs to completion in the engine — only the
-        # buffered record is reclaimed.
-        now = _time.monotonic()
-        for k in [k for k, s in self._streams.items()
-                  if now - s["last_poll"] > 300.0]:
-            del self._streams[k]
+        now = self._gc_streams()
         sid = uuid.uuid4().hex[:12]
         st = {"tokens": [], "done": False, "error": None,
               "last_poll": now}
@@ -112,6 +104,19 @@ class _LLMServer:
         asyncio.ensure_future(pump())
         return sid
 
+    def _gc_streams(self) -> float:
+        """Drop records of streams unpolled for 5 minutes (client crashed
+        or stopped draining). The generation itself still runs to
+        completion in the engine — only the buffered record is reclaimed.
+        Runs on every start AND poll so orphans are reclaimed even when no
+        new streams arrive. Returns the current monotonic time."""
+        import time as _time
+        now = _time.monotonic()
+        for k in [k for k, s in self._streams.items()
+                  if now - s["last_poll"] > 300.0]:
+            del self._streams[k]
+        return now
+
     async def stream_poll(self, sid: str, cursor: int = 0,
                           wait_s: float = 2.0) -> dict:
         """Tokens produced since `cursor`; long-polls briefly so clients
@@ -119,6 +124,7 @@ class _LLMServer:
         The stream record is dropped once polled past its end."""
         import asyncio
         import time as _time
+        self._gc_streams()
         streams = self._streams
         st = streams.get(sid)
         if st is not None:
@@ -164,10 +170,12 @@ def stream_generate(handle, tokens, **kw):
     while True:
         r = ray_tpu.get(handle.stream_poll.remote(sid, cursor),
                         timeout=300)
-        if r["error"]:
-            raise RuntimeError(f"stream failed: {r['error']}")
+        # tokens delivered alongside an error were produced before the
+        # failure — surface them to the client before raising
         yield from r["tokens"]
         cursor += len(r["tokens"])
+        if r["error"]:
+            raise RuntimeError(f"stream failed: {r['error']}")
         if r["done"]:
             return
 
