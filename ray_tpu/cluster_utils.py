@@ -51,6 +51,19 @@ class Cluster:
         self.agents.append(agent)
         return agent
 
+    def restart_head(self):
+        """Crash-restart the control service on the same address. With
+        ``config.control_persist_dir`` set, the new instance replays the
+        persisted tables and agents rejoin on their next heartbeat
+        (reference: GCS restart + NotifyGCSRestart,
+        gcs/store_client/redis_store_client.h:126)."""
+        from ray_tpu.runtime.control import ControlService
+        host, port = self.head_addr
+        self.elt.run(self.head.stop(), timeout=15)
+        self.head = ControlService(self.config)
+        self.head_addr = self.elt.run(self.head.start(host, port))
+        return self.head
+
     def remove_node(self, agent) -> None:
         self.agents.remove(agent)
         self.elt.run(agent.stop(), timeout=15)

@@ -78,6 +78,11 @@ class Config:
     metrics_port: int = -1                  # -1 off, 0 ephemeral, >0 fixed
     log_dir: str = ""                       # "" = workers inherit stdio
 
+    # --- control-plane fault tolerance ---
+    # Directory for durable control tables (GCS-persistence analog,
+    # runtime/persistence.py). "" = in-memory only.
+    control_persist_dir: str = ""
+
     extra: dict = field(default_factory=dict)
 
     @classmethod

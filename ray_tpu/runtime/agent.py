@@ -324,11 +324,48 @@ class NodeAgent:
                     pending_demand=[req["resources"]
                                     for req, _ in self._wait_queue],
                     timeout=10.0)
-                if r.get("view"):
+                if r.get("unknown"):
+                    # Control service restarted (or we were GC'd): rejoin
+                    # with the same node id and rebuild what the head lost
+                    # — the reference's NotifyGCSRestart flow inverted
+                    # (node_manager.proto:457); here the head's "unknown"
+                    # reply is the restart signal.
+                    await self._rejoin_head()
+                elif r.get("view"):
                     self.cluster_view = r["view"]
             except Exception:
                 pass
             await asyncio.sleep(period)
+
+    async def _rejoin_head(self):
+        await self.pool.call(
+            self.head_addr, "register_node", node_id=self.node_id,
+            addr=self.addr, resources_total=self.resources_total,
+            labels=self.labels)
+        # re-confirm hosted actors (their table rows survived in the
+        # persisted store; the addr refresh makes them routable again)
+        for w in list(self.workers.values()):
+            if w.actor_id is not None:
+                try:
+                    r = await self.pool.call(
+                        self.head_addr, "actor_started",
+                        actor_id=w.actor_id, addr=w.addr,
+                        node_id=self.node_id)
+                    if r.get("dead"):
+                        # the table says this actor was killed (the kill
+                        # RPC may have been lost): reap the orphan
+                        w.actor_id = None
+                        await self._kill_worker(w)
+                except Exception:
+                    pass
+        # re-publish the object directory in one bulk RPC
+        objs = self.store.sealed_objects()
+        if objs:
+            try:
+                await self.pool.call(self.head_addr, "report_objects",
+                                     node_id=self.node_id, objects=objs)
+            except Exception:
+                pass
 
     # --- worker pool ---------------------------------------------------------
 

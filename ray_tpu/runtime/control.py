@@ -110,8 +110,18 @@ class Pubsub:
 
 
 class ControlService:
-    def __init__(self, config: Optional[Config] = None):
+    def __init__(self, config: Optional[Config] = None,
+                 persist_dir: Optional[str] = None):
         self.config = config or Config.from_env()
+        # Durable tables (GCS-persistence analog, see runtime/persistence.py):
+        # set RAY_TPU_CONTROL_PERSIST_DIR or pass persist_dir to survive
+        # control-service restarts; nodes reconnect via heartbeats.
+        self._store = None
+        persist_dir = persist_dir or self.config.control_persist_dir
+        if persist_dir:
+            from ray_tpu.runtime.persistence import FileStore
+            self._store = FileStore(persist_dir)
+        self._recover_deadline = 0.0
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
@@ -158,6 +168,7 @@ class ControlService:
             "get_pg": self.get_pg,
             "list_pgs": self.list_pgs,
             "add_object_location": self.add_object_location,
+            "report_objects": self.report_objects,
             "remove_object_location": self.remove_object_location,
             "get_object_locations": self.get_object_locations,
             "poll_events": self.poll_events,
@@ -165,7 +176,61 @@ class ControlService:
             "ping": self.ping,
         }
 
+    # --- persistence --------------------------------------------------------
+
+    def _persist(self, table: str, key, value) -> None:
+        if self._store is not None:
+            self._store.put(table, key, value)
+
+    def _persist_del(self, table: str, key) -> None:
+        if self._store is not None:
+            self._store.delete(table, key)
+
+    def _persist_actor(self, a: ActorInfo) -> None:
+        self._persist("actors", a.actor_id, a)
+
+    def _recover(self) -> None:
+        """Replay persisted tables (reference: gcs/gcs_init_data.h rebuilds
+        GCS state from the store on restart). Nodes are NOT persisted —
+        agents re-register on their next heartbeat ("unknown" reply) and
+        re-confirm hosted actors + object locations."""
+        t = self._store.load_all()
+        self.kv = t.get("kv", {})
+        self.actors = t.get("actors", {})
+        for a in self.actors.values():
+            if a.name and a.state != DEAD:
+                self.named_actors[(a.namespace, a.name)] = a.actor_id
+        self.jobs = t.get("jobs", {})
+        self.submitted_jobs = t.get("submitted_jobs", {})
+        for j in self.submitted_jobs.values():
+            if j.get("status") in ("PENDING", "RUNNING"):
+                # the watcher subprocess handle died with the old control
+                # process; the job may still run but is no longer tracked
+                j["status"] = "FAILED"
+                j["error"] = "control service restarted; job untracked"
+        self.pgs = t.get("pgs", {})
+        for table, state in t.items():
+            self._store.compact(table, state)
+        # Give agents a grace window to reconnect before declaring their
+        # actors dead (they heartbeat every health_check_period_s).
+        grace = self.config.health_check_period_s * \
+            self.config.health_check_failure_threshold * 2
+        self._recover_deadline = time.monotonic() + max(grace, 5.0)
+
+    def _after_recovery_sweep(self) -> None:
+        """One-shot: actors whose node never re-registered are dead."""
+        self._recover_deadline = 0.0
+        lost = [a for a in self.actors.values()
+                if a.state in (ALIVE, PENDING, RESTARTING)
+                and (a.node_id is None or a.node_id not in self.nodes
+                     or not self.nodes[a.node_id].alive)]
+        for a in lost:
+            asyncio.ensure_future(self._on_actor_death(
+                a, "node lost across control-service restart"))
+
     async def start(self, host: str = "127.0.0.1", port: int = 0):
+        if self._store is not None:
+            self._recover()
         self.addr = await self.server.start(host, port)
         self._health_task = asyncio.ensure_future(self._health_loop())
         from ray_tpu.util import metrics as _m
@@ -188,6 +253,8 @@ class ControlService:
             await _m.release_shared_server()
         await self.server.stop()
         await self.pool.close()
+        if self._store is not None:
+            self._store.close()
 
     def _render_metrics(self) -> str:
         """Cluster-level gauges (reference: gcs metrics in
@@ -284,6 +351,8 @@ class ControlService:
         while True:
             await asyncio.sleep(period)
             now = time.monotonic()
+            if self._recover_deadline and now > self._recover_deadline:
+                self._after_recovery_sweep()
             for n in list(self.nodes.values()):
                 if n.alive and now - n.last_heartbeat > threshold:
                     await self._mark_node_dead(n.node_id, "heartbeat timeout")
@@ -313,13 +382,17 @@ class ControlService:
         if not overwrite and key in self.kv:
             return {"ok": False, "exists": True}
         self.kv[key] = value
+        self._persist("kv", key, value)
         return {"ok": True}
 
     async def kv_get(self, key: str):
         return self.kv.get(key)
 
     async def kv_del(self, key: str):
-        return {"deleted": self.kv.pop(key, None) is not None}
+        deleted = self.kv.pop(key, None) is not None
+        if deleted:
+            self._persist_del("kv", key)
+        return {"deleted": deleted}
 
     async def kv_keys(self, prefix: str = ""):
         return [k for k in self.kv if k.startswith(prefix)]
@@ -353,7 +426,9 @@ class ControlService:
         if node is None:
             info.state = DEAD
             info.death_cause = "no feasible node"
+            self._persist_actor(info)
             return {"ok": False, "error": "no feasible node for actor"}
+        self._persist_actor(info)
         return {"ok": True, "node_id": node.node_id}
 
     async def _schedule_actor(self, info: ActorInfo,
@@ -423,9 +498,15 @@ class ControlService:
         a = self.actors.get(actor_id)
         if a is None:
             return {"ok": False}
+        if a.state == DEAD:
+            # e.g. killed while the kill RPC to its agent was lost, then
+            # the agent re-reports it after a control restart: the table
+            # is authoritative — tell the agent to reap the worker.
+            return {"ok": False, "dead": True}
         a.state = ALIVE
         a.addr = tuple(addr)
         a.node_id = node_id
+        self._persist_actor(a)
         await self.pubsub.publish(
             f"actor:{actor_id.hex()}",
             {"event": "alive", "addr": a.addr})
@@ -447,6 +528,7 @@ class ControlService:
             a.num_restarts += 1
             a.state = RESTARTING
             a.addr = None
+            self._persist_actor(a)
             await self.pubsub.publish(
                 f"actor:{a.actor_id.hex()}",
                 {"event": "restarting", "restarts": a.num_restarts})
@@ -457,6 +539,7 @@ class ControlService:
         a.state = DEAD
         a.death_cause = reason
         a.addr = None
+        self._persist_actor(a)
         await self.pubsub.publish(
             f"actor:{a.actor_id.hex()}", {"event": "dead", "reason": reason})
         await self.pubsub.publish(
@@ -526,6 +609,7 @@ class ControlService:
         self.jobs[job_id] = {"job_id": job_id, "state": "RUNNING",
                              "start_time": time.time(),
                              "metadata": metadata or {}}
+        self._persist("jobs", job_id, self.jobs[job_id])
         return {"ok": True}
 
     async def finish_job(self, job_id: JobID, state: str = "SUCCEEDED"):
@@ -533,6 +617,7 @@ class ControlService:
         if j:
             j["state"] = state
             j["end_time"] = time.time()
+            self._persist("jobs", job_id, j)
         return {"ok": True}
 
     async def list_jobs(self):
@@ -585,6 +670,7 @@ class ControlService:
                "status": "RUNNING", "pid": proc.pid,
                "log_path": log_path, "start_time": time.time()}
         self.submitted_jobs[sub_id] = job
+        self._persist("submitted_jobs", sub_id, job)
         asyncio.ensure_future(self._watch_job(job, proc))
         return {"ok": True, "submission_id": sub_id}
 
@@ -601,6 +687,7 @@ class ControlService:
             job["status"] = "FAILED"
         job["returncode"] = rc
         job["end_time"] = time.time()
+        self._persist("submitted_jobs", job["submission_id"], job)
 
     async def get_submitted_job(self, submission_id: str):
         return self.submitted_jobs.get(submission_id)
@@ -713,6 +800,7 @@ class ControlService:
                                      bundle_index=idx)
                 info.bundle_nodes[idx] = node.node_id
             info.state = "CREATED"
+            self._persist("pgs", pg_id, info)
             await self.pubsub.publish("pgs",
                                       {"event": "created", "pg_id": pg_id})
             return {"ok": True, "bundle_nodes": info.bundle_nodes}
@@ -797,6 +885,7 @@ class ControlService:
             except Exception:
                 pass
         info.state = "REMOVED"
+        self._persist_del("pgs", pg_id)
         return {"ok": True}
 
     async def get_pg(self, pg_id: PlacementGroupID):
@@ -816,6 +905,14 @@ class ControlService:
                                   size: int):
         self.object_locations.setdefault(oid, {})[node_id] = size
         return {"ok": True}
+
+    async def report_objects(self, node_id: NodeID, objects) -> dict:
+        """Bulk object-directory refresh: an agent re-registering after a
+        control-service restart re-publishes every sealed object it holds
+        as [(oid, size), ...] in one RPC."""
+        for oid, size in objects:
+            self.object_locations.setdefault(oid, {})[node_id] = int(size)
+        return {"ok": True, "count": len(objects)}
 
     async def remove_object_location(self, oid: ObjectID, node_id: NodeID):
         locs = self.object_locations.get(oid)

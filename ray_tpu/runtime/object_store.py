@@ -279,6 +279,12 @@ class SharedObjectStore:
     def contains(self, oid: ObjectID) -> bool:
         return oid in self._entries
 
+    def sealed_objects(self) -> List[Tuple[ObjectID, int]]:
+        """All sealed (oid, size) pairs — the agent's bulk re-report to a
+        restarted control service (report_objects RPC)."""
+        return [(oid, e.size) for oid, e in self._entries.items()
+                if e.sealed]
+
     def is_sealed(self, oid: ObjectID) -> bool:
         e = self._entries.get(oid)
         return bool(e and e.sealed)

@@ -1,0 +1,113 @@
+"""Durable control-plane state: append-only table logs + replay.
+
+The framework's analog of GCS persistence (reference:
+src/ray/gcs/store_client/redis_store_client.h:126 and gcs/gcs_init_data.h —
+the reference persists GCS tables to Redis so a restarted GCS rebuilds its
+state and raylets reconnect). Here the control service appends every table
+mutation to a per-table log under ``persist_dir``; a restarting control
+service replays the logs, then nodes re-register on their next heartbeat
+(the inverse of the reference's NotifyGCSRestart push).
+
+Format per record: 4-byte LE length + pickled ``(op, key, value)`` where op
+is "put" or "del". Logs are compacted on load (rewritten from the replayed
+state) so they stay proportional to live state, not mutation count.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+from typing import Any, Dict, Optional
+
+_LEN = struct.Struct("<I")
+
+
+class FileStore:
+    """Append-only per-table logs under one directory."""
+
+    def __init__(self, root: str, fsync: bool = False):
+        self.root = root
+        self.fsync = fsync
+        os.makedirs(root, exist_ok=True)
+        self._files: Dict[str, Any] = {}
+
+    def _path(self, table: str) -> str:
+        return os.path.join(self.root, f"{table}.log")
+
+    def _file(self, table: str):
+        f = self._files.get(table)
+        if f is None:
+            f = open(self._path(table), "ab", buffering=0)
+            self._files[table] = f
+        return f
+
+    def _append(self, table: str, rec: tuple) -> None:
+        payload = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+        f = self._file(table)
+        f.write(_LEN.pack(len(payload)) + payload)
+        if self.fsync:
+            os.fsync(f.fileno())
+
+    def put(self, table: str, key: Any, value: Any) -> None:
+        self._append(table, ("put", key, value))
+
+    def delete(self, table: str, key: Any) -> None:
+        self._append(table, ("del", key, None))
+
+    def load_table(self, table: str) -> Dict[Any, Any]:
+        """Replay one table's log; truncated tails (crash mid-append) are
+        dropped."""
+        state: Dict[Any, Any] = {}
+        path = self._path(table)
+        if not os.path.exists(path):
+            return state
+        with open(path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off + _LEN.size <= len(data):
+            (n,) = _LEN.unpack_from(data, off)
+            if off + _LEN.size + n > len(data):
+                break                      # torn tail record
+            try:
+                op, key, value = pickle.loads(
+                    data[off + _LEN.size: off + _LEN.size + n])
+            except Exception:
+                break                      # corrupt tail
+            if op == "put":
+                state[key] = value
+            else:
+                state.pop(key, None)
+            off += _LEN.size + n
+        return state
+
+    def load_all(self) -> Dict[str, Dict[Any, Any]]:
+        tables = {}
+        for fn in os.listdir(self.root):
+            if fn.endswith(".log"):
+                name = fn[:-4]
+                tables[name] = self.load_table(name)
+        return tables
+
+    def compact(self, table: str, state: Dict[Any, Any]) -> None:
+        """Rewrite a table's log to exactly the given state."""
+        f = self._files.pop(table, None)
+        if f is not None:
+            f.close()
+        tmp = self._path(table) + ".tmp"
+        with open(tmp, "wb") as out:
+            for key, value in state.items():
+                payload = pickle.dumps(("put", key, value),
+                                       protocol=pickle.HIGHEST_PROTOCOL)
+                out.write(_LEN.pack(len(payload)) + payload)
+            out.flush()
+            os.fsync(out.fileno())
+        os.replace(tmp, self._path(table))
+
+    def close(self) -> None:
+        for f in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files.clear()

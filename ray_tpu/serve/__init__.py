@@ -13,9 +13,10 @@ from ray_tpu.serve.api import (Application, Deployment, delete, deployment,
                                shutdown, status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "Application", "Deployment", "DeploymentHandle", "batch", "delete",
-    "deployment", "get_deployment_handle", "proxy_address", "run",
-    "shutdown", "status",
+    "deployment", "get_deployment_handle", "get_multiplexed_model_id",
+    "multiplexed", "proxy_address", "run", "shutdown", "status",
 ]

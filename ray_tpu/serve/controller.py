@@ -31,7 +31,7 @@ HEALTH_CHECK_TIMEOUT_S = 10.0
 
 class _ReplicaInfo:
     __slots__ = ("actor_id", "state", "name", "started_at",
-                 "last_healthy", "ongoing")
+                 "last_healthy", "ongoing", "model_ids")
 
     def __init__(self, actor_id: ActorID, name: str):
         self.actor_id = actor_id
@@ -40,6 +40,7 @@ class _ReplicaInfo:
         self.started_at = time.time()
         self.last_healthy = time.time()
         self.ongoing = 0
+        self.model_ids: List[str] = []   # multiplexed models loaded here
 
 
 class _DeploymentState:
@@ -162,9 +163,24 @@ class ServeController:
     async def get_routing_table(self, deployment_name: str) -> dict:
         dep = self.deployments.get(deployment_name)
         if dep is None:
-            return {"replicas": [], "version": -1}
-        return {"replicas": [r.actor_id.binary() for r in dep.running()],
+            return {"replicas": [], "version": -1, "model_ids": []}
+        running = dep.running()
+        return {"replicas": [r.actor_id.binary() for r in running],
+                "model_ids": [list(r.model_ids) for r in running],
                 "version": dep.version}
+
+    async def report_model_ids(self, deployment_name: str,
+                               replica_id: str, ids: list) -> bool:
+        """Replicas push their loaded multiplexed-model sets here
+        (serve/multiplex.py); handles read them off the routing table."""
+        dep = self.deployments.get(deployment_name)
+        if dep is None:
+            return False
+        info = dep.replicas.get(replica_id)
+        if info is None:
+            return False
+        info.model_ids = [str(i) for i in ids]
+        return True
 
     async def get_ingress_routes(self) -> List[dict]:
         """[{route_prefix, deployment}] sorted longest-prefix-first."""

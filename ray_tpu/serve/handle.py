@@ -46,6 +46,7 @@ class _Router:
     def __init__(self, deployment_name: str):
         self.name = deployment_name
         self.replicas: List[bytes] = []     # actor id bytes
+        self.model_ids: Dict[bytes, set] = {}   # multiplexed models loaded
         self.version = -1
         self.fetched_at = 0.0
         self.inflight: Dict[bytes, int] = {}
@@ -65,6 +66,10 @@ class _Router:
                 self.name), timeout=timeout)
             with self.lock:
                 self.replicas = [bytes(r) for r in table["replicas"]]
+                mids = table.get("model_ids") or []
+                self.model_ids = {
+                    rid: set(mids[i]) if i < len(mids) else set()
+                    for i, rid in enumerate(self.replicas)}
                 self.version = table["version"]
                 self.fetched_at = time.monotonic()
             if self.replicas or not block_until_nonempty:
@@ -74,10 +79,18 @@ class _Router:
                     f"deployment {self.name!r} has no running replicas")
             time.sleep(0.1)
 
-    def pick(self) -> bytes:
-        """Power-of-two-choices by local in-flight counts."""
+    def pick(self, model_id: Optional[str] = None) -> bytes:
+        """Power-of-two-choices by local in-flight counts. With a
+        multiplexed model id, replicas that already hold the model are
+        preferred (p2c among them); a cold model falls through to plain
+        p2c and the chosen replica loads it."""
         with self.lock:
             reps = list(self.replicas)
+            if model_id is not None:
+                warm = [r for r in reps
+                        if model_id in self.model_ids.get(r, ())]
+                if warm:
+                    reps = warm
         if not reps:
             raise RuntimeError(f"no replicas for {self.name!r}")
         if len(reps) == 1:
@@ -137,12 +150,15 @@ class DeploymentHandle:
     """Routes calls to a deployment's replicas (p2c). Picklable — ships
     across actors as a name reference."""
 
-    def __init__(self, deployment_name: str, _pin: bytes = None):
+    def __init__(self, deployment_name: str, _pin: bytes = None,
+                 _model_id: str = None):
         self.deployment_name = deployment_name
         self._pin = _pin
+        self._model_id = _model_id
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self._pin))
+        return (DeploymentHandle,
+                (self.deployment_name, self._pin, self._model_id))
 
     def pinned(self) -> "DeploymentHandle":
         """A handle bound to ONE replica (picked now) — for stateful
@@ -150,7 +166,9 @@ class DeploymentHandle:
         on the replica holding the stream."""
         router = _router_for(self.deployment_name)
         router.refresh()
-        return DeploymentHandle(self.deployment_name, router.pick())
+        return DeploymentHandle(self.deployment_name,
+                                router.pick(self._model_id),
+                                self._model_id)
 
     def __getattr__(self, name):
         if name.startswith("_") or name in ("deployment_name",):
@@ -170,10 +188,16 @@ class DeploymentHandle:
             rid = self._pin
         else:
             router.refresh()
-            rid = router.pick()
+            rid = router.pick(self._model_id)
         replica = ActorHandle(ActorID(rid))
+        meta = {"multiplexed_model_id": self._model_id} \
+            if self._model_id else None
         try:
-            ref = replica.handle_request.remote(method, args, kwargs)
+            if meta is None:
+                ref = replica.handle_request.remote(method, args, kwargs)
+            else:
+                ref = replica.handle_request.remote(
+                    method, args, kwargs, meta)
         except api.RayTpuError:
             if self._pin is not None or _retries <= 0:
                 raise  # pinned state died with its replica — no rerouting
@@ -182,5 +206,9 @@ class DeploymentHandle:
         router.track(rid, ref)
         return ref
 
-    def options(self, **_opts) -> "DeploymentHandle":
+    def options(self, multiplexed_model_id: str = None,
+                **_opts) -> "DeploymentHandle":
+        if multiplexed_model_id is not None:
+            return DeploymentHandle(self.deployment_name, self._pin,
+                                    str(multiplexed_model_id))
         return self

@@ -41,33 +41,103 @@ class Replica:
         self._processed = 0
         self._errors = 0
         self._started_at = time.time()
+        # multiplexed-model loaders push loaded-set changes to the
+        # controller so handles can route model-affine (serve/multiplex.py);
+        # classes that reject new attributes (__slots__ etc.) just serve
+        # without the routing hint
+        self._model_active: Dict[str, int] = {}
+        try:
+            self.instance.__serve_multiplex_notify__ = self._notify_model_ids
+            self.instance.__serve_multiplex_active__ = self._model_active
+        except (AttributeError, TypeError):
+            pass
+        self._model_ids_dirty = False
         if user_config is not None and hasattr(self.instance, "reconfigure"):
             self.instance.reconfigure(user_config)
 
     # -- data path ---------------------------------------------------------
 
-    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+    async def handle_request(self, method: str, args: tuple, kwargs: dict,
+                             meta: Optional[dict] = None):
         """Run a user method. Coroutine methods run on the actor's event
         loop (enables @serve.batch coalescing); sync methods run on the
-        actor's thread pool via the worker's executor."""
+        actor's thread pool via the worker's executor. ``meta`` carries
+        request metadata (currently the multiplexed model id)."""
+        import contextvars
+
+        from ray_tpu.serve.multiplex import _current_model_id
         self._ongoing += 1
+        token = None
+        mid = (meta or {}).get("multiplexed_model_id")
+        if mid:
+            token = _current_model_id.set(mid)
+            # in-use count: deferred eviction waits for this to drain
+            # before shutting a model down (serve/multiplex.py _evict_lru)
+            self._model_active[mid] = self._model_active.get(mid, 0) + 1
         try:
             fn = getattr(self.instance, method)
             if inspect.iscoroutinefunction(fn):
                 out = await fn(*args, **kwargs)
             else:
                 loop = asyncio.get_running_loop()
+                ctx = contextvars.copy_context()
                 out = await loop.run_in_executor(
-                    None, lambda: fn(*args, **kwargs))
+                    None, lambda: ctx.run(fn, *args, **kwargs))
             self._processed += 1
             return out
         except BaseException:
             self._errors += 1
             raise
         finally:
+            if token is not None:
+                _current_model_id.reset(token)
+                n = self._model_active.get(mid, 1) - 1
+                if n <= 0:
+                    self._model_active.pop(mid, None)
+                else:
+                    self._model_active[mid] = n
             self._ongoing -= 1
 
     # -- control path ------------------------------------------------------
+
+    def _notify_model_ids(self):
+        """Push the loaded-model set to the controller (debounced); the
+        routing tables handles fetch then steer model-tagged requests to
+        replicas already holding the model."""
+        if self._model_ids_dirty:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        self._model_ids_dirty = True
+
+        async def push():
+            await asyncio.sleep(0.05)          # coalesce load bursts
+            self._model_ids_dirty = False
+            from ray_tpu.serve.multiplex import instance_model_ids
+            ids = instance_model_ids(self.instance)
+
+            def report():
+                from ray_tpu import api
+                from ray_tpu.serve.handle import CONTROLLER_NAME, \
+                    SERVE_NAMESPACE
+                c = api.get_actor(CONTROLLER_NAME,
+                                  namespace=SERVE_NAMESPACE)
+                c.report_model_ids.remote(
+                    self.deployment_name, self.replica_id, ids)
+
+            try:
+                # api calls can block; keep them off the actor loop
+                await loop.run_in_executor(None, report)
+            except Exception:
+                pass        # routing hint only — next change retries
+
+        self._push_task = loop.create_task(push())
+
+    def model_ids(self) -> list:
+        from ray_tpu.serve.multiplex import instance_model_ids
+        return instance_model_ids(self.instance)
 
     def ping(self) -> str:
         """Health check; also honors a user-defined check_health()."""
