@@ -1,11 +1,14 @@
-"""Minimal RL layer: parallel rollout actors + jitted PPO learner.
+"""RL layer: parallel rollout actors + jitted learners.
 
 Analog of the reference's RLlib core loop (reference: python/ray/rllib/
 algorithms/algorithm.py train() driving env_runner_group + learner_group)
-at the scale of one algorithm done properly on jax.
+covering both halves of the algorithm matrix: on-policy (PPO) and
+off-policy with a replay-buffer actor (DQN).
 """
 
+from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.env import CartPoleVec, make_env
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
-__all__ = ["PPO", "PPOConfig", "CartPoleVec", "make_env"]
+__all__ = ["DQN", "DQNConfig", "PPO", "PPOConfig", "ReplayBuffer",
+           "CartPoleVec", "make_env"]

@@ -1,0 +1,262 @@
+"""DQN: off-policy learning over a replay-buffer actor.
+
+The off-policy half of the reference's algorithm matrix (reference:
+python/ray/rllib/algorithms/dqn/dqn.py + utils/replay_buffers/ — env
+runners feed a replay buffer, the learner samples uniformly and applies
+double-DQN updates against a periodically-synced target network), built
+TPU-idiomatically: the replay buffer is a runtime actor holding numpy
+ring storage, and the entire K-minibatch update loop runs as ONE jitted
+``lax.scan`` so the learner does a single dispatch per train iteration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict
+
+import jax
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+
+
+# --- Q network (MLP, same init scheme as ppo.init_policy) ---------------
+
+def init_q(rng, obs_dim: int, n_actions: int, hidden=(64, 64)):
+    from ray_tpu.rllib.nets import head, init_trunk
+    sizes = (obs_dim, *hidden)
+    keys = jax.random.split(rng, len(sizes))
+    params = init_trunk(keys, sizes)
+    params["w_q"], params["b_q"] = head(
+        keys[-1], sizes[-1], n_actions, 0.01)
+    return params
+
+
+def q_forward(params, obs):
+    """obs (B, obs_dim) -> q-values (B, A)."""
+    from ray_tpu.rllib.nets import trunk_forward
+    return trunk_forward(params, obs) @ params["w_q"] + params["b_q"]
+
+
+# --- replay buffer actor ------------------------------------------------
+
+@ray_tpu.remote
+class ReplayBuffer:
+    """Uniform ring replay (reference:
+    rllib/utils/replay_buffers/replay_buffer.py). Stores flat numpy
+    transition arrays; sampling returns a dict of stacked minibatches so
+    the learner can scan over them in one jitted call."""
+
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self.idx = 0
+        self.full = False
+        self.rng = np.random.default_rng(seed)
+
+    def add(self, batch: Dict[str, np.ndarray]) -> int:
+        n = len(batch["actions"])
+        ids = (self.idx + np.arange(n)) % self.capacity
+        self.obs[ids] = batch["obs"]
+        self.next_obs[ids] = batch["next_obs"]
+        self.actions[ids] = batch["actions"]
+        self.rewards[ids] = batch["rewards"]
+        self.dones[ids] = batch["dones"]
+        self.idx = int((self.idx + n) % self.capacity)
+        self.full = self.full or self.idx < n or self.idx == 0
+        return len(self)
+
+    def __len__(self):
+        return self.capacity if self.full else self.idx
+
+    def size(self) -> int:
+        return len(self)
+
+    def sample(self, batch_size: int, num_batches: int):
+        """(num_batches, batch_size, ...) stacked minibatches, or None
+        until the buffer holds at least one batch."""
+        n = len(self)
+        if n < batch_size:
+            return None
+        ids = self.rng.integers(0, n, size=(num_batches, batch_size))
+        return {"obs": self.obs[ids], "next_obs": self.next_obs[ids],
+                "actions": self.actions[ids],
+                "rewards": self.rewards[ids], "dones": self.dones[ids]}
+
+
+# --- exploration actor --------------------------------------------------
+
+@ray_tpu.remote
+class DQNRunner:
+    """Epsilon-greedy transition collector (reference:
+    rllib/env/single_agent_env_runner.py under DQN's config)."""
+
+    def __init__(self, env_name: str, num_envs: int, steps_per_call: int,
+                 seed: int):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        self.env = make_env(env_name, num_envs, seed)
+        self.steps_per_call = steps_per_call
+        self.obs = self.env.reset_all()
+        self.rng = np.random.default_rng(seed)
+        self.ep_ret = np.zeros(num_envs, np.float32)
+        from collections import deque
+        self.done_returns = deque(maxlen=100)
+        self._q = jax.jit(q_forward)
+
+    def sample(self, params, epsilon: float) -> Dict[str, np.ndarray]:
+        out = {k: [] for k in
+               ("obs", "next_obs", "actions", "rewards", "dones")}
+        for _ in range(self.steps_per_call):
+            q = np.asarray(self._q(params, self.obs))
+            greedy = q.argmax(axis=1)
+            rand = self.rng.integers(0, q.shape[1], size=len(greedy))
+            explore = self.rng.random(len(greedy)) < epsilon
+            a = np.where(explore, rand, greedy).astype(np.int32)
+            obs2, r, done = self.env.step(a)
+            out["obs"].append(self.obs)
+            # env auto-resets on done: obs2 rows where done are the NEXT
+            # episode's start, but the (1-done) mask in the TD target
+            # zeroes the bootstrap there so the value never leaks across
+            out["next_obs"].append(obs2)
+            out["actions"].append(a)
+            out["rewards"].append(r)
+            out["dones"].append(done.astype(np.float32))
+            self.ep_ret += r
+            if done.any():
+                for i in np.where(done)[0]:
+                    self.done_returns.append(float(self.ep_ret[i]))
+                    self.ep_ret[i] = 0.0
+            self.obs = obs2
+        batch = {k: np.concatenate(v) for k, v in out.items()}
+        batch["episode_returns"] = np.array(self.done_returns, np.float32)
+        return batch
+
+
+# --- learner ------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("gamma", "lr"))
+def dqn_update(params, target_params, opt_state, batches, *,
+               gamma=0.99, lr=1e-3):
+    """Double-DQN over a stack of minibatches in one lax.scan."""
+    import jax.numpy as jnp
+    import optax
+
+    opt = optax.adam(lr)
+
+    def loss_fn(p, mb):
+        q = q_forward(p, mb["obs"])
+        q_sel = jnp.take_along_axis(
+            q, mb["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+        # double-DQN: online net picks the argmax, target net scores it
+        next_a = q_forward(p, mb["next_obs"]).argmax(axis=1)
+        next_q = jnp.take_along_axis(
+            q_forward(target_params, mb["next_obs"]),
+            next_a[:, None], axis=1)[:, 0]
+        target = mb["rewards"] + gamma * (1.0 - mb["dones"]) \
+            * jax.lax.stop_gradient(next_q)
+        return jnp.mean((q_sel - target) ** 2)
+
+    def step(carry, mb):
+        p, os_ = carry
+        l, g = jax.value_and_grad(loss_fn)(p, mb)
+        updates, os_ = opt.update(g, os_, p)
+        p = optax.apply_updates(p, updates)
+        return (p, os_), l
+
+    (params, opt_state), losses = jax.lax.scan(
+        step, (params, opt_state), batches)
+    return params, opt_state, losses.mean()
+
+
+@dataclass
+class DQNConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 8
+    steps_per_call: int = 32          # env steps per runner per iteration
+    buffer_capacity: int = 50_000
+    learning_starts: int = 500        # min transitions before updates
+    batch_size: int = 64
+    updates_per_iter: int = 16
+    target_sync_every: int = 4        # iterations between target syncs
+    gamma: float = 0.99
+    lr: float = 1e-3
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_iters: int = 40
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    runner_options: dict = field(default_factory=dict)
+
+
+class DQN:
+    def __init__(self, config: DQNConfig):
+        import optax
+        self.cfg = config
+        env = make_env(config.env, 1, 0)
+        self.obs_dim, self.n_actions = env.OBS_DIM, env.N_ACTIONS
+        self.params = init_q(jax.random.PRNGKey(config.seed),
+                             self.obs_dim, self.n_actions, config.hidden)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.opt_state = optax.adam(config.lr).init(self.params)
+        self.buffer = ReplayBuffer.remote(
+            config.buffer_capacity, self.obs_dim, config.seed)
+        self.runners = [
+            DQNRunner.options(**config.runner_options).remote(
+                config.env, config.num_envs_per_runner,
+                config.steps_per_call, config.seed + 100 + i)
+            for i in range(config.num_env_runners)]
+        self._iter = 0
+
+    def epsilon(self) -> float:
+        c = self.cfg
+        frac = min(1.0, self._iter / max(c.epsilon_decay_iters, 1))
+        return c.epsilon_start + frac * (c.epsilon_end - c.epsilon_start)
+
+    def train(self) -> dict:
+        """One iteration: parallel exploration -> buffer add -> K jitted
+        double-DQN minibatch updates -> (periodic) target sync."""
+        import jax.numpy as jnp
+        self._iter += 1
+        c = self.cfg
+        eps = self.epsilon()
+        host_params = jax.device_get(self.params)
+        batches = ray_tpu.get(
+            [r.sample.remote(host_params, eps) for r in self.runners],
+            timeout=300)
+        ep_rets = [b.pop("episode_returns") for b in batches]
+        sizes = ray_tpu.get(
+            [self.buffer.add.remote(b) for b in batches], timeout=300)
+        loss = float("nan")
+        if sizes[-1] >= max(c.learning_starts, c.batch_size):
+            mbs = ray_tpu.get(self.buffer.sample.remote(
+                c.batch_size, c.updates_per_iter), timeout=300)
+            if mbs is not None:
+                mbs = {k: jnp.asarray(v) for k, v in mbs.items()}
+                self.params, self.opt_state, l = dqn_update(
+                    self.params, self.target_params, self.opt_state,
+                    mbs, gamma=c.gamma, lr=c.lr)
+                loss = float(l)
+        if self._iter % c.target_sync_every == 0:
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        ep = np.concatenate([e for e in ep_rets if len(e)]) \
+            if any(len(e) for e in ep_rets) else np.array([0.0])
+        return {"training_iteration": self._iter,
+                "episode_reward_mean": float(ep.mean()),
+                "loss": loss, "epsilon": eps,
+                "buffer_size": int(sizes[-1]),
+                "timesteps_this_iter": int(
+                    c.num_env_runners * c.num_envs_per_runner
+                    * c.steps_per_call)}
+
+    def get_policy_params(self):
+        return jax.device_get(self.params)

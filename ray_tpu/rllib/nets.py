@@ -1,0 +1,41 @@
+"""Shared policy/value network building blocks.
+
+One MLP trunk (He-init hidden layers, tanh activations) reused by every
+algorithm head — the minimal analog of the reference's RLModule catalog
+(reference: rllib/core/rl_module/ + models/catalog.py deduplicate network
+construction the same way).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def init_trunk(keys, sizes) -> dict:
+    """Hidden layers w0/b0..wn/bn for sizes=(in, h1, ..., hn)."""
+    import jax.numpy as jnp
+    params = {}
+    for i in range(len(sizes) - 1):
+        params[f"w{i}"] = jnp.asarray(
+            jax.random.normal(keys[i], (sizes[i], sizes[i + 1]))
+            * np.sqrt(2 / sizes[i]), jnp.float32)
+        params[f"b{i}"] = jnp.zeros(sizes[i + 1], jnp.float32)
+    return params
+
+
+def trunk_forward(params, obs):
+    """obs (B, obs_dim) -> features (B, hidden[-1])."""
+    import jax.numpy as jnp
+    x = obs
+    i = 0
+    while f"w{i}" in params:
+        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+        i += 1
+    return x
+
+
+def head(key, in_dim: int, out_dim: int, scale: float):
+    import jax.numpy as jnp
+    return jnp.asarray(jax.random.normal(key, (in_dim, out_dim)) * scale,
+                       jnp.float32), jnp.zeros(out_dim, jnp.float32)

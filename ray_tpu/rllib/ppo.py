@@ -26,30 +26,20 @@ from ray_tpu.rllib.env import make_env
 # --- pure-jax policy ----------------------------------------------------
 
 def init_policy(rng, obs_dim: int, n_actions: int, hidden=(64, 64)):
-    params = {}
+    from ray_tpu.rllib.nets import head, init_trunk
     sizes = (obs_dim, *hidden)
     keys = jax.random.split(rng, len(sizes) + 1)
-    for i in range(len(sizes) - 1):
-        params[f"w{i}"] = jax.random.normal(
-            keys[i], (sizes[i], sizes[i + 1])) * np.sqrt(2 / sizes[i])
-        params[f"b{i}"] = np.zeros(sizes[i + 1], np.float32) + 0.0
-    params["w_pi"] = jax.random.normal(
-        keys[-2], (sizes[-1], n_actions)) * 0.01
-    params["b_pi"] = np.zeros(n_actions, np.float32) + 0.0
-    params["w_v"] = jax.random.normal(keys[-1], (sizes[-1], 1)) * 1.0
-    params["b_v"] = np.zeros(1, np.float32) + 0.0
-    import jax.numpy as jnp
-    return {k: jnp.asarray(v, jnp.float32) for k, v in params.items()}
+    params = init_trunk(keys, sizes)
+    params["w_pi"], params["b_pi"] = head(
+        keys[-2], sizes[-1], n_actions, 0.01)
+    params["w_v"], params["b_v"] = head(keys[-1], sizes[-1], 1, 1.0)
+    return params
 
 
 def policy_forward(params, obs):
     """obs (B, obs_dim) -> (logits (B, A), value (B,))."""
-    import jax.numpy as jnp
-    x = obs
-    i = 0
-    while f"w{i}" in params:
-        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
-        i += 1
+    from ray_tpu.rllib.nets import trunk_forward
+    x = trunk_forward(params, obs)
     logits = x @ params["w_pi"] + params["b_pi"]
     value = (x @ params["w_v"] + params["b_v"])[:, 0]
     return logits, value

@@ -46,3 +46,31 @@ def test_ppo_learns_cartpole():
         assert any(k.startswith("w") for k in params)
     finally:
         ray_tpu.shutdown()
+
+
+def test_dqn_learns_cartpole():
+    from ray_tpu.rllib import DQN, DQNConfig
+    ray_tpu.init(num_cpus=4)
+    try:
+        algo = DQN(DQNConfig(num_env_runners=2, num_envs_per_runner=8,
+                             steps_per_call=64, learning_starts=512,
+                             updates_per_iter=32, seed=7))
+        best, first, losses = -1.0, None, []
+        for _ in range(30):
+            res = algo.train()
+            assert res["timesteps_this_iter"] == 2 * 8 * 64
+            if first is None and res["episode_reward_mean"] > 0:
+                first = res["episode_reward_mean"]
+            best = max(best, res["episode_reward_mean"])
+            if np.isfinite(res["loss"]):
+                losses.append(res["loss"])
+        assert losses, "updates never started"
+        assert res["buffer_size"] > 512
+        assert res["epsilon"] < 0.3          # schedule decayed
+        # Random policy scores ~20; a learning one clears 2.5x that.
+        assert first is not None
+        assert best > max(50.0, 1.5 * first), (first, best)
+        params = algo.get_policy_params()
+        assert "w_q" in params
+    finally:
+        ray_tpu.shutdown()
