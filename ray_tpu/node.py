@@ -152,7 +152,10 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if not args.head and not args.address:
         p.error("one of --head / --address is required")
-    return asyncio.run(_amain(args))
+    from ray_tpu.runtime.rpc import new_event_loop
+    loop = new_event_loop()
+    asyncio.set_event_loop(loop)
+    return loop.run_until_complete(_amain(args))
 
 
 if __name__ == "__main__":

@@ -324,6 +324,10 @@ class NodeAgent:
                     pending_demand=[req["resources"]
                                     for req, _ in self._wait_queue],
                     timeout=10.0)
+                if r.get("drained"):
+                    # deliberately removed: stop beating — the node is
+                    # mid-teardown and must not be resurrected
+                    return
                 if r.get("unknown"):
                     # Control service restarted (or we were GC'd): rejoin
                     # with the same node id and rebuild what the head lost
@@ -338,10 +342,12 @@ class NodeAgent:
             await asyncio.sleep(period)
 
     async def _rejoin_head(self):
-        await self.pool.call(
+        r = await self.pool.call(
             self.head_addr, "register_node", node_id=self.node_id,
             addr=self.addr, resources_total=self.resources_total,
             labels=self.labels)
+        if not r.get("ok"):
+            return  # drained across the restart: stay out
         # re-confirm hosted actors (their table rows survived in the
         # persisted store; the addr refresh makes them routable again)
         for w in list(self.workers.values()):

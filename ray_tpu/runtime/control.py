@@ -122,6 +122,7 @@ class ControlService:
             from ray_tpu.runtime.persistence import FileStore
             self._store = FileStore(persist_dir)
         self._recover_deadline = 0.0
+        self._drained: set = set()         # node ids removed for good
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
@@ -209,6 +210,7 @@ class ControlService:
                 j["status"] = "FAILED"
                 j["error"] = "control service restarted; job untracked"
         self.pgs = t.get("pgs", {})
+        self._drained = set(t.get("drained", {}))
         for table, state in t.items():
             self._store.compact(table, state)
         # Give agents a grace window to reconnect before declaring their
@@ -283,6 +285,10 @@ class ControlService:
 
     async def register_node(self, node_id: NodeID, addr, resources_total,
                             labels=None):
+        if node_id in self._drained:
+            # deliberately removed; a re-register (e.g. rejoin after a
+            # control restart) must not resurrect it
+            return {"ok": False, "drained": True}
         self.nodes[node_id] = NodeInfo(
             node_id=node_id, addr=tuple(addr),
             resources_total=dict(resources_total),
@@ -342,6 +348,10 @@ class ControlService:
         n = self.nodes.get(node_id)
         if n is not None:
             n.drained = True
+        self._drained.add(node_id)
+        # drain intent must survive a control restart, or the dying
+        # node's agent would rejoin as a fresh healthy node
+        self._persist("drained", node_id, True)
         await self._mark_node_dead(node_id, "drained")
         return {"ok": True}
 

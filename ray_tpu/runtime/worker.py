@@ -336,8 +336,11 @@ async def _amain():
 
 
 def main():
+    from ray_tpu.runtime.rpc import new_event_loop
+    loop = new_event_loop()
+    asyncio.set_event_loop(loop)
     try:
-        asyncio.run(_amain())
+        loop.run_until_complete(_amain())
     except (KeyboardInterrupt, SystemExit):
         pass
 

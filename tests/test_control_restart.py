@@ -133,3 +133,19 @@ def test_tasks_run_through_restart(persist_cluster):
     # in-flight tasks run worker-direct (ownership model): the control
     # restart must not fail them
     assert ray_tpu.get(refs, timeout=120) == [i * 2 for i in range(8)]
+
+
+def test_drained_node_stays_out_across_restart(persist_cluster):
+    c = persist_cluster
+    agent = c.agents[-1]
+    nid = agent.node_id
+    # drain WITHOUT stopping the agent process: its heartbeat loop is
+    # still running when the control service crash-restarts
+    c.elt.run(c.head.pool.call(c.head_addr, "drain_node", node_id=nid))
+    c.restart_head()
+    time.sleep(2.0)   # several heartbeat periods for any rejoin attempt
+    nodes = c.elt.run(c.head.pool.call(c.head_addr, "get_nodes"))
+    alive = {n["node_id"] for n in nodes if n["alive"]}
+    assert nid not in alive, "drained node resurrected after restart"
+    # the other node rejoined fine
+    assert any(a.node_id in alive for a in c.agents[:-1])
