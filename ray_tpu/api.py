@@ -620,11 +620,32 @@ def available_resources() -> Dict[str, float]:
     return out
 
 
-def timeline() -> List[dict]:
+def timeline(all_nodes: bool = False,
+             chrome_path: Optional[str] = None) -> List[dict]:
     """Task/actor event timeline (reference: _private/state.py:1010).
-    Populated by the observability module when enabled."""
+
+    ``all_nodes=True`` collects every node's worker span buffers through
+    the control service (submit edges + exec spans, util/tracing.py);
+    ``chrome_path=`` additionally writes a chrome://tracing / Perfetto
+    JSON file and the returned records are the chrome-trace events."""
     from ray_tpu.util import events
-    return events.dump()
+    if all_nodes:
+        ctx = _require_init()
+        r = _run(ctx.pool.call(ctx.head_addr, "collect_timeline",
+                               timeout=45.0))
+        evs = list(r.get("events", []))
+        if _g.agent is None:
+            # driver attached to an externally-started node: its local
+            # buffer isn't behind any agent — append it. (With an
+            # in-process agent the buffer is process-global and
+            # node_timeline already returned it, tagged.)
+            evs += events.dump()
+    else:
+        evs = events.dump()
+    if chrome_path is not None:
+        from ray_tpu.util import tracing
+        return tracing.to_chrome(evs, chrome_path)
+    return evs
 
 
 # --- placement groups --------------------------------------------------------

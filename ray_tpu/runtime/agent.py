@@ -115,6 +115,7 @@ class NodeAgent:
             "fetch_chunk": self.fetch_chunk,
             "free_objects": self.free_objects,
             "node_stats": self.node_stats,
+            "node_timeline": self.node_timeline,
             "ping": self.ping,
         }
 
@@ -301,6 +302,28 @@ class NodeAgent:
                 "workers": len([w for w in self.workers.values()
                                 if w.state != DEAD]),
                 "store": self.store.stats()}
+
+    async def node_timeline(self):
+        """This node's merged event/span buffers: the agent's own plus
+        every live worker's (util/tracing.py; the control service fans
+        out to all agents for the cluster view)."""
+        from ray_tpu.util import events
+        nid = self.node_id.hex()
+        out = [{**e, "node": nid} for e in events.dump()]
+
+        async def pull(addr):
+            try:
+                r = await self.pool.call(addr, "get_events", timeout=5.0)
+                return r.get("events", [])
+            except Exception:
+                return []
+
+        results = await asyncio.gather(*[
+            pull(w.addr) for w in list(self.workers.values())
+            if w.state != DEAD and w.addr is not None])
+        for evs in results:
+            out.extend(evs)
+        return {"events": out}
 
     # --- heartbeats / cluster view ------------------------------------------
 

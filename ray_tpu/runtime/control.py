@@ -170,6 +170,7 @@ class ControlService:
             "list_pgs": self.list_pgs,
             "add_object_location": self.add_object_location,
             "report_objects": self.report_objects,
+            "collect_timeline": self.collect_timeline,
             "remove_object_location": self.remove_object_location,
             "get_object_locations": self.get_object_locations,
             "poll_events": self.poll_events,
@@ -305,6 +306,12 @@ class ControlService:
         across GcsHealthCheckManager and ray_syncer; one RPC suffices at
         TPU-pod node counts). Reply carries the full cluster resource view
         so every agent can make spillback decisions locally."""
+        if node_id in self._drained:
+            # covers the restart case too: the node isn't in self.nodes
+            # (nodes aren't persisted) but the drain intent is — reply
+            # "drained", not "unknown", so the agent stands down instead
+            # of retrying _rejoin_head every period
+            return {"ok": False, "drained": True}
         n = self.nodes.get(node_id)
         if n is None:
             return {"ok": False, "unknown": True}
@@ -915,6 +922,21 @@ class ControlService:
                                   size: int):
         self.object_locations.setdefault(oid, {})[node_id] = size
         return {"ok": True}
+
+    async def collect_timeline(self) -> dict:
+        """Cluster-wide event/span collection: fan out to every alive
+        agent (reference surface: ray.timeline via gcs_task_manager)."""
+        async def pull(addr):
+            try:
+                r = await self.pool.call(addr, "node_timeline",
+                                         timeout=10.0)
+                return r.get("events", [])
+            except Exception:
+                return []
+
+        results = await asyncio.gather(*[
+            pull(n.addr) for n in list(self.nodes.values()) if n.alive])
+        return {"events": [e for evs in results for e in evs]}
 
     async def report_objects(self, node_id: NodeID, objects) -> dict:
         """Bulk object-directory refresh: an agent re-registering after a

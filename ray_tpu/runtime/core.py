@@ -27,6 +27,7 @@ from ray_tpu.config import Config
 from ray_tpu.runtime import rpc
 from ray_tpu.runtime.ids import (ActorID, NodeID, ObjectID, TaskID, WorkerID)
 from ray_tpu.runtime.object_store import SharedStoreReader
+from ray_tpu.util import tracing
 from ray_tpu.runtime.serialization import (FunctionCache, Serialized,
                                            dumps_oob, loads_oob)
 
@@ -872,6 +873,8 @@ class CoreContext:
                    else self.config.default_max_task_retries)
         task_id = TaskID.generate()
         _M_TASKS().inc()
+        tracing.record_submit(task_id.hex(), "task",
+                              getattr(fn, "__name__", "?"))
         oids = [ObjectID.generate() for _ in range(num_returns)]
         for oid in oids:
             self.store.create_pending(oid)
@@ -1141,6 +1144,8 @@ class CoreContext:
                                max_task_retries: int = 0) -> List[ObjectRef]:
         """Thread-safe actor-call submission (see submit_task_sync)."""
         oids = [ObjectID.generate() for _ in range(num_returns)]
+        if oids:
+            tracing.record_submit(oids[0].hex(), "actor", method)
         for oid in oids:
             self.store.create_pending(oid)
         refs = [ObjectRef(oid, self.addr) for oid in oids]

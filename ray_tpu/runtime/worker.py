@@ -18,16 +18,19 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextvars
 import inspect
 import os
 import pickle
 import sys
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu.config import Config
 from ray_tpu.runtime.core import CoreContext, ObjectRef, TaskError
 from ray_tpu.runtime.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.runtime.serialization import dumps_oob, loads_oob, serialize
+from ray_tpu.util import tracing
 
 
 class _BatchError:
@@ -60,6 +63,7 @@ class WorkerExecutor:
         ctx.server.add_handler("actor_call", self.actor_call)
         ctx.server.add_handler("actor_call_batch", self.actor_call_batch)
         ctx.server.add_handler("cancel_task", self.cancel_task)
+        ctx.server.add_handler("get_events", self.get_events)
         ctx.server.add_handler("shutdown_worker", self.shutdown_worker)
 
     # --- common result packaging -----------------------------------------
@@ -110,8 +114,12 @@ class WorkerExecutor:
         if inspect.iscoroutinefunction(fn):
             return await fn(*args, **kwargs)
         loop = asyncio.get_running_loop()
+        # copy_context: the tracing current_span contextvar must follow
+        # user code into the executor thread so nested submissions from
+        # sync tasks record their parent edge (util/tracing.py)
+        ctx = contextvars.copy_context()
         return await loop.run_in_executor(
-            pool or self.task_pool, lambda: fn(*args, **kwargs))
+            pool or self.task_pool, lambda: ctx.run(fn, *args, **kwargs))
 
     # --- stateless tasks ----------------------------------------------------
 
@@ -123,12 +131,20 @@ class WorkerExecutor:
             return self._package_error(
                 TaskError("task cancelled"), return_oids)
         fn = self.ctx.fn_cache.resolve(fn_digest, fn_payload)
+        t0, err = time.time(), False
+        tok = tracing.current_span.set(task_id.hex())
         try:
             args, kwargs = await self._resolve_args(args_frame)
             value = await self._run_callable(fn, args, kwargs)
             return await self._package(value, return_oids)
         except BaseException as e:  # noqa: BLE001
+            err = True
             return self._package_error(e, return_oids)
+        finally:
+            tracing.current_span.reset(tok)
+            tracing.record_exec(task_id.hex(), "task",
+                                getattr(fn, "__name__", "?"),
+                                t0, time.time(), error=err)
 
     async def exec_task_batch(self, calls: list, owner_addr):
         """Coalesced stateless tasks (see core.py _task_pump). Sync
@@ -156,20 +172,30 @@ class WorkerExecutor:
                 out[i] = self._package_error(e, c["return_oids"])
                 continue
             if inspect.iscoroutinefunction(fn):
+                span = c["task_id"].hex()
+                t0, failed = time.time(), False
+                tok = tracing.current_span.set(span)
                 try:
                     value = await fn(*args, **kwargs)
                 except BaseException as e:  # noqa: BLE001
+                    failed = True
                     out[i] = self._package_error(e, c["return_oids"])
                 else:
                     out[i] = await self._package_slot(
                         value, c["return_oids"])
+                finally:
+                    tracing.current_span.reset(tok)
+                    tracing.record_exec(span, "task",
+                                        getattr(fn, "__name__", "?"),
+                                        t0, time.time(), error=failed)
             else:
-                sync_items.append((i, fn, args, kwargs))
+                sync_items.append((i, fn, args, kwargs,
+                                   c["task_id"].hex()))
         if sync_items:
             loop = asyncio.get_running_loop()
             vals = await loop.run_in_executor(
                 self.task_pool, self._run_task_batch_sync, sync_items)
-            for (i, _fn, _a, _k), v in zip(sync_items, vals):
+            for (i, _fn, _a, _k, _s), v in zip(sync_items, vals):
                 c = calls[i]
                 out[i] = await self._package_slot(v, c["return_oids"])
         return {"batch": out}
@@ -187,16 +213,33 @@ class WorkerExecutor:
     @staticmethod
     def _run_task_batch_sync(items):
         vals = []
-        for _i, fn, args, kwargs in items:
+        for _i, fn, args, kwargs, span in items:
+            tok = tracing.current_span.set(span)
+            t0, failed = time.time(), False
             try:
                 vals.append(fn(*args, **kwargs))
             except BaseException as e:  # noqa: BLE001 — per-task error
+                failed = True
                 vals.append(_BatchError(e))
+            finally:
+                tracing.current_span.reset(tok)
+                tracing.record_exec(span, "task",
+                                    getattr(fn, "__name__", "?"),
+                                    t0, time.time(), batch=len(items),
+                                    error=failed)
         return vals
 
     async def cancel_task(self, task_id: TaskID):
         self.cancelled.add(task_id)
         return {"ok": True}
+
+    async def get_events(self):
+        """This worker's event/span buffer, node-tagged (pulled by the
+        agent for the cluster timeline — the reference ships worker task
+        events to the GCS instead, task_event_buffer.h)."""
+        from ray_tpu.util import events
+        nid = self.ctx.node_id.hex()
+        return {"events": [{**e, "node": nid} for e in events.dump()]}
 
     # --- actors -------------------------------------------------------------
 
@@ -222,6 +265,9 @@ class WorkerExecutor:
         if hosted is None:
             return self._package_error(
                 TaskError(f"actor {actor_id} not hosted here"), return_oids)
+        span = return_oids[0].hex() if return_oids else ""
+        t0, err = time.time(), False
+        tok = tracing.current_span.set(span)
         try:
             args, kwargs = await self._resolve_args(args_frame)
             if method == "__dag_exec_loop__":
@@ -244,7 +290,12 @@ class WorkerExecutor:
                     fn, args, kwargs, hosted.executor)
             return await self._package(value, return_oids)
         except BaseException as e:  # noqa: BLE001
+            err = True
             return self._package_error(e, return_oids)
+        finally:
+            tracing.current_span.reset(tok)
+            tracing.record_exec(span, "actor", method, t0, time.time(),
+                                error=err)
 
     async def actor_call_batch(self, actor_id: ActorID, calls: list,
                                owner_addr):
@@ -270,10 +321,21 @@ class WorkerExecutor:
                         c["args_frame"]))
                 except BaseException as e:  # noqa: BLE001 — isolate call
                     resolved.append(_BatchError(e))
+            spans = [c["return_oids"][0].hex() if c["return_oids"] else ""
+                     for c in calls]
             async with hosted.lock:
                 loop = asyncio.get_running_loop()
+                t0 = time.time()
                 values = await loop.run_in_executor(
-                    hosted.executor, self._run_batch_sync, methods, resolved)
+                    hosted.executor, self._run_batch_sync, methods,
+                    resolved, spans)
+                t1 = time.time()
+            for s, c, r, v in zip(spans, calls, resolved, values):
+                if isinstance(r, _BatchError):
+                    continue  # never executed (arg resolution failed)
+                tracing.record_exec(s, "actor", c["method"], t0, t1,
+                                    batch=len(calls),
+                                    error=isinstance(v, _BatchError))
             out = []
             for v, c in zip(values, calls):
                 out.append(await self._package_slot(v, c["return_oids"]))
@@ -288,17 +350,21 @@ class WorkerExecutor:
         return {"batch": list(out)}
 
     @staticmethod
-    def _run_batch_sync(methods, resolved):
+    def _run_batch_sync(methods, resolved, spans=None):
         vals = []
-        for m, r in zip(methods, resolved):
+        for i, (m, r) in enumerate(zip(methods, resolved)):
             if isinstance(r, _BatchError):  # arg resolution failed
                 vals.append(r)
                 continue
             args, kwargs = r
+            tok = tracing.current_span.set(spans[i]) if spans else None
             try:
                 vals.append(m(*args, **kwargs))
             except BaseException as e:  # noqa: BLE001 — per-call error
                 vals.append(_BatchError(e))
+            finally:
+                if tok is not None:
+                    tracing.current_span.reset(tok)
         return vals
 
     async def shutdown_worker(self):
