@@ -49,12 +49,15 @@ def main():
         # pallas grid from 32k to 512 invocations (6.1 -> 14.6 TF/s on the
         # kernel); full per-layer remat beats saving attention residuals
         # (residual HBM traffic costs more than the recompute); batch 16 and
-        # 2048 blocks OOM. 28.9% -> 53.7% MFU overall.
+        # 2048 blocks OOM. Round-3 sweep: bf16 logits (+0.3pt) and
+        # batch 4 x seq 4096 (+1.2pt over 8x2048; b12, b8s4096 regress).
+        # 28.9% -> 53.7% -> ~54.8% MFU overall.
         cfg = llama.LlamaConfig(
             vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
-            n_kv_heads=16, ffn_dim=5504, max_seq_len=2048,
-            attn_impl="flash", attn_block_q=1024, attn_block_k=1024)
-        batch, seq, iters, warmup = 8, 2048, 10, 3
+            n_kv_heads=16, ffn_dim=5504, max_seq_len=4096,
+            attn_impl="flash", attn_block_q=1024, attn_block_k=1024,
+            logits_dtype="bfloat16")
+        batch, seq, iters, warmup = 4, 4096, 10, 3
     else:
         cfg = llama.tiny(attn_impl="reference")
         batch, seq, iters, warmup = 4, 256, 5, 1

@@ -294,8 +294,11 @@ class WorkerExecutor:
             return self._package_error(e, return_oids)
         finally:
             tracing.current_span.reset(tok)
-            tracing.record_exec(span, "actor", method, t0, time.time(),
-                                error=err)
+            if method != "__dag_exec_loop__":
+                # the pinned dag loop lives for the dag's whole lifetime —
+                # a span covering it would occlude every real slice
+                tracing.record_exec(span, "actor", method, t0, time.time(),
+                                    error=err)
 
     async def actor_call_batch(self, actor_id: ActorID, calls: list,
                                owner_addr):
@@ -323,19 +326,12 @@ class WorkerExecutor:
                     resolved.append(_BatchError(e))
             spans = [c["return_oids"][0].hex() if c["return_oids"] else ""
                      for c in calls]
+            names = [c["method"] for c in calls]
             async with hosted.lock:
                 loop = asyncio.get_running_loop()
-                t0 = time.time()
                 values = await loop.run_in_executor(
                     hosted.executor, self._run_batch_sync, methods,
-                    resolved, spans)
-                t1 = time.time()
-            for s, c, r, v in zip(spans, calls, resolved, values):
-                if isinstance(r, _BatchError):
-                    continue  # never executed (arg resolution failed)
-                tracing.record_exec(s, "actor", c["method"], t0, t1,
-                                    batch=len(calls),
-                                    error=isinstance(v, _BatchError))
+                    resolved, spans, names)
             out = []
             for v, c in zip(values, calls):
                 out.append(await self._package_slot(v, c["return_oids"]))
@@ -350,7 +346,7 @@ class WorkerExecutor:
         return {"batch": list(out)}
 
     @staticmethod
-    def _run_batch_sync(methods, resolved, spans=None):
+    def _run_batch_sync(methods, resolved, spans=None, names=None):
         vals = []
         for i, (m, r) in enumerate(zip(methods, resolved)):
             if isinstance(r, _BatchError):  # arg resolution failed
@@ -358,13 +354,19 @@ class WorkerExecutor:
                 continue
             args, kwargs = r
             tok = tracing.current_span.set(spans[i]) if spans else None
+            t0, failed = time.time(), False
             try:
                 vals.append(m(*args, **kwargs))
             except BaseException as e:  # noqa: BLE001 — per-call error
+                failed = True
                 vals.append(_BatchError(e))
             finally:
                 if tok is not None:
                     tracing.current_span.reset(tok)
+                    tracing.record_exec(
+                        spans[i], "actor",
+                        names[i] if names else getattr(m, "__name__", "?"),
+                        t0, time.time(), batch=len(methods), error=failed)
         return vals
 
     async def shutdown_worker(self):

@@ -25,7 +25,7 @@ def _trace(evs, name):
             and e.get("name") == name]
 
 
-def test_exec_spans_and_submit_edges(cluster, tmp_path_factory):
+def test_exec_spans_and_submit_edges(cluster):
     @ray_tpu.remote
     def leaf(x):
         return x + 1
