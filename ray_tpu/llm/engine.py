@@ -41,6 +41,9 @@ class _Request:
     stream: Optional[asyncio.Queue] = None
     submitted: float = field(default_factory=time.monotonic)
     first_token_at: Optional[float] = None
+    # KV computed by a remote prefill engine (disaggregated serving):
+    # {"k","v": (layers, bucket, kvh, hd) numpy, "logits": (vocab,)}
+    prefilled: Optional[dict] = None
 
 
 class LLMEngine:
@@ -80,8 +83,15 @@ class LLMEngine:
     async def generate(self, tokens: Sequence[int], *,
                        max_new_tokens: int = 64,
                        temperature: float = 0.0,
-                       eos_id: Optional[int] = None) -> dict:
-        r = self._submit(tokens, max_new_tokens, temperature, eos_id)
+                       eos_id: Optional[int] = None,
+                       prefilled: Optional[dict] = None) -> dict:
+        """``prefilled`` skips the in-engine prompt forward pass: it is
+        the KV payload a remote PrefillEngine computed for these tokens
+        (prefill/decode disaggregation, ray_tpu/llm/pd.py; reference:
+        llm/_internal/serve/serving_patterns/prefill_decode/, KV moved
+        via NIXL there, via the object plane here)."""
+        r = self._submit(tokens, max_new_tokens, temperature, eos_id,
+                         prefilled=prefilled)
         r.fut = asyncio.get_running_loop().create_future()
         await r.fut
         return self._result(r)
@@ -89,9 +99,11 @@ class LLMEngine:
     async def generate_stream(self, tokens: Sequence[int], *,
                               max_new_tokens: int = 64,
                               temperature: float = 0.0,
-                              eos_id: Optional[int] = None):
+                              eos_id: Optional[int] = None,
+                              prefilled: Optional[dict] = None):
         """Async generator of token ids as they are produced."""
-        r = self._submit(tokens, max_new_tokens, temperature, eos_id)
+        r = self._submit(tokens, max_new_tokens, temperature, eos_id,
+                         prefilled=prefilled)
         r.stream = asyncio.Queue()
         while True:
             t = await r.stream.get()
@@ -101,7 +113,15 @@ class LLMEngine:
                 raise t
             yield t
 
-    def _submit(self, tokens, max_new_tokens, temperature, eos_id):
+    async def generate_prefilled(self, tokens, prefilled: dict,
+                                 **kw) -> dict:
+        return await self.generate(tokens, prefilled=prefilled, **kw)
+
+    def generate_stream_prefilled(self, tokens, prefilled: dict, **kw):
+        return self.generate_stream(tokens, prefilled=prefilled, **kw)
+
+    def _submit(self, tokens, max_new_tokens, temperature, eos_id,
+                prefilled=None):
         if self._stopped:
             raise RuntimeError("engine is stopped")
         tokens = list(map(int, tokens))
@@ -117,7 +137,23 @@ class LLMEngine:
             raise ValueError(
                 f"prompt+generation ({len(tokens)}+{max_new_tokens}) "
                 f"exceeds max_len {self.max_len}")
-        r = _Request(tokens, max_new_tokens, temperature, eos_id)
+        if prefilled is not None:
+            # validate at submission: a malformed payload must fail THIS
+            # request, not blow up the shared scheduler loop mid-admit
+            for k in ("k", "v", "logits", "length"):
+                if k not in prefilled:
+                    raise ValueError(f"prefilled payload missing {k!r}")
+            if int(prefilled["length"]) != len(tokens):
+                raise ValueError(
+                    f"prefilled length {prefilled['length']} != prompt "
+                    f"length {len(tokens)}")
+            if prefilled["k"].shape[1] > self.max_len:
+                raise ValueError(
+                    f"prefilled KV spans {prefilled['k'].shape[1]} "
+                    f"positions > decode max_len {self.max_len} "
+                    "(prefill/decode bucket configs disagree)")
+        r = _Request(tokens, max_new_tokens, temperature, eos_id,
+                     prefilled=prefilled)
         self._waiting.put_nowait(r)
         self.stats["requests"] += 1
         self._ensure_loop()
@@ -219,9 +255,19 @@ class LLMEngine:
 
     def _admit_sync(self, slot: int, r: _Request) -> int:
         """Prefill (executor thread): pad to bucket, fill cache slot.
-        Returns the first sampled token."""
+        Returns the first sampled token. Remotely-prefilled requests
+        skip the forward pass: their shipped KV is written straight
+        into the slot."""
         import jax.numpy as jnp
         n = len(r.tokens)
+        if r.prefilled is not None:
+            p = r.prefilled
+            r.prefilled = None          # free the host copy after write
+            kv = {"k": jnp.asarray(p["k"]), "v": jnp.asarray(p["v"])}
+            self._cache = lm.write_prefill_to_cache(
+                self._cache, kv, slot, jnp.int32(n))
+            self._slots[slot] = r
+            return self._sample_one(np.asarray(p["logits"]), r)
         b = self._bucket_for(n)
         padded = np.zeros((b,), np.int32)
         padded[:n] = r.tokens

@@ -1,0 +1,77 @@
+"""Prefill/decode disaggregation: compute-bound prefill on one set of
+replicas, latency-bound decode on another.
+
+The TPU-native analog of the reference's prefill-decode serving pattern
+(reference: llm/_internal/serve/serving_patterns/prefill_decode/builder.py:184
++ engines/vllm/kv_transfer/nixl.py — there the KV cache moves GPU-to-GPU
+over NIXL; here it moves host-staged over the runtime's shared-memory
+object plane, sliced to the prompt's prefill bucket so the transfer is
+proportional to the prompt, not max_len).
+
+Why disaggregate on TPU: a prefill of a long prompt is one large
+MXU-bound matmul burst that stalls every decode slot sharing the chip;
+separate prefill replicas keep decode steps (latency-bound, small
+batches) off the critical path. Decode admits shipped KV with one
+dynamic_update_slice — no forward pass.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.llm import model as lm
+from ray_tpu.models.llama import LlamaConfig
+
+
+class PrefillEngine:
+    """Stateless prompt prefill: tokens -> {kv, logits, length}.
+
+    Shape-bucketed like LLMEngine's in-engine prefill (one compile per
+    bucket); the returned KV is bucket-sized, and
+    LLMEngine.generate_prefilled() writes it into a decode slot.
+    """
+
+    def __init__(self, cfg: LlamaConfig, params, *,
+                 prefill_buckets: Sequence[int] = (64, 128, 256, 512),
+                 max_len: int = 1024,
+                 cache_dtype: str = "bfloat16"):
+        self.cfg = cfg
+        self.params = params
+        self.max_len = max_len
+        self.buckets = tuple(sorted(b for b in prefill_buckets
+                                    if b <= max_len)) or (max_len,)
+        self.cache_dtype = cache_dtype
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def prefill(self, tokens: Sequence[int]) -> dict:
+        """Runs the prompt forward pass; returns host numpy
+        {"k","v": (layers, bucket, kvh, hd), "logits": (vocab,),
+        "length": n} ready to ship to a decode engine."""
+        import jax.numpy as jnp
+        tokens = list(map(int, tokens))
+        n = len(tokens)
+        if n == 0:
+            raise ValueError("empty prompt")
+        if n > self.buckets[-1]:
+            raise ValueError(
+                f"prompt of {n} tokens exceeds the largest prefill "
+                f"bucket {self.buckets[-1]}")
+        b = self._bucket_for(n)
+        padded = np.zeros((b,), np.int32)
+        padded[:n] = tokens
+        # pad KV only to the bucket (not max_len): the shipped payload
+        # scales with the prompt
+        logits, kv = lm.prefill(self.params, jnp.asarray(padded),
+                                jnp.int32(n), self.cfg, b)
+        dt = jnp.dtype(self.cache_dtype)
+        return {"k": np.asarray(kv["k"].astype(dt)),
+                "v": np.asarray(kv["v"].astype(dt)),
+                "logits": np.asarray(logits),
+                "length": n}

@@ -41,24 +41,32 @@ class LLMConfig:
     max_ongoing_requests: int = 64
 
 
+def _load_model(cfg: LLMConfig):
+    """Resolve (model_cfg, params) from an LLMConfig — shared by the
+    decode, prefill, and unified servers so every replica holds
+    identical weights."""
+    import jax
+
+    from ray_tpu.models import llama
+    model_cfg = cfg.model
+    if isinstance(model_cfg, str):
+        model_cfg = getattr(llama, model_cfg)(**cfg.model_overrides)
+    if cfg.checkpoint:
+        import orbax.checkpoint as ocp
+        params = ocp.StandardCheckpointer().restore(cfg.checkpoint)
+    else:
+        params = llama.init_params(
+            jax.random.PRNGKey(cfg.seed), model_cfg)
+    return model_cfg, params
+
+
 class _LLMServer:
     """One engine per replica; requests ride serve's router + the
     engine's own continuous batching."""
 
     def __init__(self, cfg: LLMConfig):
-        import jax
-
         from ray_tpu.llm.engine import LLMEngine
-        from ray_tpu.models import llama
-        model_cfg = cfg.model
-        if isinstance(model_cfg, str):
-            model_cfg = getattr(llama, model_cfg)(**cfg.model_overrides)
-        if cfg.checkpoint:
-            import orbax.checkpoint as ocp
-            params = ocp.StandardCheckpointer().restore(cfg.checkpoint)
-        else:
-            params = llama.init_params(
-                jax.random.PRNGKey(cfg.seed), model_cfg)
+        model_cfg, params = _load_model(cfg)
         self.engine = LLMEngine(
             model_cfg, params, max_slots=cfg.max_slots,
             max_len=cfg.max_len, prefill_buckets=cfg.prefill_buckets,
@@ -187,3 +195,103 @@ def build_llm_deployment(cfg: LLMConfig,
         max_ongoing_requests=cfg.max_ongoing_requests,
         route_prefix=f"/{name}")
     return dep.bind(cfg)
+
+
+# --- prefill/decode disaggregation ------------------------------------
+# Reference pattern: llm/_internal/serve/serving_patterns/prefill_decode/
+# builder.py:184 (separate prefill + decode deployments, KV handed off
+# between them). The KV rides the object plane here (ray_tpu/llm/pd.py).
+
+class _PrefillServer:
+    """Stateless prompt prefill replicas (compute-bound tier)."""
+
+    def __init__(self, cfg: LLMConfig):
+        from ray_tpu.llm.pd import PrefillEngine
+        model_cfg, params = _load_model(cfg)
+        self.engine = PrefillEngine(
+            model_cfg, params, prefill_buckets=cfg.prefill_buckets,
+            max_len=cfg.max_len, cache_dtype=cfg.cache_dtype)
+
+    async def prefill(self, tokens) -> dict:
+        import asyncio
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, self.engine.prefill, tokens)
+
+
+class _DecodeServer(_LLMServer):
+    """Decode tier: same engine, plus KV-handoff admission."""
+
+    async def generate_prefilled(self, tokens, prefilled,
+                                 max_new_tokens: int = 64,
+                                 temperature: float = 0.0,
+                                 eos_id: Optional[int] = None) -> dict:
+        import ray_tpu
+        from ray_tpu.runtime.core import ObjectRef
+        if isinstance(prefilled, ObjectRef):
+            # the ingress forwards the prefill result by REFERENCE: the
+            # KV bytes move prefill-node -> decode-node over the object
+            # plane exactly once, never through the ingress
+            prefilled = await ray_tpu.get_async(prefilled)
+        return await self.engine.generate_prefilled(
+            tokens, prefilled, max_new_tokens=max_new_tokens,
+            temperature=temperature, eos_id=eos_id)
+
+
+class _PDIngress:
+    """Routes each request through the two tiers: prefill replicas
+    compute the prompt KV, decode replicas stream tokens from it."""
+
+    def __init__(self, cfg: LLMConfig, prefill_handle, decode_handle):
+        self.cfg = cfg
+        self.prefill = prefill_handle
+        self.decode = decode_handle
+
+    # sync methods: they run on the replica's executor thread, where the
+    # blocking handle-routing path is allowed (the actor event loop must
+    # stay free for concurrent requests)
+    def generate(self, tokens, max_new_tokens: int = 64,
+                 temperature: float = 0.0,
+                 eos_id: Optional[int] = None) -> dict:
+        import ray_tpu
+        # forward the prefill ObjectRef, not its value: the KV payload
+        # flows prefill-replica -> decode-replica directly; the ingress
+        # never holds it
+        pre_ref = self.prefill.prefill.remote(tokens)
+        return ray_tpu.get(
+            self.decode.generate_prefilled.remote(
+                tokens, pre_ref, max_new_tokens=max_new_tokens,
+                temperature=temperature, eos_id=eos_id), timeout=300)
+
+    def __call__(self, request: dict) -> dict:
+        return self.generate(
+            request["tokens"],
+            max_new_tokens=int(request.get("max_new_tokens", 64)),
+            temperature=float(request.get("temperature", 0.0)),
+            eos_id=request.get("eos_id"))
+
+
+def build_pd_llm_deployment(cfg: LLMConfig,
+                            num_prefill_replicas: int = 1,
+                            num_decode_replicas: int = 1,
+                            name: str = "LLM") -> Application:
+    """Disaggregated app: ingress -> prefill tier -> decode tier.
+
+        app = build_pd_llm_deployment(LLMConfig(model="tiny"), 2, 1)
+        h = serve.run(app, name="pd")
+        out = h.generate.remote([1, 2, 3], max_new_tokens=16).result()
+    """
+    prefill = deployment(
+        _PrefillServer, name=f"{name}Prefill",
+        num_replicas=num_prefill_replicas,
+        max_ongoing_requests=cfg.max_ongoing_requests).bind(cfg)
+    decode = deployment(
+        _DecodeServer, name=f"{name}Decode",
+        num_replicas=num_decode_replicas,
+        max_ongoing_requests=cfg.max_ongoing_requests).bind(cfg)
+    ingress = deployment(
+        _PDIngress, name=f"{name}Ingress",
+        num_replicas=1,
+        max_ongoing_requests=cfg.max_ongoing_requests,
+        route_prefix=f"/{name}")
+    return ingress.bind(cfg, prefill, decode)

@@ -3,16 +3,17 @@
 Reference surface: python/ray/tune (tuner.py:43, tune_config.py,
 schedulers/async_hyperband.py, search/sample.py)."""
 
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     PopulationBasedTraining)
 from ray_tpu.tune.search import (Categorical, Domain, Float, Integer,
                                  choice, grid_search, loguniform, randint,
                                  uniform)
 from ray_tpu.tune.tuner import (Result, ResultGrid, TrialStopped,
-                                TuneConfig, Tuner, report)
+                                TuneConfig, Tuner, get_checkpoint, report)
 
 __all__ = [
-    "ASHAScheduler", "FIFOScheduler", "Categorical", "Domain", "Float",
-    "Integer", "choice", "grid_search", "loguniform", "randint",
-    "uniform", "Result", "ResultGrid", "TrialStopped", "TuneConfig",
-    "Tuner", "report",
+    "ASHAScheduler", "FIFOScheduler", "PopulationBasedTraining",
+    "Categorical", "Domain", "Float", "Integer", "choice", "grid_search",
+    "loguniform", "randint", "uniform", "Result", "ResultGrid",
+    "TrialStopped", "TuneConfig", "Tuner", "get_checkpoint", "report",
 ]

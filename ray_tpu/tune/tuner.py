@@ -15,7 +15,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from ray_tpu.tune.schedulers import STOP, FIFOScheduler
+from ray_tpu.tune.schedulers import STOP, Exploit, FIFOScheduler
 from ray_tpu.tune.search import generate_variants
 
 
@@ -46,6 +46,18 @@ def report(metrics: Optional[dict] = None, *, checkpoint: Optional[Any] = None,
         raise TrialStopped()
 
 
+def get_checkpoint() -> Any:
+    """The checkpoint to resume from, inside a trainable: the trial's own
+    last reported checkpoint, or — after a PBT exploit — the donor
+    trial's checkpoint (reference: tune.get_checkpoint /
+    train.get_checkpoint)."""
+    st = getattr(_trial_local, "state", None)
+    if st is None:
+        return None
+    with st.lock:
+        return st.checkpoint
+
+
 class _TrialState:
     def __init__(self):
         self.lock = threading.Lock()
@@ -66,9 +78,18 @@ class _TrialActor:
     def __init__(self):
         self.state = _TrialState()
 
-    def run(self, fn: Callable[[dict], Any], config: dict):
-        _trial_local.state = self.state
+    def run(self, fn: Callable[[dict], Any], config: dict,
+            checkpoint: Any = None):
         st = self.state
+        with st.lock:
+            # restarts (PBT exploit) reuse the actor: clear the stop
+            # latch, keep the report log (cursor continuity), and seed
+            # the donor checkpoint for get_checkpoint()
+            st.stop = False
+            st.status = "RUNNING"
+            if checkpoint is not None:
+                st.checkpoint = checkpoint
+        _trial_local.state = st
         try:
             out = fn(config)
             with st.lock:
@@ -183,6 +204,7 @@ class _Trial:
     cursor: int = 0
     reports: List[dict] = field(default_factory=list)
     stop_requested: bool = False
+    exploit: Any = None       # pending PBT Exploit decision
 
 
 def _trainer_trainable(trainer) -> Callable[[dict], Any]:
@@ -340,10 +362,24 @@ class Tuner:
             except Exception:
                 pass
 
+        def donor_checkpoint(donor_id: str):
+            d = running.get(donor_id)
+            if d is not None:
+                try:
+                    fin = ray_tpu.get(d.actor.get_final.remote(),
+                                      timeout=30)
+                    return fin["checkpoint"]
+                except Exception:
+                    return None
+            r = results.get(donor_id)
+            return r.checkpoint if r is not None else None
+
         while pending or running:
             while pending and len(running) < limit:
                 t = pending.pop(0)
                 t.actor = actor_cls.remote()
+                if hasattr(scheduler, "on_trial_start"):
+                    scheduler.on_trial_start(t.trial_id, t.config)
                 t.run_ref = t.actor.run.remote(self._fn, t.config)
                 running[t.trial_id] = t
             for t in list(running.values()):
@@ -357,12 +393,30 @@ class Tuner:
                 t.cursor = r["cursor"]
                 t.reports.extend(r["reports"])
                 for m in r["reports"]:
-                    if (not t.stop_requested
-                            and scheduler.on_result(
-                                t.trial_id, m) == STOP):
+                    if t.stop_requested:
+                        continue
+                    d = scheduler.on_result(t.trial_id, m)
+                    if d == STOP:
                         t.stop_requested = True
                         t.actor.request_stop.remote()
+                    elif isinstance(d, Exploit):
+                        t.stop_requested = True
+                        t.exploit = d
+                        t.actor.request_stop.remote()
                 if r["status"] != "RUNNING":
+                    if t.exploit is not None and r["status"] == "STOPPED":
+                        # PBT: clone the donor's checkpoint, continue on
+                        # the same actor with the mutated config
+                        ck = donor_checkpoint(t.exploit.donor_id)
+                        t.config = dict(t.exploit.config)
+                        t.exploit = None
+                        t.stop_requested = False
+                        if hasattr(scheduler, "on_exploit_applied"):
+                            scheduler.on_exploit_applied(
+                                t.trial_id, t.config)
+                        t.run_ref = t.actor.run.remote(
+                            self._fn, t.config, ck)
+                        continue
                     status = ("TERMINATED" if r["status"] == "TERMINATED"
                               else r["status"])
                     finalize(t, status, r["error"])

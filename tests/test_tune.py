@@ -180,3 +180,64 @@ def test_asha_interrupts_trainer_trials_live(runtime):
     loser = by_lr[50.0]
     assert loser.status == "STOPPED", (loser.status, loser.error)
     assert len(loser.all_reports) < 20, len(loser.all_reports)
+
+
+def test_pbt_scheduler_unit():
+    from ray_tpu.tune.schedulers import CONTINUE, Exploit
+    pbt = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=2,
+        hyperparam_mutations={"lr": [0.1, 1.0]},
+        quantile_fraction=0.5, seed=0)
+    for tid, cfg in (("a", {"lr": 1.0}), ("b", {"lr": 0.1})):
+        pbt.on_trial_start(tid, cfg)
+    # before the interval: no decision
+    assert pbt.on_result("a", {"training_iteration": 1,
+                               "score": 10}) == CONTINUE
+    assert pbt.on_result("b", {"training_iteration": 1,
+                               "score": 1}) == CONTINUE
+    # at the interval, the top trial continues...
+    assert pbt.on_result("a", {"training_iteration": 2,
+                               "score": 20}) == CONTINUE
+    # ...and the bottom trial exploits it
+    d = pbt.on_result("b", {"training_iteration": 2, "score": 2})
+    assert isinstance(d, Exploit) and d.donor_id == "a"
+    assert "lr" in d.config and d.config["lr"] in (0.1, 1.0)
+    assert pbt.num_exploits == 0   # counted only when actually applied
+    pbt.on_exploit_applied("b", d.config)
+    assert pbt.num_exploits == 1
+
+
+def test_pbt_exploit_migrates_trials(runtime):
+    """Bad-lr trials must clone the good trial's state mid-run and end
+    near the best trajectory (reference behavior:
+    tune/tests/test_trial_scheduler_pbt.py)."""
+    # horizon long enough (~4s/trial) that the controller's poll loop
+    # decides + stops mid-run even on a slow contended box; exploits
+    # that lose the race to a finished trial are dropped by design
+    def trainable(config):
+        x = tune.get_checkpoint() or 0.0
+        lr = config["lr"]
+        for _ in range(25):
+            x += lr
+            tune.report({"score": x}, checkpoint=x)
+            import time as _t
+            _t.sleep(0.15)
+        return {"score": x}
+
+    pbt = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": [1.0]},
+        quantile_fraction=0.34, resample_probability=1.0, seed=3)
+    grid = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([1.0, 0.01, 0.01])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    max_concurrent_trials=3,
+                                    scheduler=pbt),
+    ).fit()
+    assert pbt.num_exploits >= 1, "no exploit ever happened"
+    best = grid.get_best_result().metrics["score"]
+    assert best >= 24.9
+    # a migrated trial must beat what lr=0.01 alone could reach (0.25)
+    others = sorted(r.metrics.get("score", 0.0) for r in grid)
+    assert others[-2] > 2.0, others
