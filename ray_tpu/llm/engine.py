@@ -183,10 +183,7 @@ class LLMEngine:
             self._loop_task = asyncio.ensure_future(self._run())
 
     def _bucket_for(self, n: int) -> int:
-        for b in self.buckets:
-            if n <= b:
-                return b
-        return self.buckets[-1]
+        return lm.bucket_for(self.buckets, n)
 
     async def _run(self):
         loop = asyncio.get_running_loop()
@@ -269,8 +266,7 @@ class LLMEngine:
             self._slots[slot] = r
             return self._sample_one(np.asarray(p["logits"]), r)
         b = self._bucket_for(n)
-        padded = np.zeros((b,), np.int32)
-        padded[:n] = r.tokens
+        padded = lm.pad_prompt(r.tokens, b)
         logits, kv = lm.prefill(self.params, jnp.asarray(padded),
                                 jnp.int32(n), self.cfg, self.max_len)
         self._cache = lm.write_prefill_to_cache(

@@ -27,6 +27,24 @@ from ray_tpu.models.llama import (LlamaConfig, _rmsnorm, _rope,
                                   _rope_tables)
 
 
+def bucket_for(buckets, n: int) -> int:
+    """Smallest prefill shape bucket holding an n-token prompt (shared
+    by the unified and disaggregated engines so the policy can't
+    drift)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def pad_prompt(tokens, bucket: int):
+    """Zero-pad a prompt to its bucket (numpy, int32)."""
+    import numpy as np
+    out = np.zeros((bucket,), np.int32)
+    out[:len(tokens)] = tokens
+    return out
+
+
 def init_cache(cfg: LlamaConfig, slots: int, max_len: int,
                dtype=jnp.bfloat16) -> dict:
     shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)

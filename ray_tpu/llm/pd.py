@@ -44,12 +44,6 @@ class PrefillEngine:
                                     if b <= max_len)) or (max_len,)
         self.cache_dtype = cache_dtype
 
-    def _bucket_for(self, n: int) -> int:
-        for b in self.buckets:
-            if n <= b:
-                return b
-        return self.buckets[-1]
-
     def prefill(self, tokens: Sequence[int]) -> dict:
         """Runs the prompt forward pass; returns host numpy
         {"k","v": (layers, bucket, kvh, hd), "logits": (vocab,),
@@ -63,9 +57,8 @@ class PrefillEngine:
             raise ValueError(
                 f"prompt of {n} tokens exceeds the largest prefill "
                 f"bucket {self.buckets[-1]}")
-        b = self._bucket_for(n)
-        padded = np.zeros((b,), np.int32)
-        padded[:n] = tokens
+        b = lm.bucket_for(self.buckets, n)
+        padded = lm.pad_prompt(tokens, b)
         # pad KV only to the bucket (not max_len): the shipped payload
         # scales with the prompt
         logits, kv = lm.prefill(self.params, jnp.asarray(padded),

@@ -368,5 +368,22 @@ class EventLoopThread:
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
 
     def stop(self):
+        # Cancel-and-await everything still parked on the loop (server
+        # conn handlers, client recv loops, long polls) BEFORE stopping
+        # it: stopping with pending tasks leaves them to be GC'd at
+        # interpreter exit with "Task was destroyed" / "coroutine
+        # ignored GeneratorExit" noise.
+        async def _drain():
+            me = asyncio.current_task()
+            tasks = [t for t in asyncio.all_tasks() if t is not me]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                _drain(), self.loop).result(timeout=3)
+        except Exception:
+            pass
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._thread.join(timeout=5)

@@ -247,24 +247,28 @@ class _PDIngress:
         self.prefill = prefill_handle
         self.decode = decode_handle
 
-    # sync methods: they run on the replica's executor thread, where the
-    # blocking handle-routing path is allowed (the actor event loop must
-    # stay free for concurrent requests)
-    def generate(self, tokens, max_new_tokens: int = 64,
-                 temperature: float = 0.0,
-                 eos_id: Optional[int] = None) -> dict:
-        import ray_tpu
-        # forward the prefill ObjectRef, not its value: the KV payload
-        # flows prefill-replica -> decode-replica directly; the ingress
-        # never holds it
-        pre_ref = self.prefill.prefill.remote(tokens)
-        return ray_tpu.get(
-            self.decode.generate_prefilled.remote(
-                tokens, pre_ref, max_new_tokens=max_new_tokens,
-                temperature=temperature, eos_id=eos_id), timeout=300)
+    async def generate(self, tokens, max_new_tokens: int = 64,
+                       temperature: float = 0.0,
+                       eos_id: Optional[int] = None) -> dict:
+        import asyncio
 
-    def __call__(self, request: dict) -> dict:
-        return self.generate(
+        import ray_tpu
+        # Handle SUBMISSION (blocking routing-table work) hops to the
+        # executor for milliseconds; the generation itself is awaited on
+        # the loop so one thread is never held for a whole request.
+        # The prefill ObjectRef is forwarded, not its value: the KV
+        # payload flows prefill-replica -> decode-replica directly.
+        loop = asyncio.get_running_loop()
+        pre_ref = await loop.run_in_executor(
+            None, lambda: self.prefill.prefill.remote(tokens))
+        ref = await loop.run_in_executor(
+            None, lambda: self.decode.generate_prefilled.remote(
+                tokens, pre_ref, max_new_tokens=max_new_tokens,
+                temperature=temperature, eos_id=eos_id))
+        return await ray_tpu.get_async(ref, timeout=300)
+
+    async def __call__(self, request: dict) -> dict:
+        return await self.generate(
             request["tokens"],
             max_new_tokens=int(request.get("max_new_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),

@@ -73,8 +73,10 @@ class HTTPProxy:
                 if req is None:
                     return
                 method, path, headers, body = req
-                await self._dispatch(writer, method, path, headers, body)
-                if headers.get("connection", "").lower() == "close":
+                r = await self._dispatch(writer, method, path, headers,
+                                         body)
+                if r == "close" or \
+                        headers.get("connection", "").lower() == "close":
                     return
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 BrokenPipeError):
@@ -129,6 +131,11 @@ class HTTPProxy:
             arg = body
         else:
             arg = None
+        if "text/event-stream" in headers.get("accept", ""):
+            # SSE token streaming (reference: serve streams LLM responses
+            # over HTTP; here the proxy drives the replica's cursor-poll
+            # protocol and emits one `data:` event per token)
+            return await self._dispatch_stream(writer, dep, arg)
         loop = asyncio.get_running_loop()
         try:
             # Handle routing + submission is the sync caller API — run it on
@@ -144,6 +151,79 @@ class HTTPProxy:
             return self._respond(writer, 500,
                                  {"error": f"{type(e).__name__}: {e}"})
         self._respond(writer, 200, result)
+
+    async def _dispatch_stream(self, writer, dep: str, arg) -> str:
+        """Server-sent events: requires a deployment exposing the
+        stream_start/stream_poll protocol (serve/llm.py _LLMServer).
+        Returns "close" — an SSE response ends with the connection."""
+        from ray_tpu.serve.handle import DeploymentHandle
+        loop = asyncio.get_running_loop()
+        if arg is not None and not isinstance(arg, dict):
+            self._errors += 1
+            self._respond(writer, 500,
+                          {"error": "stream requests take a JSON object "
+                                    "body with a 'tokens' field"})
+            return "close"
+        kw = dict(arg or {})
+        tokens = kw.pop("tokens", None)
+        if tokens is None:
+            self._errors += 1
+            self._respond(writer, 500,
+                          {"error": "stream request needs 'tokens'"})
+            return "close"
+        try:
+            h = DeploymentHandle(dep)
+            ph = await loop.run_in_executor(None, h.pinned)
+            ref = await loop.run_in_executor(
+                None, lambda: ph.stream_start.remote(tokens, **kw))
+            sid = await api.get_async(ref, timeout=120.0)
+        except BaseException as e:  # noqa: BLE001
+            self._errors += 1
+            self._respond(writer, 500,
+                          {"error": f"{type(e).__name__}: {e}"})
+            return "close"
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: text/event-stream\r\n"
+                     b"Cache-Control: no-cache\r\n"
+                     b"Connection: close\r\n\r\n")
+        cursor = 0
+        try:
+            while True:
+                ref = await loop.run_in_executor(
+                    None, lambda: ph.stream_poll.remote(sid, cursor))
+                r = await api.get_async(ref, timeout=120.0)
+                for t in r["tokens"]:
+                    writer.write(
+                        f"data: {json.dumps({'token': t})}\n\n".encode())
+                cursor += len(r["tokens"])
+                await writer.drain()
+                if r["error"]:
+                    self._errors += 1
+                    writer.write(
+                        b"event: error\ndata: "
+                        + json.dumps({"error": r["error"]}).encode()
+                        + b"\n\n")
+                    break
+                if r["done"]:
+                    writer.write(b"event: done\ndata: {}\n\n")
+                    break
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass  # client went away; the replica GC reclaims the stream
+        except BaseException as e:  # noqa: BLE001 — replica died mid-stream
+            # surface the failure as the protocol's error frame instead of
+            # killing the connection handler with an unhandled exception
+            self._errors += 1
+            try:
+                writer.write(
+                    b"event: error\ndata: "
+                    + json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                    + b"\n\n")
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+        return "close"
 
     def _respond(self, writer, code: int, payload):
         reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}

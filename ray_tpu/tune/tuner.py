@@ -78,8 +78,7 @@ class _TrialActor:
     def __init__(self):
         self.state = _TrialState()
 
-    def run(self, fn: Callable[[dict], Any], config: dict,
-            checkpoint: Any = None):
+    def _reset_for_run(self, checkpoint: Any = None):
         st = self.state
         with st.lock:
             # restarts (PBT exploit) reuse the actor: clear the stop
@@ -89,6 +88,9 @@ class _TrialActor:
             st.status = "RUNNING"
             if checkpoint is not None:
                 st.checkpoint = checkpoint
+
+    def _body(self, fn: Callable[[dict], Any], config: dict):
+        st = self.state
         _trial_local.state = st
         try:
             out = fn(config)
@@ -104,6 +106,23 @@ class _TrialActor:
                 st.status = "ERROR"
         finally:
             _trial_local.state = None
+        return True
+
+    def run(self, fn: Callable[[dict], Any], config: dict,
+            checkpoint: Any = None):
+        self._reset_for_run(checkpoint)
+        return self._body(fn, config)
+
+    async def restart(self, fn: Callable[[dict], Any], config: dict,
+                      checkpoint: Any = None) -> bool:
+        """Exploit restart. Async so the status flips to RUNNING *on the
+        actor loop, in call order* — a poll() sent after this call can
+        never observe the previous run's terminal status — while the
+        trainable body runs on the executor in the background."""
+        import asyncio
+        self._reset_for_run(checkpoint)
+        loop = asyncio.get_running_loop()
+        loop.run_in_executor(None, self._body, fn, config)
         return True
 
     async def poll(self, cursor: int) -> dict:
@@ -414,7 +433,7 @@ class Tuner:
                         if hasattr(scheduler, "on_exploit_applied"):
                             scheduler.on_exploit_applied(
                                 t.trial_id, t.config)
-                        t.run_ref = t.actor.run.remote(
+                        t.run_ref = t.actor.restart.remote(
                             self._fn, t.config, ck)
                         continue
                     status = ("TERMINATED" if r["status"] == "TERMINATED"

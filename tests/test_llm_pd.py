@@ -114,3 +114,52 @@ def test_pd_serve_app():
             serve.shutdown()
         finally:
             ray_tpu.shutdown()
+
+
+def test_sse_streaming_over_http_proxy():
+    """The proxy streams tokens as server-sent events (one `data:` per
+    token, terminated by `event: done`) when the client asks for
+    text/event-stream — the HTTP analog of stream_generate."""
+    import http.client
+    import json as _json
+
+    from ray_tpu.serve.llm import LLMConfig, build_llm_deployment
+    ray_tpu.init(num_cpus=8)
+    try:
+        cfg = LLMConfig(model="tiny",
+                        model_overrides=dict(
+                            vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                            n_kv_heads=2, ffn_dim=128, dtype="float32",
+                            logits_dtype="float32",
+                            attn_impl="reference"),
+                        max_slots=2, max_len=128, prefill_buckets=(16,),
+                        cache_dtype="float32")
+        h = serve.run(build_llm_deployment(cfg, name="sse"),
+                      name="sse_app", route_prefix="/sse")
+        want = ray_tpu.get(
+            h.generate.remote([3, 7, 11], max_new_tokens=8),
+            timeout=120)["tokens"]
+
+        addr = serve.proxy_address()
+        conn = http.client.HTTPConnection(addr["host"], addr["port"],
+                                          timeout=120)
+        conn.request("POST", "/sse",
+                     body=_json.dumps({"tokens": [3, 7, 11],
+                                       "max_new_tokens": 8}),
+                     headers={"Content-Type": "application/json",
+                              "Accept": "text/event-stream"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        raw = resp.read().decode()   # connection closes at stream end
+        conn.close()
+        toks = [_json.loads(line[len("data: "):])["token"]
+                for line in raw.splitlines()
+                if line.startswith("data: ") and "token" in line]
+        assert toks == want, (toks, want)
+        assert "event: done" in raw
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
