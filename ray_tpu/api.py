@@ -312,7 +312,13 @@ def get(refs, timeout: Optional[float] = None):
     else:
         return values[0] if single else values
     wait_budget = None if timeout is None else timeout + 10
-    return _run(ctx.get(refs if single else ref_list, timeout),
+    # Capture task-context HERE: run_coroutine_threadsafe runs the
+    # coroutine in a fresh context on the loop, so the executing-task
+    # contextvar (tracing.current_span) is only visible on this thread.
+    from ray_tpu.util import tracing
+    in_task = not ctx.is_driver and bool(tracing.current_span.get())
+    return _run(ctx.get(refs if single else ref_list, timeout,
+                        in_task=in_task),
                 timeout=wait_budget)
 
 
@@ -325,7 +331,9 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
     ctx = _require_init()
     if num_returns > len(refs):
         raise ValueError("num_returns > len(refs)")
-    return _run(ctx.wait(refs, num_returns, timeout))
+    from ray_tpu.util import tracing
+    in_task = not ctx.is_driver and bool(tracing.current_span.get())
+    return _run(ctx.wait(refs, num_returns, timeout, in_task=in_task))
 
 
 def free(refs: Sequence[ObjectRef]) -> None:

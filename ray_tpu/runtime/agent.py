@@ -53,6 +53,10 @@ class _Lease:
     bundle_index: Optional[int] = None
     acked: bool = False                      # client confirmed receipt
     granted_at: float = field(default_factory=time.monotonic)
+    # COUNT of tasks on this lease parked in get()/wait(): pipelined
+    # tasks share one lease, so two may block concurrently; resources
+    # release on 0->1 and re-acquire on 1->0
+    blocked: int = 0
 
 
 class NodeAgent:
@@ -81,6 +85,10 @@ class NodeAgent:
         self._lease_seq = 0
         self._worker_claims: Dict[str, int] = {}  # env_hash -> claims
         self._wait_queue: List[Tuple[dict, asyncio.Future]] = []
+        from collections import deque
+        # spans pushed by this node's workers (report_events)
+        self._worker_events: "deque" = deque(
+            maxlen=self.config.event_buffer_size)
         self.cluster_view: Dict[NodeID, dict] = {}
         self._view_version = 0
         self._pulls: Dict[ObjectID, asyncio.Future] = {}
@@ -102,6 +110,8 @@ class NodeAgent:
             "request_lease": self.request_lease,
             "ack_lease": self.ack_lease,
             "release_lease": self.release_lease,
+            "worker_blocked": self.worker_blocked,
+            "worker_unblocked": self.worker_unblocked,
             "start_actor": self.start_actor,
             "kill_actor_worker": self.kill_actor_worker,
             "prepare_bundle": self.prepare_bundle,
@@ -116,6 +126,7 @@ class NodeAgent:
             "free_objects": self.free_objects,
             "node_stats": self.node_stats,
             "node_timeline": self.node_timeline,
+            "report_events": self.report_events,
             "ping": self.ping,
         }
 
@@ -147,6 +158,19 @@ class NodeAgent:
 
     async def stop(self):
         self._stopping = True
+        try:
+            # archive this node's spans at the head so the cluster
+            # timeline survives the node (e.g. a driver session ending);
+            # node_timeline turns empty afterwards so a concurrent
+            # collect_timeline can't double-count this node
+            tl = await self.node_timeline()
+            self._events_archived = True
+            if tl["events"]:
+                await self.pool.call(
+                    self.head_addr, "report_node_events",
+                    events=tl["events"], timeout=5.0)
+        except Exception:
+            pass
         if self._hb_task:
             self._hb_task.cancel()
         if getattr(self, "_mem_task", None):
@@ -303,26 +327,23 @@ class NodeAgent:
                                 if w.state != DEAD]),
                 "store": self.store.stats()}
 
+    async def report_events(self, events: list) -> dict:
+        """Workers push their span buffers here every second and at
+        shutdown (worker.py flush_events), so spans survive worker exit
+        — the reference's TaskEventBuffer -> GCS push, node-local."""
+        self._worker_events.extend(events)
+        return {"ok": True, "count": len(events)}
+
     async def node_timeline(self):
-        """This node's merged event/span buffers: the agent's own plus
-        every live worker's (util/tracing.py; the control service fans
-        out to all agents for the cluster view)."""
+        """This node's event/span buffers: the agent's own plus
+        everything its workers pushed (util/tracing.py; the control
+        service fans out to all agents for the cluster view)."""
+        if getattr(self, "_events_archived", False):
+            return {"events": []}  # already handed to the head (stop())
         from ray_tpu.util import events
         nid = self.node_id.hex()
         out = [{**e, "node": nid} for e in events.dump()]
-
-        async def pull(addr):
-            try:
-                r = await self.pool.call(addr, "get_events", timeout=5.0)
-                return r.get("events", [])
-            except Exception:
-                return []
-
-        results = await asyncio.gather(*[
-            pull(w.addr) for w in list(self.workers.values())
-            if w.state != DEAD and w.addr is not None])
-        for evs in results:
-            out.extend(evs)
+        out.extend(self._worker_events)
         return {"events": out}
 
     # --- heartbeats / cluster view ------------------------------------------
@@ -736,13 +757,48 @@ class NodeAgent:
         lease = self.leases.pop(lease_id, None)
         if lease is None:
             return {"ok": False}
-        self._release_res(lease.resources, lease.pg_id, lease.bundle_index)
+        if lease.blocked == 0:  # blocked leases already gave back resources
+            self._release_res(lease.resources, lease.pg_id,
+                              lease.bundle_index)
         w = lease.worker
         if not worker_died and w.state == LEASED:
             w.state = IDLE
             w.lease_id = None
         self._drain_queue()
         return {"ok": True}
+
+    async def worker_blocked(self, worker_id: WorkerID):
+        """The worker is parked in a blocking get()/wait() inside its
+        task: release the lease's resources so the tasks it is waiting ON
+        can take leases here — without this, a parent task on a saturated
+        node deadlocks against its own children (the reference releases a
+        blocked worker's CPU the same way, raylet/node_manager.cc
+        HandleWorkerBlocked)."""
+        for lease in self.leases.values():
+            if lease.worker.worker_id == worker_id:
+                lease.blocked += 1
+                if lease.blocked == 1:
+                    self._release_res(lease.resources, lease.pg_id,
+                                      lease.bundle_index)
+                    self._drain_queue()
+                return {"ok": True}
+        return {"ok": False}
+
+    async def worker_unblocked(self, worker_id: WorkerID):
+        for lease in self.leases.values():
+            if lease.worker.worker_id == worker_id and lease.blocked > 0:
+                lease.blocked -= 1
+                if lease.blocked == 0 and not self._try_acquire(
+                        lease.resources, lease.pg_id, lease.bundle_index):
+                    # the freed capacity went to children while we were
+                    # blocked: run temporarily oversubscribed (available
+                    # goes negative) rather than deadlock on re-acquire —
+                    # it self-corrects as leases release
+                    pool = self._avail_for(lease.pg_id, lease.bundle_index)
+                    for k, v in lease.resources.items():
+                        pool[k] = pool.get(k, 0.0) - v
+                return {"ok": True}
+        return {"ok": False}
 
     def _drain_queue(self):
         still = []

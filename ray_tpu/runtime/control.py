@@ -123,6 +123,10 @@ class ControlService:
             self._store = FileStore(persist_dir)
         self._recover_deadline = 0.0
         self._drained: set = set()         # node ids removed for good
+        from collections import deque
+        # span buffers archived by departing nodes (collect_timeline)
+        self._archived_events: "deque" = deque(
+            maxlen=self.config.event_buffer_size)
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}
@@ -171,6 +175,7 @@ class ControlService:
             "add_object_location": self.add_object_location,
             "report_objects": self.report_objects,
             "collect_timeline": self.collect_timeline,
+            "report_node_events": self.report_node_events,
             "remove_object_location": self.remove_object_location,
             "get_object_locations": self.get_object_locations,
             "poll_events": self.poll_events,
@@ -756,26 +761,29 @@ class ControlService:
         self.pgs[pg_id] = info
         # Stay PENDING while the cluster is busy: resource views refresh on
         # heartbeats, so placement that is infeasible *now* may fit in a
-        # moment (reference: PGs queue in GcsPlacementGroupManager). Fail
-        # fast only when no combination of TOTAL node capacities can ever
-        # host the bundles. A prepare-phase race (two PGs placed on the
-        # same stale view) also retries within the deadline. Concurrent
-        # remove_pg aborts the wait.
-        deadline = time.monotonic() + 30.0
+        # moment (reference: PGs queue in GcsPlacementGroupManager). Even
+        # exceeding TOTAL cluster capacity is only terminal after the
+        # infeasibility window: a PENDING gang's bundles are autoscaler
+        # demand (autoscaler.py _collect_demand), so capacity may be on
+        # its way — this is SURVEY section 7's "slice reservation races
+        # autoscaling" hard part, resolved by making the reservation
+        # patient instead of fail-fast. A prepare-phase race (two PGs
+        # placed on the same stale view) also retries within the
+        # deadline. Concurrent remove_pg aborts the wait.
+        deadline = time.monotonic() + max(
+            30.0, self.config.infeasible_wait_window_s)
         while True:
             if info.state == "REMOVED":
                 return {"ok": False, "error": "placement group removed"}
             placement = self._place_bundles(info)
             if placement is None:
-                if not self._feasible_by_total(info):
-                    info.state = "INFEASIBLE"
-                    return {"ok": False,
-                            "error": "infeasible placement group "
-                                     "(exceeds total cluster capacity)"}
                 if time.monotonic() >= deadline:
                     info.state = "INFEASIBLE"
+                    reason = "exceeds total cluster capacity" \
+                        if not self._feasible_by_total(info) \
+                        else "timed out pending"
                     return {"ok": False,
-                            "error": "placement group timed out pending"}
+                            "error": f"placement group {reason}"}
                 await asyncio.sleep(0.25)
                 continue
             # Phase 1: prepare on every node (all-or-nothing).
@@ -923,9 +931,17 @@ class ControlService:
         self.object_locations.setdefault(oid, {})[node_id] = size
         return {"ok": True}
 
+    async def report_node_events(self, events: list) -> dict:
+        """A stopping node archives its span buffer here so the cluster
+        timeline outlives it (reference: task events live in the GCS,
+        gcs/gcs_task_manager.h)."""
+        self._archived_events.extend(events)
+        return {"ok": True, "count": len(events)}
+
     async def collect_timeline(self) -> dict:
-        """Cluster-wide event/span collection: fan out to every alive
-        agent (reference surface: ray.timeline via gcs_task_manager)."""
+        """Cluster-wide event/span collection: archived buffers from
+        departed nodes + a fan-out to every alive agent (reference
+        surface: ray.timeline via gcs_task_manager)."""
         async def pull(addr):
             try:
                 r = await self.pool.call(addr, "node_timeline",
@@ -936,7 +952,10 @@ class ControlService:
 
         results = await asyncio.gather(*[
             pull(n.addr) for n in list(self.nodes.values()) if n.alive])
-        return {"events": [e for evs in results for e in evs]}
+        out = list(self._archived_events)
+        for evs in results:
+            out.extend(evs)
+        return {"events": out}
 
     async def report_objects(self, node_id: NodeID, objects) -> dict:
         """Bulk object-directory refresh: an agent re-registering after a

@@ -575,10 +575,42 @@ class CoreContext:
             raise self._loads_error(e.error_frame)
         return False, None
 
-    async def get(self, refs, timeout: Optional[float] = None):
+    def _refs_locally_ready(self, refs) -> bool:
+        for r in refs:
+            e = self.store.get_entry(r.oid)
+            if e is None or e.status == PENDING:
+                return False
+        return True
+
+    async def _notify_block_state(self, method: str) -> bool:
+        """Tell the local agent this worker is entering/leaving a blocking
+        get/wait inside a task, so the lease's resources free up for the
+        children it waits on (reference: blocked workers release their
+        CPU, raylet HandleWorkerBlocked)."""
+        import os
+        wid = os.environ.get("RAY_TPU_WORKER_ID")
+        if not wid:
+            return False
+        try:
+            r = await self.pool.call(
+                self.agent_addr, method,
+                worker_id=WorkerID.from_hex(wid), timeout=5.0)
+            return bool(r.get("ok"))
+        except Exception:
+            return False
+
+    async def get(self, refs, timeout: Optional[float] = None,
+                  in_task: bool = False):
         single = isinstance(refs, ObjectRef)
         if single:
             refs = [refs]
+        from ray_tpu.util import tracing
+        blocked = False
+        if not in_task and not self.is_driver \
+                and tracing.current_span.get():
+            in_task = True  # async actor methods run in exec context
+        if in_task and not self._refs_locally_ready(refs):
+            blocked = await self._notify_block_state("worker_blocked")
         try:
             # The outer wait_for bounds the WHOLE path — resolve, pull,
             # and any lineage recovery — by the caller's budget.
@@ -590,6 +622,9 @@ class CoreContext:
                 values = await coro
         except asyncio.TimeoutError:
             raise GetTimeoutError(f"get() timed out after {timeout}s")
+        finally:
+            if blocked:
+                await self._notify_block_state("worker_unblocked")
         return values[0] if single else values
 
     async def _get_one(self, ref: ObjectRef, timeout: Optional[float]):
@@ -787,13 +822,22 @@ class CoreContext:
         return {"kind": "lost"}
 
     async def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
-                   timeout: Optional[float] = None):
+                   timeout: Optional[float] = None,
+                   in_task: bool = False):
         """Park one subscription per pending ref (owner-side event wait; for
         borrowed refs a long-poll parked on the owner) and return once
         `num_returns` are ready — no polling loop (reference:
         raylet/wait_manager.h parks waiters on object-ready callbacks)."""
         refs = list(refs)
         num_returns = min(num_returns, len(refs))
+        blocked = False
+        if in_task and sum(
+                1 for r in refs
+                if (e := self.store.get_entry(r.oid)) is not None
+                and e.status != PENDING) < num_returns:
+            # same deadlock-avoidance as get(): a task parked in wait()
+            # must give its lease's resources back to its children
+            blocked = await self._notify_block_state("worker_blocked")
         tasks: Dict[asyncio.Task, ObjectRef] = {
             asyncio.ensure_future(self._await_ready(r)): r for r in refs}
         deadline = (time.monotonic() + timeout) if timeout is not None else None
@@ -815,6 +859,8 @@ class CoreContext:
         finally:
             for t in tasks:
                 t.cancel()
+            if blocked:
+                await self._notify_block_state("worker_unblocked")
         # Exactly num_returns in `ready` even when more resolved in the
         # same wakeup — callers rely on the reference's contract that
         # len(ready) <= num_returns; surplus completions stay "pending"

@@ -63,7 +63,6 @@ class WorkerExecutor:
         ctx.server.add_handler("actor_call", self.actor_call)
         ctx.server.add_handler("actor_call_batch", self.actor_call_batch)
         ctx.server.add_handler("cancel_task", self.cancel_task)
-        ctx.server.add_handler("get_events", self.get_events)
         ctx.server.add_handler("shutdown_worker", self.shutdown_worker)
 
     # --- common result packaging -----------------------------------------
@@ -233,13 +232,32 @@ class WorkerExecutor:
         self.cancelled.add(task_id)
         return {"ok": True}
 
-    async def get_events(self):
-        """This worker's event/span buffer, node-tagged (pulled by the
-        agent for the cluster timeline — the reference ships worker task
-        events to the GCS instead, task_event_buffer.h)."""
+    async def flush_events(self) -> int:
+        """Ship this worker's span buffer to the agent (the reference
+        pushes worker task events to the GCS the same way,
+        task_event_buffer.h). Runs every second and at shutdown so spans
+        survive the worker process."""
         from ray_tpu.util import events
+        evs = events.drain()
+        if not evs:
+            return 0
         nid = self.ctx.node_id.hex()
-        return {"events": [{**e, "node": nid} for e in events.dump()]}
+        try:
+            await self.ctx.pool.call(
+                self.ctx.agent_addr, "report_events",
+                events=[{**e, "node": nid} for e in evs], timeout=10.0)
+        except Exception:
+            # transient agent hiccup: put the batch back so the next
+            # tick retries instead of dropping this window's spans
+            events.requeue(evs)
+            return 0
+        return len(evs)
+
+    async def _event_flush_loop(self):
+        import asyncio as _a
+        while True:
+            await _a.sleep(1.0)
+            await self.flush_events()
 
     # --- actors -------------------------------------------------------------
 
@@ -370,6 +388,7 @@ class WorkerExecutor:
         return vals
 
     async def shutdown_worker(self):
+        await self.flush_events()     # spans must outlive the worker
         asyncio.get_running_loop().call_later(0.05, sys.exit, 0)
         return {"ok": True}
 
@@ -391,7 +410,8 @@ async def _amain():
     session = os.environ["RAY_TPU_SESSION"]
 
     ctx = CoreContext(head, agent, node_id, session, is_driver=False)
-    WorkerExecutor(ctx)
+    executor = WorkerExecutor(ctx)
+    asyncio.ensure_future(executor._event_flush_loop())
     await ctx.start()
 
     # Make the worker-side public API work inside tasks (subtask submission,

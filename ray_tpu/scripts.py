@@ -234,6 +234,20 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_timeline(args) -> int:
+    """Collect the cluster-wide task/span timeline; write a
+    chrome://tracing / Perfetto JSON file (reference: `ray timeline`)."""
+    from ray_tpu.util.tracing import to_chrome
+    addr = _resolve_address(args)
+    evs = _call_head(addr, "collect_timeline").get("events", [])
+    recs = to_chrome(evs, args.output)
+    spans = sum(1 for r in recs if r.get("ph") == "X")
+    flows = sum(1 for r in recs if r.get("ph") == "s")
+    print(f"wrote {args.output}: {spans} spans, {flows} flow edges "
+          f"({len(evs)} raw events)")
+    return 0
+
+
 def cmd_job(args) -> int:
     from ray_tpu.job_submission import JobSubmissionClient
     addr = _resolve_address(args)
@@ -312,6 +326,13 @@ def main(argv=None) -> int:
     pm = sub.add_parser("metrics", help="dump a node's /metrics")
     pm.add_argument("--endpoint", help="host:port (default: latest local)")
     pm.set_defaults(fn=cmd_metrics)
+
+    pt = sub.add_parser("timeline",
+                        help="dump the cluster task timeline "
+                             "(chrome://tracing JSON)")
+    pt.add_argument("--address")
+    pt.add_argument("-o", "--output", default="timeline.json")
+    pt.set_defaults(fn=cmd_timeline)
 
     pj = sub.add_parser("job", help="submit / inspect entrypoint jobs")
     jsub = pj.add_subparsers(dest="job_cmd", required=True)

@@ -28,6 +28,22 @@ def dump() -> List[dict]:
         return list(_BUF)
 
 
+def drain() -> List[dict]:
+    """Atomically take-and-clear (the worker's periodic flush to its
+    agent — events must not be double-shipped or lost in between)."""
+    with _LOCK:
+        out = list(_BUF)
+        _BUF.clear()
+        return out
+
+
+def requeue(evs: List[dict]) -> None:
+    """Put a drained batch back at the FRONT (a failed flush retries on
+    the next tick instead of losing that window's spans)."""
+    with _LOCK:
+        _BUF.extendleft(reversed(evs))
+
+
 def clear() -> None:
     with _LOCK:
         _BUF.clear()

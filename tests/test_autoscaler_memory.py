@@ -152,3 +152,47 @@ def test_cgroup_kernel_memory_cap():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_slice_reservation_drives_autoscaling():
+    """SURVEY section 7 hard part: slice gang reservation must compose
+    with autoscaling — a pending SlicePlacementGroup's TPU bundles are
+    demand the reconciler satisfies, after which the STRICT_SPREAD gang
+    commits on the fresh nodes."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.tpu import slice_placement_group
+    cfg = Config.from_env(infeasible_wait_window_s=60.0)
+    c = Cluster(config=cfg)
+    c.add_node(num_cpus=0)          # head-side anchor; no TPU anywhere
+    ray_tpu.init(address=c.address, num_cpus=0, config=cfg)
+    elt = rpc.EventLoopThread("slice_scaler_test")
+    provider = LocalNodeProvider(c.address)
+    scaler = Autoscaler(c.address, provider, AutoscalerConfig(
+        min_nodes=0, max_nodes=2,
+        node_resources={"CPU": 1.0, "TPU": 4.0},
+        idle_timeout_s=30.0, reconcile_interval_s=0.5))
+    elt.run(scaler.start())
+    try:
+        spg = slice_placement_group(pod_type="v5e-8", num_hosts=2,
+                                    chips=4, name="slice0")
+        # the gang cannot place now (zero TPU nodes); the autoscaler
+        # must observe the pending bundles and launch 2 TPU nodes
+        assert spg.pg.ready(timeout=120), "slice never placed"
+        nodes = ray_tpu.nodes()
+        tpu_nodes = [n for n in nodes
+                     if (n.get("resources_total") or {}).get("TPU")]
+        assert len(tpu_nodes) >= 2
+        # STRICT_SPREAD: the two bundles landed on distinct nodes
+        info = c.elt.run(c.head.pool.call(
+            c.head_addr, "get_pg", pg_id=spg.pg.id))
+        assert info["state"] == "CREATED"
+        assert len(set(info["bundle_nodes"])) == 2
+    finally:
+        try:
+            elt.run(scaler.stop(), timeout=30)
+            for h in elt.run(provider.alive_handles()):
+                elt.run(provider.terminate(h), timeout=20)
+        finally:
+            elt.stop()
+            ray_tpu.shutdown()
+            c.shutdown()

@@ -1,0 +1,94 @@
+"""Blocked workers release their lease resources (deadlock avoidance).
+
+Reference behavior: a worker blocked in ray.get releases its CPU so the
+tasks it waits on can schedule (raylet HandleWorkerBlocked /
+node_manager.cc); without it, a parent task on a saturated node
+deadlocks against its own children. Found live: a 1-CPU CLI node hung
+forever on a nested fan-out.
+"""
+
+import pytest
+
+import ray_tpu
+
+
+def test_nested_get_on_saturated_node():
+    # ONE cpu total: the parent's lease is the only capacity, so its
+    # children can only run if the blocked parent gives the cpu back
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def leaf(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def root():
+            return sum(ray_tpu.get([leaf.remote(i) for i in range(3)],
+                                   timeout=60))
+
+        assert ray_tpu.get(root.remote(), timeout=90) == 6
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_deeply_nested_chain():
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def step(depth):
+            if depth == 0:
+                return 1
+            return 1 + ray_tpu.get(step.remote(depth - 1), timeout=60)
+
+        # every level blocks holding (then releasing) the single cpu
+        assert ray_tpu.get(step.remote(4), timeout=120) == 5
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_resources_restore_after_unblock():
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def leaf(x):
+            return x
+
+        @ray_tpu.remote
+        def root():
+            return sum(ray_tpu.get([leaf.remote(i) for i in range(4)],
+                                   timeout=60))
+
+        assert ray_tpu.get(root.remote(), timeout=90) == 6
+        # after everything completes, availability is back to total
+        # (no leaked or double-counted capacity from block/unblock)
+        import time
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            n = [x for x in ray_tpu.nodes() if x["alive"]][0]
+            if n["resources_available"].get("CPU") == 2.0:
+                break
+            time.sleep(0.2)
+        assert n["resources_available"].get("CPU") == 2.0, n
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_nested_wait_on_saturated_node():
+    """wait() inside a task releases the lease too (same deadlock class
+    as get)."""
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def leaf(x):
+            return x
+
+        @ray_tpu.remote
+        def root():
+            refs = [leaf.remote(i) for i in range(3)]
+            ready, pending = ray_tpu.wait(refs, num_returns=3, timeout=60)
+            assert not pending
+            return sum(ray_tpu.get(ready, timeout=30))
+
+        assert ray_tpu.get(root.remote(), timeout=90) == 3
+    finally:
+        ray_tpu.shutdown()
