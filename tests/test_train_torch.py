@@ -1,0 +1,59 @@
+"""TorchTrainer: real gloo DDP over the worker group.
+
+Reference behavior analog: train/torch/config.py (_TorchBackend sets up
+the process group; DDP averages gradients across the gang). Verifies the
+MASTER_ADDR/PORT + RANK/WORLD_SIZE plumbing against an actual
+torch.distributed.init_process_group("gloo") + DistributedDataParallel
+step, not just env-var assertions.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import ScalingConfig
+
+
+def _ddp_train_fn(config=None):
+    import torch
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel as DDP
+    dist.init_process_group("gloo")
+    try:
+        rank, ws = dist.get_rank(), dist.get_world_size()
+        torch.manual_seed(0)
+        model = DDP(torch.nn.Linear(4, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        # rank-dependent data: the loss differs per rank, so identical
+        # post-step weights prove DDP actually averaged the gradients
+        x = torch.full((8, 4), float(rank + 1))
+        y = torch.zeros(8, 1)
+        loss = None
+        for _ in range(3):
+            opt.zero_grad()
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            train.report({"loss": loss.item(), "ws": ws})
+        w = model.module.weight.detach().numpy().copy()
+        t = torch.from_numpy(w.copy())
+        dist.broadcast(t, src=0)
+        assert np.allclose(t.numpy(), w), "weights diverged across ranks"
+    finally:
+        dist.destroy_process_group()
+
+
+def test_torch_trainer_gloo_ddp():
+    ray_tpu.init(num_cpus=4)
+    try:
+        trainer = train.TorchTrainer(
+            _ddp_train_fn, scaling_config=ScalingConfig(num_workers=2))
+        res = trainer.fit()
+        assert res.error is None, res.error
+        assert res.metrics.get("ws") == 2
+        assert np.isfinite(res.metrics.get("loss"))
+    finally:
+        ray_tpu.shutdown()
