@@ -41,7 +41,12 @@ class Deployment:
                  max_ongoing_requests: int = 16,
                  route_prefix: Optional[str] = None,
                  user_config: Optional[dict] = None,
-                 ray_actor_options: Optional[dict] = None):
+                 ray_actor_options: Optional[dict] = None,
+                 gang: Any = None):
+        if gang and autoscaling_config:
+            raise ValueError(
+                "gang deployments are fixed-size: gang= and "
+                "autoscaling_config= are mutually exclusive")
         self._target = cls_or_fn
         self.name = name
         self.num_replicas = num_replicas
@@ -50,6 +55,7 @@ class Deployment:
         self.route_prefix = route_prefix
         self.user_config = user_config
         self.ray_actor_options = ray_actor_options
+        self.gang = "STRICT_SPREAD" if gang is True else gang
 
     def options(self, **kw) -> "Deployment":
         merged = dict(
@@ -59,6 +65,7 @@ class Deployment:
             route_prefix=self.route_prefix,
             user_config=self.user_config,
             ray_actor_options=self.ray_actor_options,
+            gang=self.gang,
         )
         name = kw.pop("name", self.name)
         merged.update(kw)
@@ -90,11 +97,18 @@ def deployment(_target: Optional[Callable] = None, *,
                max_ongoing_requests: int = 16,
                route_prefix: Optional[str] = None,
                user_config: Optional[dict] = None,
-               ray_actor_options: Optional[dict] = None):
+               ray_actor_options: Optional[dict] = None,
+               gang: Any = None):
     """``@serve.deployment`` / ``@serve.deployment(num_replicas=...)``.
 
     ``num_replicas`` may be an int or ``"auto"`` (autoscaling with
     defaults); explicit ``autoscaling_config`` wins.
+
+    ``gang=True`` (or a PG strategy string) co-schedules the replicas as
+    ONE placement group — num_replicas bundles of the replica's
+    resources, STRICT_SPREAD by default, all-or-nothing (reference:
+    serve/gang.py gang deployments for TP x PP engines; here the gang is
+    the slice-granular unit, e.g. one replica per TPU host).
     """
     def wrap(target):
         nonlocal autoscaling_config, num_replicas
@@ -108,7 +122,8 @@ def deployment(_target: Optional[Callable] = None, *,
             max_ongoing_requests=max_ongoing_requests,
             route_prefix=route_prefix,
             user_config=user_config,
-            ray_actor_options=ray_actor_options)
+            ray_actor_options=ray_actor_options,
+            gang=gang)
 
     if _target is not None:
         return wrap(_target)
@@ -158,6 +173,7 @@ def _collect_specs(app: Application, specs: Dict[str, dict]):
         "route_prefix": d.route_prefix,
         "user_config": d.user_config,
         "actor_options": d.ray_actor_options,
+        "gang": getattr(d, "gang", None),
     }
 
 

@@ -31,7 +31,7 @@ HEALTH_CHECK_TIMEOUT_S = 10.0
 
 class _ReplicaInfo:
     __slots__ = ("actor_id", "state", "name", "started_at",
-                 "last_healthy", "ongoing", "model_ids")
+                 "last_healthy", "ongoing", "model_ids", "bundle_index")
 
     def __init__(self, actor_id: ActorID, name: str):
         self.actor_id = actor_id
@@ -41,6 +41,7 @@ class _ReplicaInfo:
         self.last_healthy = time.time()
         self.ongoing = 0
         self.model_ids: List[str] = []   # multiplexed models loaded here
+        self.bundle_index: Optional[int] = None   # gang PG slot
 
 
 class _DeploymentState:
@@ -52,6 +53,12 @@ class _DeploymentState:
         self.target = self._initial_target()
         self.last_scale_up_signal = time.time()
         self.last_scale_change = 0.0
+        # gang scheduling (spec["gang"]): one PG, one bundle per replica
+        self.pg_id = None
+        self.pg_creating = False
+        self.pg_error: Optional[str] = None
+        self.pg_error_at = 0.0
+        self.pg_checked_at = 0.0
 
     def _initial_target(self) -> int:
         auto = self.spec.get("autoscaling_config")
@@ -118,6 +125,13 @@ class ServeController:
                 for r in existing.replicas.values():
                     r.state = "STOPPING"
                 existing.version += 1
+                # a gang PG reflects the OLD spec's size/resources:
+                # release it and let the reconcile loop re-reserve
+                if existing.pg_id is not None:
+                    asyncio.ensure_future(self._remove_pg(existing.pg_id))
+                existing.pg_id = None
+                existing.pg_creating = False
+                existing.pg_error = None
         # Deployments removed from the app spec are torn down.
         for old in self.apps.get(app_name, []):
             if old not in names and old in self.deployments:
@@ -203,6 +217,11 @@ class ServeController:
                     for rid, r in dep.replicas.items()
                 },
             }
+            if dep.spec.get("gang"):
+                out[name]["gang"] = {
+                    "pg_id": dep.pg_id.hex() if dep.pg_id else None,
+                    "error": dep.pg_error,
+                }
         return out
 
     # -- reconcile ---------------------------------------------------------
@@ -223,7 +242,11 @@ class ServeController:
             dep = self.deployments[name]
             await self._autoscale(dep)
             await self._converge(dep)
-            if dep.spec.get("_deleted") and not dep.replicas:
+            if dep.spec.get("_deleted") and not dep.replicas \
+                    and not dep.pg_creating:
+                if dep.pg_id is not None:
+                    await self._remove_pg(dep.pg_id)
+                    dep.pg_id = None
                 del self.deployments[name]
 
     async def _converge(self, dep: _DeploymentState):
@@ -259,7 +282,31 @@ class ServeController:
                 except Exception:
                     r.state = "STOPPING"
                     dep.version += 1
-        # 3. scale toward target
+        # 3. gang deployments reserve their placement group first:
+        #    replicas only start once every bundle is committed
+        #    (all-or-nothing, reference: serve/gang.py)
+        if dep.spec.get("gang") and not dep.spec.get("_deleted"):
+            now = time.time()
+            if dep.pg_id is not None and now - dep.pg_checked_at > 2.0:
+                # gang health: a bundle on a dead node invalidates the
+                # whole reservation (all-or-nothing) — tear down and
+                # re-reserve so the gang moves to healthy capacity
+                dep.pg_checked_at = now
+                if not await self._gang_pg_healthy(dep):
+                    await self._remove_pg(dep.pg_id)
+                    dep.pg_id = None
+                    for r in dep.replicas.values():
+                        r.state = "STOPPING"
+                    dep.version += 1
+            if dep.pg_id is None:
+                if dep.pg_error is not None and \
+                        now - dep.pg_error_at > 5.0:
+                    dep.pg_error = None      # retry after backoff
+                if not dep.pg_creating and dep.pg_error is None:
+                    dep.pg_creating = True
+                    asyncio.ensure_future(self._create_gang_pg(dep))
+                return
+        # 3b. scale toward target
         alive = [r for r in dep.replicas.values()
                  if r.state in ("STARTING", "RUNNING")]
         missing = dep.target - len(alive)
@@ -272,11 +319,8 @@ class ServeController:
                 r.state = "STOPPING"
                 dep.version += 1
 
-    async def _start_replica(self, dep: _DeploymentState):
-        from ray_tpu.serve.replica import Replica
-        rid = uuid.uuid4().hex[:8]
-        name = f"SERVE_REPLICA:{dep.name}:{rid}"
-        spec = dep.spec
+    @staticmethod
+    def _replica_resources(spec: dict) -> dict:
         opts = dict(spec.get("actor_options") or {})
         resources = dict(opts.get("resources") or {})
         if opts.get("num_cpus") is not None:
@@ -285,6 +329,76 @@ class ServeController:
             resources["TPU"] = float(opts["num_tpus"])
         if "CPU" not in resources and "TPU" not in resources:
             resources["CPU"] = 1.0
+        return resources
+
+    async def _create_gang_pg(self, dep: _DeploymentState):
+        """Reserve the gang: num_replicas bundles of the replica's
+        resources in ONE placement group (all-or-nothing)."""
+        from ray_tpu.runtime.ids import PlacementGroupID
+        ctx = self._ctx()
+        res = self._replica_resources(dep.spec)
+        pg_id = PlacementGroupID.generate()
+        try:
+            r = await ctx.pool.call(
+                ctx.head_addr, "create_pg", pg_id=pg_id,
+                bundles=[dict(res) for _ in range(dep.target)],
+                strategy=str(dep.spec["gang"]),
+                name=f"serve_gang:{dep.name}", timeout=120.0)
+            if r.get("ok"):
+                if dep.spec.get("_deleted") or \
+                        self.deployments.get(dep.name) is not dep:
+                    # deleted/replaced while reserving: don't leak the
+                    # committed bundles on an orphaned state object
+                    await self._remove_pg(pg_id)
+                else:
+                    dep.pg_id = pg_id
+                    dep.pg_error = None
+            else:
+                dep.pg_error = r.get("error", "gang reserve failed")
+                dep.pg_error_at = time.time()
+        except Exception as e:  # noqa: BLE001
+            dep.pg_error = f"{type(e).__name__}: {e}"
+            dep.pg_error_at = time.time()
+        finally:
+            dep.pg_creating = False
+
+    async def _remove_pg(self, pg_id) -> None:
+        try:
+            ctx = self._ctx()
+            await ctx.pool.call(ctx.head_addr, "remove_pg", pg_id=pg_id)
+        except Exception:
+            pass
+
+    async def _gang_pg_healthy(self, dep: _DeploymentState) -> bool:
+        try:
+            ctx = self._ctx()
+            info = await ctx.pool.call(ctx.head_addr, "get_pg",
+                                       pg_id=dep.pg_id, timeout=10.0)
+            if info is None or info["state"] != "CREATED":
+                return False
+            nodes = await ctx.pool.call(ctx.head_addr, "get_nodes",
+                                        timeout=10.0)
+            alive = {n["node_id"] for n in nodes if n["alive"]}
+            return all(nid in alive for nid in info["bundle_nodes"])
+        except Exception:
+            return True  # can't tell; don't churn on a control hiccup
+
+    async def _start_replica(self, dep: _DeploymentState):
+        from ray_tpu.serve.replica import Replica
+        rid = uuid.uuid4().hex[:8]
+        name = f"SERVE_REPLICA:{dep.name}:{rid}"
+        spec = dep.spec
+        resources = self._replica_resources(spec)
+        pg = None
+        bundle_index = None
+        if dep.pg_id is not None:
+            used = {r.bundle_index for r in dep.replicas.values()
+                    if r.bundle_index is not None}
+            free = [i for i in range(dep.target) if i not in used]
+            if not free:
+                return  # every gang slot is occupied
+            bundle_index = free[0]
+            pg = (dep.pg_id, bundle_index)
         try:
             actor_id = await self._ctx().create_actor(
                 Replica,
@@ -295,11 +409,14 @@ class ServeController:
                 {},
                 name=name, namespace="serve",
                 resources=resources,
+                pg=pg,
                 max_concurrency=int(spec.get("max_ongoing_requests", 16)),
                 lifetime="detached")
         except Exception:
             return
-        dep.replicas[rid] = _ReplicaInfo(actor_id, name)
+        info = _ReplicaInfo(actor_id, name)
+        info.bundle_index = bundle_index
+        dep.replicas[rid] = info
 
     # -- autoscaling -------------------------------------------------------
 
