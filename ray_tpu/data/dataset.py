@@ -111,6 +111,19 @@ class Dataset:
     def zip(self, other: "Dataset") -> "Dataset":
         return self._with(_Op("zip", "zip", None, {"other": other._ops}))
 
+    def join(self, other: "Dataset", on: str, *,
+             join_type: str = "inner", suffix: str = "_r") -> "Dataset":
+        """Hash join on a key column (reference:
+        data/_internal/execution/operators/join.py). ``join_type`` is
+        "inner" or "left"; colliding right columns get ``suffix``. Runs
+        distributed when the runtime is up (both sides hash-partitioned
+        by key, one join task per partition)."""
+        if join_type not in ("inner", "left"):
+            raise ValueError("join_type must be 'inner' or 'left'")
+        return self._with(_Op("join", "join", None,
+                              {"other": other._ops, "on": on,
+                               "join_type": join_type, "suffix": suffix}))
+
     # ---- execution ----
     def iter_blocks(self) -> Iterator[Block]:
         yield from _execute(self._ops)
@@ -353,6 +366,8 @@ def _apply(stream: Iterator[Block], op: _Op) -> Iterator[Block]:
         return union_gen()
     if op.kind == "zip":
         return _zip_stream(stream, _execute(op.args["other"]))
+    if op.kind == "join":
+        return _join_exec(stream, op)
     raise ValueError(f"unknown op kind {op.kind}")
 
 
@@ -545,6 +560,25 @@ def _all2all_local(stream: Iterator[Block], op: _Op) -> Iterator[Block]:
         if start >= total:
             break
         yield block_slice(merged, start, end)
+
+
+def _join_exec(stream: Iterator[Block], op: _Op) -> Iterator[Block]:
+    other = _execute(op.args["other"])
+    key = op.args["on"]
+    jt, suffix = op.args["join_type"], op.args["suffix"]
+    if _runtime_up():
+        from ray_tpu.data.shuffle import distributed_join
+        yield from distributed_join(stream, other, key, jt, suffix)
+        return
+    # local fallback (no cluster): concat both sides, one in-driver join
+    from ray_tpu.data.shuffle import join_blocks
+    lblocks = [b for b in stream if block_num_rows(b)]
+    rblocks = [b for b in other if block_num_rows(b)]
+    out = join_blocks(block_concat(lblocks) if lblocks else None,
+                      block_concat(rblocks) if rblocks else None,
+                      key, jt, suffix)
+    if block_num_rows(out):
+        yield out
 
 
 def _zip_stream(a: Iterator[Block], b: Iterator[Block]) -> Iterator[Block]:

@@ -59,7 +59,9 @@ def _spec_partition(block: Block, n_out: int, spec: dict) -> List[Block]:
     out = []
     for j in range(n_out):
         idx = np.nonzero(part == j)[0]
-        out.append(block_take(block, idx) if len(idx) else {})
+        # empty partitions keep their COLUMNS (zero-row block): the join
+        # needs the right-side schema in right-empty partitions
+        out.append(block_take(block, idx))
     # num_returns=1 stores the return value as ONE object — return the
     # bare block so the merge task doesn't see a single-element list.
     return out[0] if n_out == 1 else out
@@ -121,12 +123,7 @@ def distributed_all2all(stream: Iterator[Block],
         spec = dict(spec)
         spec["bounds"] = _sample_bounds(in_refs, spec, n_out)
 
-    part_fn = ray_tpu.remote(_spec_partition).options(num_returns=n_out)
-    rows = []
-    for ref in in_refs:
-        r = part_fn.remote(ref, n_out, spec)
-        rows.append([r] if n_out == 1 else r)  # bare ref when 1 return
-    cols = [[rows[i][j] for i in range(len(rows))] for j in range(n_out)]
+    cols = _fan_cols(in_refs, n_out, spec)
     merge_fn = ray_tpu.remote(_spec_merge)
     out_refs = [merge_fn.remote(spec, *col) for col in cols]
     # Stream the reduced partitions; free inputs after the first merge
@@ -149,6 +146,19 @@ def distributed_all2all(stream: Iterator[Block],
                 out = block_take(
                     out, np.arange(block_num_rows(out) - 1, -1, -1))
             yield out
+
+
+def _fan_cols(in_refs, n_out: int, spec: dict):
+    """Map phase: one _spec_partition task per input block; returns the
+    transposed [partition][input] ref grid (shared by shuffle and join —
+    the free/zero-copy protocol must stay identical in both)."""
+    import ray_tpu
+    part_fn = ray_tpu.remote(_spec_partition).options(num_returns=n_out)
+    rows = []
+    for ref in in_refs:
+        r = part_fn.remote(ref, n_out, spec)
+        rows.append([r] if n_out == 1 else r)  # bare ref when 1 return
+    return [[rows[i][j] for i in range(len(rows))] for j in range(n_out)]
 
 
 def _sample_bounds(in_refs, spec: dict, n_out: int) -> np.ndarray:
@@ -181,3 +191,114 @@ def distributed_groupby(stream: Iterator[Block], key: str,
     land in one partition, so per-partition aggregation is exact)."""
     spec = {"mode": "hash", "key": key, "aggs": aggs}
     yield from distributed_all2all(stream, spec)
+
+
+# --- join ------------------------------------------------------------------
+# Reference: python/ray/data/_internal/execution/operators/join.py (hash
+# join: both sides hash-partitioned by key, each output partition joined
+# independently — all rows of one key land in the same partition pair).
+
+def join_blocks(lb: Optional[Block], rb: Optional[Block], key: str,
+                join_type: str, suffix: str) -> Block:
+    """Join two (already co-partitioned) blocks on `key`. inner / left;
+    left-join fills missing right numerics with NaN and everything else
+    with None (object dtype)."""
+    if lb is None or not block_num_rows(lb):
+        return {}
+    # a right block with columns but zero rows still contributes SCHEMA:
+    # a left join must emit its columns (as nulls) in every partition
+    have_right = rb is not None and len(rb) > 0
+    r_rows = block_num_rows(rb) if have_right else 0
+    keys_l = np.asarray(lb[key])
+    ridx: Dict[Any, List[int]] = {}
+    if r_rows:
+        for i, k in enumerate(np.asarray(rb[key]).tolist()):
+            ridx.setdefault(k, []).append(i)
+    li: List[int] = []
+    ri: List[int] = []
+    for i, k in enumerate(keys_l.tolist()):
+        matches = ridx.get(k)
+        if matches:
+            for j in matches:
+                li.append(i)
+                ri.append(j)
+        elif join_type == "left":
+            li.append(i)
+            ri.append(-1)           # null marker
+    if not li:
+        return {}
+    out = dict(block_take(lb, np.asarray(li, np.int64)))
+    if have_right:
+        rtake = np.asarray([j if j >= 0 else 0 for j in ri], np.int64)
+        nulls = np.asarray([j < 0 for j in ri])
+        for col, vals in rb.items():
+            if col == key:
+                continue
+            name = col
+            while name in out:   # keep suffixing until unique — a right
+                name += suffix   # column named f"{col}{suffix}" must not
+                                 # be silently overwritten
+            if r_rows:
+                v = np.asarray(block_take({col: vals}, rtake)[col])
+            else:  # zero-row right partition: every match is null
+                v = np.asarray(vals)
+            if nulls.any() or not r_rows:
+                if v.dtype.kind in "fiub":
+                    v = np.resize(v.astype(np.float64), len(li))
+                    v[nulls] = np.nan
+                else:
+                    v = np.resize(v.astype(object), len(li))
+                    v[nulls] = None
+            out[name] = v
+    return out
+
+
+def _join_partition(key: str, join_type: str, suffix: str, n_left: int,
+                    *parts: Block) -> Block:
+    """One output partition: concat this partition's left and right
+    sub-blocks, join them. Runs inside a worker task."""
+    left = [p for p in parts[:n_left] if block_num_rows(p)]
+    # keep zero-row right parts: they carry the right-side SCHEMA, which
+    # a left join needs to emit null columns in right-empty partitions
+    right = [p for p in parts[n_left:] if len(p) > 0]
+    nonempty_r = [p for p in right if block_num_rows(p)]
+    lb = block_concat(left) if left else None
+    rb = block_concat(nonempty_r) if nonempty_r else (
+        right[0] if right else None)
+    return join_blocks(lb, rb, key, join_type, suffix)
+
+
+def distributed_join(left: Iterator[Block], right: Iterator[Block],
+                     key: str, join_type: str = "inner",
+                     suffix: str = "_r") -> Iterator[Block]:
+    """Hash join across the cluster: both sides partitioned by key, one
+    join task per partition, outputs streamed."""
+    import ray_tpu
+
+    l_refs = [ray_tpu.put(b) for b in left if block_num_rows(b)]
+    r_refs = [ray_tpu.put(b) for b in right if block_num_rows(b)]
+    if not l_refs:
+        ray_tpu.free(r_refs)   # nothing to join; don't pin the right side
+        return
+    n_out = min(max(1, len(l_refs) + len(r_refs)), MAX_PARTITIONS)
+    spec = {"mode": "hash", "key": key}
+    l_cols = _fan_cols(l_refs, n_out, spec)
+    r_cols = _fan_cols(r_refs, n_out, spec) if r_refs \
+        else [[] for _ in range(n_out)]
+    join_fn = ray_tpu.remote(_join_partition)
+    out_refs = []
+    cols = []
+    for j in range(n_out):
+        cols.append(l_cols[j] + r_cols[j])
+        out_refs.append(join_fn.remote(key, join_type, suffix,
+                                       len(l_cols[j]), *cols[-1]))
+    first = True
+    for j in range(n_out):
+        out = ray_tpu.get(out_refs[j], timeout=600)
+        out = {k: np.array(v) for k, v in out.items()}
+        if first:
+            ray_tpu.free(l_refs + r_refs)
+            first = False
+        ray_tpu.free(cols[j] + [out_refs[j]])
+        if block_num_rows(out):
+            yield out

@@ -59,6 +59,7 @@ class _DeploymentState:
         self.pg_error: Optional[str] = None
         self.pg_error_at = 0.0
         self.pg_checked_at = 0.0
+        self.pg_gen = 0       # bumped on redeploy: stale creates discard
 
     def _initial_target(self) -> int:
         auto = self.spec.get("autoscaling_config")
@@ -126,11 +127,14 @@ class ServeController:
                     r.state = "STOPPING"
                 existing.version += 1
                 # a gang PG reflects the OLD spec's size/resources:
-                # release it and let the reconcile loop re-reserve
+                # release it and let the reconcile loop re-reserve. The
+                # generation bump makes any still-in-flight create for
+                # the old spec discard (and remove) its PG on completion
+                # instead of adopting it.
+                existing.pg_gen += 1
                 if existing.pg_id is not None:
                     asyncio.ensure_future(self._remove_pg(existing.pg_id))
                 existing.pg_id = None
-                existing.pg_creating = False
                 existing.pg_error = None
         # Deployments removed from the app spec are torn down.
         for old in self.apps.get(app_name, []):
@@ -336,6 +340,7 @@ class ServeController:
         resources in ONE placement group (all-or-nothing)."""
         from ray_tpu.runtime.ids import PlacementGroupID
         ctx = self._ctx()
+        gen = dep.pg_gen
         res = self._replica_resources(dep.spec)
         pg_id = PlacementGroupID.generate()
         try:
@@ -345,10 +350,10 @@ class ServeController:
                 strategy=str(dep.spec["gang"]),
                 name=f"serve_gang:{dep.name}", timeout=120.0)
             if r.get("ok"):
-                if dep.spec.get("_deleted") or \
+                if dep.spec.get("_deleted") or dep.pg_gen != gen or \
                         self.deployments.get(dep.name) is not dep:
-                    # deleted/replaced while reserving: don't leak the
-                    # committed bundles on an orphaned state object
+                    # deleted/redeployed while reserving: don't leak the
+                    # committed bundles on a stale reservation
                     await self._remove_pg(pg_id)
                 else:
                     dep.pg_id = pg_id

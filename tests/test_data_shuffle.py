@@ -95,3 +95,44 @@ def test_shuffle_spans_nodes(two_node):
     assert ds.count() == 10_000
     view = ray_tpu.cluster_resources()
     assert view.get("CPU", 0) == 4.0
+
+
+def test_join_inner_distributed(two_node):
+    import ray_tpu.data as rd
+    left = rd.from_items([{"k": i % 5, "a": i} for i in range(40)])
+    right = rd.from_items([{"k": k, "tag": f"t{k}"} for k in range(3)])
+    out = left.join(right, on="k").take_all()
+    # keys 0,1,2 match (8 left rows each); 3,4 dropped
+    assert len(out) == 24
+    assert all(r["tag"] == f"t{r['k']}" for r in out)
+    assert {r["k"] for r in out} == {0, 1, 2}
+
+
+def test_join_left_with_nulls(two_node):
+    import numpy as np
+    import ray_tpu.data as rd
+    left = rd.from_items([{"k": i, "a": i * 10} for i in range(4)])
+    right = rd.from_items([{"k": 1, "v": 1.5}, {"k": 3, "v": 3.5}])
+    out = sorted(left.join(right, on="k", join_type="left").take_all(),
+                 key=lambda r: r["k"])
+    assert len(out) == 4
+    assert out[1]["v"] == 1.5 and out[3]["v"] == 3.5
+    assert np.isnan(out[0]["v"]) and np.isnan(out[2]["v"])
+
+
+def test_join_duplicate_keys_cartesian(two_node):
+    import ray_tpu.data as rd
+    left = rd.from_items([{"k": 1, "a": i} for i in range(3)])
+    right = rd.from_items([{"k": 1, "b": j} for j in range(2)])
+    out = left.join(right, on="k").take_all()
+    assert len(out) == 6  # 3 x 2 per-key cartesian
+    assert {(r["a"], r["b"]) for r in out} == {
+        (a, b) for a in range(3) for b in range(2)}
+
+
+def test_join_column_collision_suffix(two_node):
+    import ray_tpu.data as rd
+    left = rd.from_items([{"k": 1, "x": 10}])
+    right = rd.from_items([{"k": 1, "x": 20}])
+    out = left.join(right, on="k").take_all()
+    assert out[0]["x"] == 10 and out[0]["x_r"] == 20
