@@ -139,9 +139,13 @@ def _get_or_create_controller():
         pass
     from ray_tpu.serve.controller import ServeController
     try:
+        # max_restarts: a crashed controller comes back and re-adopts its
+        # persisted app specs (reference: serve controller checkpoints to
+        # the GCS KV and recovers)
         h = core_api.remote(ServeController).options(
             name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE,
-            lifetime="detached", max_concurrency=32).remote()
+            lifetime="detached", max_concurrency=32,
+            max_restarts=100).remote()
     except Exception:
         h = core_api.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
     core_api.get(h.start.remote(), timeout=30)

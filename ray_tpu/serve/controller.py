@@ -95,10 +95,68 @@ class ServeController:
 
     # -- lifecycle ---------------------------------------------------------
 
+    APPS_KV_KEY = "serve:apps"
+
     async def start(self) -> bool:
         if self._loop_task is None:
+            # loop first, recovery second: _recover() re-enters
+            # deploy_app, whose _ensure_started must see the loop set
+            # (not recurse back into start)
             self._loop_task = asyncio.ensure_future(self._reconcile_loop())
+            await self._recover()
         return True
+
+    async def _ensure_started(self):
+        """Every RPC path self-starts the reconcile loop: after a
+        crash-restart, nobody calls start() again — the first routed
+        request (or deploy) triggers recovery."""
+        if self._loop_task is None:
+            await self.start()
+
+    async def _persist_apps(self):
+        import cloudpickle
+        ctx = self._ctx()
+        try:
+            payload = cloudpickle.dumps(
+                {app: [self.deployments[n].spec for n in names
+                       if n in self.deployments]
+                 for app, names in self.apps.items()}, protocol=5)
+            await ctx.pool.call(ctx.head_addr, "kv_put",
+                                key=self.APPS_KV_KEY, value=payload)
+        except Exception:
+            pass  # next mutation retries
+
+    async def _recover(self):
+        """Crash-restart: reload app specs from the control KV, reap any
+        orphaned replica actors from the previous incarnation (their
+        table is gone — clean slate), and redeploy."""
+        import cloudpickle
+        ctx = self._ctx()
+        try:
+            blob = await ctx.pool.call(ctx.head_addr, "kv_get",
+                                       key=self.APPS_KV_KEY)
+        except Exception:
+            return
+        if not blob:
+            return
+        try:
+            apps = cloudpickle.loads(blob)
+        except Exception:
+            return
+        try:
+            actors = await ctx.pool.call(ctx.head_addr, "list_actors")
+            for a in actors:
+                name = a.get("name") or ""
+                if name.startswith("SERVE_REPLICA:") and \
+                        a.get("state") not in ("DEAD",):
+                    await ctx.kill_actor(a["actor_id"], no_restart=True)
+        except Exception:
+            pass
+        for app_name, specs in apps.items():
+            for spec in specs:
+                spec.pop("_deleted", None)
+            if specs:
+                await self.deploy_app(app_name, specs, _persist=False)
 
     async def ping(self) -> str:
         return "ok"
@@ -106,7 +164,8 @@ class ServeController:
     # -- deploy API --------------------------------------------------------
 
     async def deploy_app(self, app_name: str,
-                         deployments: List[dict]) -> bool:
+                         deployments: List[dict],
+                         _persist: bool = True) -> bool:
         """deployments: list of specs {name, cls_payload, init_args,
         init_kwargs, num_replicas|autoscaling_config, max_ongoing_requests,
         route_prefix, actor_options, user_config}."""
@@ -144,12 +203,16 @@ class ServeController:
                 self.deployments[old].target = 0
                 self.deployments[old].spec["_deleted"] = True
         self.apps[app_name] = names
+        await self._ensure_started()
+        if _persist:
+            await self._persist_apps()
         return True
 
     async def list_apps(self) -> List[str]:
         return list(self.apps)
 
     async def delete_app(self, app_name: str) -> bool:
+        await self._ensure_started()
         for name in self.apps.pop(app_name, []):
             dep = self.deployments.get(name)
             if dep is not None:
@@ -157,6 +220,7 @@ class ServeController:
                 dep.spec["_deleted"] = True
                 for r in dep.replicas.values():
                     r.state = "STOPPING"
+        await self._persist_apps()
         return True
 
     async def wait_ready(self, app_name: str, timeout: float = 120.0) -> dict:
@@ -179,6 +243,7 @@ class ServeController:
     # -- routing -----------------------------------------------------------
 
     async def get_routing_table(self, deployment_name: str) -> dict:
+        await self._ensure_started()
         dep = self.deployments.get(deployment_name)
         if dep is None:
             return {"replicas": [], "version": -1, "model_ids": []}
