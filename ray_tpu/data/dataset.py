@@ -401,7 +401,10 @@ def _flat_map_rows(b: Block, fn) -> Block:
 def _filter_rows(b: Block, fn) -> Block:
     keep = np.asarray([bool(fn(r)) for r in block_rows(b)])
     if not keep.any():
-        return {}
+        # zero rows but KEEP the columns: schema must survive an
+        # all-filtered block (left joins emit right columns as nulls
+        # based on it)
+        return {c: np.asarray(v)[:0] for c, v in b.items()}
     return block_take(b, np.nonzero(keep)[0])
 
 
@@ -573,10 +576,14 @@ def _join_exec(stream: Iterator[Block], op: _Op) -> Iterator[Block]:
     # local fallback (no cluster): concat both sides, one in-driver join
     from ray_tpu.data.shuffle import join_blocks
     lblocks = [b for b in stream if block_num_rows(b)]
-    rblocks = [b for b in other if block_num_rows(b)]
+    rall = list(other)
+    rblocks = [b for b in rall if block_num_rows(b)]
+    # a zero-row right side still carries SCHEMA: left joins must emit
+    # its columns as nulls rather than silently change shape
+    rb = block_concat(rblocks) if rblocks else \
+        next((b for b in rall if len(b) > 0), None)
     out = join_blocks(block_concat(lblocks) if lblocks else None,
-                      block_concat(rblocks) if rblocks else None,
-                      key, jt, suffix)
+                      rb, key, jt, suffix)
     if block_num_rows(out):
         yield out
 

@@ -202,7 +202,10 @@ def join_blocks(lb: Optional[Block], rb: Optional[Block], key: str,
                 join_type: str, suffix: str) -> Block:
     """Join two (already co-partitioned) blocks on `key`. inner / left;
     left-join fills missing right numerics with NaN and everything else
-    with None (object dtype)."""
+    with None (object dtype). NOTE: when any left row is unmatched, right
+    int/uint/bool columns are promoted to float64 so NaN can represent
+    the nulls (numpy has no nullable ints) — same promotion pandas
+    applies on a left merge."""
     if lb is None or not block_num_rows(lb):
         return {}
     # a right block with columns but zero rows still contributes SCHEMA:
@@ -254,9 +257,13 @@ def join_blocks(lb: Optional[Block], rb: Optional[Block], key: str,
 
 
 def _join_partition(key: str, join_type: str, suffix: str, n_left: int,
+                    r_schema: Optional[Dict[str, Any]],
                     *parts: Block) -> Block:
     """One output partition: concat this partition's left and right
-    sub-blocks, join them. Runs inside a worker task."""
+    sub-blocks, join them. Runs inside a worker task. `r_schema`
+    ({col: dtype}) is the right side's schema, threaded through so a
+    left join emits the right columns (as nulls) even in partitions —
+    or whole joins — where the right side has no rows at all."""
     left = [p for p in parts[:n_left] if block_num_rows(p)]
     # keep zero-row right parts: they carry the right-side SCHEMA, which
     # a left join needs to emit null columns in right-empty partitions
@@ -265,6 +272,8 @@ def _join_partition(key: str, join_type: str, suffix: str, n_left: int,
     lb = block_concat(left) if left else None
     rb = block_concat(nonempty_r) if nonempty_r else (
         right[0] if right else None)
+    if rb is None and r_schema:
+        rb = {c: np.empty(0, dtype=dt) for c, dt in r_schema.items()}
     return join_blocks(lb, rb, key, join_type, suffix)
 
 
@@ -276,7 +285,13 @@ def distributed_join(left: Iterator[Block], right: Iterator[Block],
     import ray_tpu
 
     l_refs = [ray_tpu.put(b) for b in left if block_num_rows(b)]
-    r_refs = [ray_tpu.put(b) for b in right if block_num_rows(b)]
+    r_refs = []
+    r_schema = None     # first right block's {col: dtype}, rows or not
+    for b in right:
+        if r_schema is None and len(b) > 0:
+            r_schema = {c: np.asarray(v).dtype for c, v in b.items()}
+        if block_num_rows(b):
+            r_refs.append(ray_tpu.put(b))
     if not l_refs:
         ray_tpu.free(r_refs)   # nothing to join; don't pin the right side
         return
@@ -291,7 +306,8 @@ def distributed_join(left: Iterator[Block], right: Iterator[Block],
     for j in range(n_out):
         cols.append(l_cols[j] + r_cols[j])
         out_refs.append(join_fn.remote(key, join_type, suffix,
-                                       len(l_cols[j]), *cols[-1]))
+                                       len(l_cols[j]), r_schema,
+                                       *cols[-1]))
     first = True
     for j in range(n_out):
         out = ray_tpu.get(out_refs[j], timeout=600)

@@ -53,10 +53,14 @@ class _Lease:
     bundle_index: Optional[int] = None
     acked: bool = False                      # client confirmed receipt
     granted_at: float = field(default_factory=time.monotonic)
-    # COUNT of tasks on this lease parked in get()/wait(): pipelined
-    # tasks share one lease, so two may block concurrently; resources
-    # release on 0->1 and re-acquire on 1->0
-    blocked: int = 0
+    # Tokens of blocking get()/wait() episodes parked on this lease:
+    # pipelined tasks share one lease, so two may block concurrently;
+    # resources release on empty->nonempty and re-acquire on
+    # nonempty->empty. A SET (not a counter) so that RPC retries of
+    # worker_blocked/worker_unblocked are idempotent — the ConnectionPool
+    # retries on timeout, and a double-applied counter mutation would
+    # leave the node's resources permanently inflated.
+    blocked: set = field(default_factory=set)
 
 
 class NodeAgent:
@@ -166,9 +170,16 @@ class NodeAgent:
             tl = await self.node_timeline()
             self._events_archived = True
             if tl["events"]:
-                await self.pool.call(
-                    self.head_addr, "report_node_events",
-                    events=tl["events"], timeout=5.0)
+                try:
+                    await self.pool.call(
+                        self.head_addr, "report_node_events",
+                        events=tl["events"], timeout=5.0)
+                except Exception:
+                    # head didn't ack (briefly down?): keep serving the
+                    # local buffers so the spans aren't silently dropped
+                    # from future collect_timeline calls — a possible
+                    # applied-but-unacked duplicate beats losing them
+                    self._events_archived = False
         except Exception:
             pass
         if self._hb_task:
@@ -757,7 +768,7 @@ class NodeAgent:
         lease = self.leases.pop(lease_id, None)
         if lease is None:
             return {"ok": False}
-        if lease.blocked == 0:  # blocked leases already gave back resources
+        if not lease.blocked:  # blocked leases already gave back resources
             self._release_res(lease.resources, lease.pg_id,
                               lease.bundle_index)
         w = lease.worker
@@ -767,28 +778,34 @@ class NodeAgent:
         self._drain_queue()
         return {"ok": True}
 
-    async def worker_blocked(self, worker_id: WorkerID):
+    async def worker_blocked(self, worker_id: WorkerID, token: str = ""):
         """The worker is parked in a blocking get()/wait() inside its
         task: release the lease's resources so the tasks it is waiting ON
         can take leases here — without this, a parent task on a saturated
         node deadlocks against its own children (the reference releases a
         blocked worker's CPU the same way, raylet/node_manager.cc
-        HandleWorkerBlocked)."""
+        HandleWorkerBlocked). `token` identifies one blocking episode so
+        that RPC-level retries are idempotent (re-adding a present token
+        is a no-op)."""
         for lease in self.leases.values():
             if lease.worker.worker_id == worker_id:
-                lease.blocked += 1
-                if lease.blocked == 1:
+                if token in lease.blocked:  # retried RPC — already applied
+                    return {"ok": True}
+                was_empty = not lease.blocked
+                lease.blocked.add(token)
+                if was_empty:
                     self._release_res(lease.resources, lease.pg_id,
                                       lease.bundle_index)
                     self._drain_queue()
                 return {"ok": True}
         return {"ok": False}
 
-    async def worker_unblocked(self, worker_id: WorkerID):
+    async def worker_unblocked(self, worker_id: WorkerID, token: str = ""):
         for lease in self.leases.values():
-            if lease.worker.worker_id == worker_id and lease.blocked > 0:
-                lease.blocked -= 1
-                if lease.blocked == 0 and not self._try_acquire(
+            if lease.worker.worker_id == worker_id \
+                    and token in lease.blocked:
+                lease.blocked.discard(token)
+                if not lease.blocked and not self._try_acquire(
                         lease.resources, lease.pg_id, lease.bundle_index):
                     # the freed capacity went to children while we were
                     # blocked: run temporarily oversubscribed (available
@@ -798,6 +815,11 @@ class NodeAgent:
                     for k, v in lease.resources.items():
                         pool[k] = pool.get(k, 0.0) - v
                 return {"ok": True}
+        # Unknown token: either the matching worker_blocked never applied
+        # (request lost before reaching us) or the lease already released.
+        # Both are safe no-ops — callers send unblock unconditionally after
+        # an *attempted* block precisely so an applied-but-unacked block
+        # can't leak.
         return {"ok": False}
 
     def _drain_queue(self):

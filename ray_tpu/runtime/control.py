@@ -188,10 +188,43 @@ class ControlService:
     def _persist(self, table: str, key, value) -> None:
         if self._store is not None:
             self._store.put(table, key, value)
+            self._maybe_compact(table)
 
     def _persist_del(self, table: str, key) -> None:
         if self._store is not None:
             self._store.delete(table, key)
+            self._maybe_compact(table)
+
+    def _live_table(self, table: str):
+        """The authoritative in-memory state for a persisted table, used
+        to rewrite its log during online compaction."""
+        if table == "kv":
+            return self.kv
+        if table == "actors":
+            return self.actors
+        if table == "jobs":
+            return self.jobs
+        if table == "submitted_jobs":
+            return self.submitted_jobs
+        if table == "pgs":
+            # REMOVED pgs stay in self.pgs for status queries but have a
+            # "del" record in the log — compacting them back in as "put"s
+            # would resurrect them across a restart
+            return {pid: info for pid, info in self.pgs.items()
+                    if getattr(info, "state", None) != "REMOVED"}
+        if table == "drained":
+            return {nid: True for nid in self._drained}
+        return None
+
+    def _maybe_compact(self, table: str) -> None:
+        """Online compaction: rewrite a log that outgrew its live state
+        by FileStore.COMPACT_GROWTH_FACTOR (without this, logs only
+        compact on restart and grow unboundedly in long-lived clusters)."""
+        if not self._store.should_compact(table):
+            return
+        state = self._live_table(table)
+        if state is not None:
+            self._store.compact(table, state)
 
     def _persist_actor(self, a: ActorInfo) -> None:
         self._persist("actors", a.actor_id, a)
@@ -378,6 +411,8 @@ class ControlService:
             for n in list(self.nodes.values()):
                 if n.alive and now - n.last_heartbeat > threshold:
                     await self._mark_node_dead(n.node_id, "heartbeat timeout")
+            if self._store is not None:
+                self._store.flush()   # bound the fsync-batching window
 
     async def _mark_node_dead(self, node_id: NodeID, reason: str):
         n = self.nodes.get(node_id)

@@ -19,6 +19,7 @@ scoped here to owner-resident metadata.
 from __future__ import annotations
 
 import asyncio
+import os as _os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -582,11 +583,16 @@ class CoreContext:
                 return False
         return True
 
-    async def _notify_block_state(self, method: str) -> bool:
+    async def _notify_block_state(self, method: str, token: str) -> bool:
         """Tell the local agent this worker is entering/leaving a blocking
         get/wait inside a task, so the lease's resources free up for the
         children it waits on (reference: blocked workers release their
-        CPU, raylet HandleWorkerBlocked)."""
+        CPU, raylet HandleWorkerBlocked). `token` names this blocking
+        episode: the agent tracks blocked state as a token set, so
+        retried or duplicated RPCs are idempotent, and the caller sends
+        worker_unblocked whenever it *attempted* worker_blocked (even on
+        an error/timeout reply) so an applied-but-unacked block can't
+        inflate the node's resources forever."""
         import os
         wid = os.environ.get("RAY_TPU_WORKER_ID")
         if not wid:
@@ -594,7 +600,8 @@ class CoreContext:
         try:
             r = await self.pool.call(
                 self.agent_addr, method,
-                worker_id=WorkerID.from_hex(wid), timeout=5.0)
+                worker_id=WorkerID.from_hex(wid), token=token,
+                timeout=5.0)
             return bool(r.get("ok"))
         except Exception:
             return False
@@ -605,12 +612,13 @@ class CoreContext:
         if single:
             refs = [refs]
         from ray_tpu.util import tracing
-        blocked = False
         if not in_task and not self.is_driver \
                 and tracing.current_span.get():
             in_task = True  # async actor methods run in exec context
+        block_token = None
         if in_task and not self._refs_locally_ready(refs):
-            blocked = await self._notify_block_state("worker_blocked")
+            block_token = _os.urandom(8).hex()
+            await self._notify_block_state("worker_blocked", block_token)
         try:
             # The outer wait_for bounds the WHOLE path — resolve, pull,
             # and any lineage recovery — by the caller's budget.
@@ -623,8 +631,11 @@ class CoreContext:
         except asyncio.TimeoutError:
             raise GetTimeoutError(f"get() timed out after {timeout}s")
         finally:
-            if blocked:
-                await self._notify_block_state("worker_unblocked")
+            if block_token is not None:
+                # unconditional: the block may have applied even if its
+                # reply was lost; unknown tokens are a no-op agent-side
+                await self._notify_block_state(
+                    "worker_unblocked", block_token)
         return values[0] if single else values
 
     async def _get_one(self, ref: ObjectRef, timeout: Optional[float]):
@@ -830,14 +841,15 @@ class CoreContext:
         raylet/wait_manager.h parks waiters on object-ready callbacks)."""
         refs = list(refs)
         num_returns = min(num_returns, len(refs))
-        blocked = False
+        block_token = None
         if in_task and sum(
                 1 for r in refs
                 if (e := self.store.get_entry(r.oid)) is not None
                 and e.status != PENDING) < num_returns:
             # same deadlock-avoidance as get(): a task parked in wait()
             # must give its lease's resources back to its children
-            blocked = await self._notify_block_state("worker_blocked")
+            block_token = _os.urandom(8).hex()
+            await self._notify_block_state("worker_blocked", block_token)
         tasks: Dict[asyncio.Task, ObjectRef] = {
             asyncio.ensure_future(self._await_ready(r)): r for r in refs}
         deadline = (time.monotonic() + timeout) if timeout is not None else None
@@ -859,8 +871,9 @@ class CoreContext:
         finally:
             for t in tasks:
                 t.cancel()
-            if blocked:
-                await self._notify_block_state("worker_unblocked")
+            if block_token is not None:
+                await self._notify_block_state(
+                    "worker_unblocked", block_token)
         # Exactly num_returns in `ready` even when more resolved in the
         # same wakeup — callers rely on the reference's contract that
         # len(ready) <= num_returns; surplus completions stay "pending"

@@ -81,6 +81,11 @@ class ServeController:
         self.apps: Dict[str, List[str]] = {}       # app -> deployment names
         self._loop_task: Optional[asyncio.Task] = None
         self._proxy_started = False
+        # while recovering, reconcile must not start replacement replicas
+        # for deployments whose survivors are about to be adopted
+        self._recovering = False
+        self._creating: set = set()    # replica names mid-create_actor
+        self._last_orphan_sweep = 0.0
 
     # -- internal async cluster ops ---------------------------------------
 
@@ -127,36 +132,91 @@ class ServeController:
             pass  # next mutation retries
 
     async def _recover(self):
-        """Crash-restart: reload app specs from the control KV, reap any
-        orphaned replica actors from the previous incarnation (their
-        table is gone — clean slate), and redeploy."""
+        """Crash-restart: reload app specs from the control KV, redeploy,
+        and RE-ADOPT live replica actors from the previous incarnation
+        instead of restarting them — a controller crash must not cause a
+        serving outage (reference: serve controller recovers running
+        replicas from its checkpoint, deployment_state.py
+        _recover_from_checkpoint). Replicas whose deployment no longer
+        exists are killed as orphans. Gang deployments are the
+        exception: bundle assignments aren't recoverable from the actor
+        table, and the gang is all-or-nothing, so those replicas are
+        restarted on a fresh reservation."""
         import cloudpickle
         ctx = self._ctx()
+        self._recovering = True
         try:
-            blob = await ctx.pool.call(ctx.head_addr, "kv_get",
-                                       key=self.APPS_KV_KEY)
-        except Exception:
-            return
-        if not blob:
-            return
-        try:
-            apps = cloudpickle.loads(blob)
-        except Exception:
-            return
-        try:
-            actors = await ctx.pool.call(ctx.head_addr, "list_actors")
-            for a in actors:
+            # Bounded retries: recovery often runs in the same disruption
+            # window that crashed the controller (head briefly
+            # unreachable); one transient RPC failure must not leave the
+            # controller permanently amnesiac about its replicas.
+            blob = None
+            actors = None
+            for attempt in range(5):
+                try:
+                    if blob is None:
+                        blob = await ctx.pool.call(
+                            ctx.head_addr, "kv_get", key=self.APPS_KV_KEY)
+                        if not blob:
+                            return      # genuinely nothing deployed
+                    actors = await ctx.pool.call(ctx.head_addr,
+                                                 "list_actors")
+                    break
+                except Exception:
+                    if attempt == 4:
+                        if blob is None:
+                            return
+                        actors = []     # adopt nothing; reconcile heals
+                    else:
+                        await asyncio.sleep(0.5 * (attempt + 1))
+            try:
+                apps = cloudpickle.loads(blob)
+            except Exception:
+                return
+            # name -> (rid, actor_id) of live replicas left behind
+            survivors: Dict[str, List] = {}
+            for a in actors or []:
                 name = a.get("name") or ""
                 if name.startswith("SERVE_REPLICA:") and \
                         a.get("state") not in ("DEAD",):
-                    await ctx.kill_actor(a["actor_id"], no_restart=True)
-        except Exception:
-            pass
-        for app_name, specs in apps.items():
-            for spec in specs:
-                spec.pop("_deleted", None)
-            if specs:
-                await self.deploy_app(app_name, specs, _persist=False)
+                    _, dep_name, rid = name.split(":", 2)
+                    survivors.setdefault(dep_name, []).append(
+                        (rid, a["actor_id"], name))
+            # The previous incarnation's gang PGs are orphans: the fresh
+            # deployment states start with pg_id=None and re-reserve, so
+            # an unremoved old PG would hold its committed bundles
+            # forever (and starve the new reservation on a tight
+            # cluster). Remove them all; reconcile re-creates as needed.
+            try:
+                for pg in await ctx.pool.call(ctx.head_addr, "list_pgs"):
+                    nm = pg.get("name") or ""
+                    if nm.startswith("serve_gang:") and \
+                            pg.get("state") != "REMOVED":
+                        await self._remove_pg(pg["pg_id"])
+            except Exception:
+                pass
+            for app_name, specs in apps.items():
+                for spec in specs:
+                    spec.pop("_deleted", None)
+                if specs:
+                    await self.deploy_app(app_name, specs, _persist=False)
+            for dep_name, infos in survivors.items():
+                dep = self.deployments.get(dep_name)
+                adopt = dep is not None and not dep.spec.get("gang")
+                for rid, actor_id, name in infos:
+                    if adopt:
+                        info = _ReplicaInfo(actor_id, name)
+                        # STARTING: the next reconcile's ping promotes a
+                        # healthy survivor to RUNNING; a dead one is
+                        # reaped by the 120s STARTING timeout
+                        dep.replicas[rid] = info
+                    else:
+                        try:
+                            await ctx.kill_actor(actor_id, no_restart=True)
+                        except Exception:
+                            pass
+        finally:
+            self._recovering = False
 
     async def ping(self) -> str:
         return "ok"
@@ -306,7 +366,41 @@ class ServeController:
                 traceback.print_exc()
             await asyncio.sleep(RECONCILE_INTERVAL_S)
 
+    ORPHAN_SWEEP_INTERVAL_S = 10.0
+
+    async def _sweep_orphans(self):
+        """Kill SERVE_REPLICA actors no deployment tracks (left behind
+        when recovery couldn't adopt, or by a crashed deploy path).
+        Belt-and-braces: detached replicas otherwise leak forever."""
+        try:
+            ctx = self._ctx()
+            actors = await ctx.pool.call(ctx.head_addr, "list_actors")
+        except Exception:
+            return
+        for a in actors:
+            name = a.get("name") or ""
+            if not name.startswith("SERVE_REPLICA:") or \
+                    a.get("state") in ("DEAD",):
+                continue
+            if name in self._creating:   # registration still in flight
+                continue
+            _, dep_name, rid = name.split(":", 2)
+            dep = self.deployments.get(dep_name)
+            if dep is None or rid not in dep.replicas:
+                try:
+                    await self._ctx().kill_actor(a["actor_id"],
+                                                 no_restart=True)
+                except Exception:
+                    pass
+
     async def _reconcile_once(self):
+        if self._recovering:
+            return
+        now = time.time()
+        if now - getattr(self, "_last_orphan_sweep", 0.0) > \
+                self.ORPHAN_SWEEP_INTERVAL_S:
+            self._last_orphan_sweep = now
+            await self._sweep_orphans()
         for name in list(self.deployments):
             dep = self.deployments[name]
             await self._autoscale(dep)
@@ -469,6 +563,7 @@ class ServeController:
                 return  # every gang slot is occupied
             bundle_index = free[0]
             pg = (dep.pg_id, bundle_index)
+        self._creating.add(name)
         try:
             actor_id = await self._ctx().create_actor(
                 Replica,
@@ -482,11 +577,13 @@ class ServeController:
                 pg=pg,
                 max_concurrency=int(spec.get("max_ongoing_requests", 16)),
                 lifetime="detached")
+            info = _ReplicaInfo(actor_id, name)
+            info.bundle_index = bundle_index
+            dep.replicas[rid] = info
         except Exception:
             return
-        info = _ReplicaInfo(actor_id, name)
-        info.bundle_index = bundle_index
-        dep.replicas[rid] = info
+        finally:
+            self._creating.discard(name)
 
     # -- autoscaling -------------------------------------------------------
 

@@ -1,0 +1,137 @@
+"""Round-3 verify drive A: blocked-lease accounting, serve controller
+re-adoption, persisted-control restart, left-join schema — all through
+the public API (not pytest)."""
+import os
+import sys
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def drive_blocking():
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def leaf(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def root():
+            return sum(ray_tpu.get([leaf.remote(i) for i in range(4)],
+                                   timeout=60))
+
+        assert ray_tpu.get(root.remote(), timeout=90) == 12
+        deadline = time.monotonic() + 15
+        cpu = None
+        while time.monotonic() < deadline:
+            n = [x for x in ray_tpu.nodes() if x["alive"]][0]
+            cpu = n["resources_available"].get("CPU")
+            if cpu == 1.0:
+                break
+            time.sleep(0.2)
+        assert cpu == 1.0, f"CPU accounting drifted: {cpu}"
+        print("blocking: OK (nested get on 1 CPU, accounting restored)")
+    finally:
+        ray_tpu.shutdown()
+
+
+def drive_serve_readopt():
+    from ray_tpu import serve
+    from ray_tpu.util import state
+    ray_tpu.init(num_cpus=8)
+    try:
+        @serve.deployment(num_replicas=2)
+        class Echo:
+            def __call__(self, v=None):
+                return f"e:{v}"
+
+        h = serve.run(Echo.bind(), name="vapp", route_prefix=None)
+        assert ray_tpu.get(h.remote(1), timeout=30) == "e:1"
+        before = {a["actor_id"] for a in state.list_actors()
+                  if (a.get("name") or "").startswith("SERVE_REPLICA:Echo:")
+                  and a["state"] == "ALIVE"}
+        ctrl = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+        ray_tpu.kill(ctrl, no_restart=False)
+        ok = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if ray_tpu.get(h.remote(2), timeout=10) == "e:2":
+                    ok = True
+                    break
+            except Exception:
+                time.sleep(0.5)
+        assert ok, "serve did not recover"
+        after = {a["actor_id"] for a in state.list_actors()
+                 if (a.get("name") or "").startswith("SERVE_REPLICA:Echo:")
+                 and a["state"] == "ALIVE"}
+        assert after == before, f"replicas churned: {before} -> {after}"
+        print("serve: OK (controller crash -> same replicas adopted)")
+    finally:
+        ray_tpu.shutdown()
+
+
+def drive_control_restart(tmp):
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.config import Config
+    cfg = Config.from_env(num_workers_prestart=0,
+                          health_check_period_s=0.2,
+                          control_persist_dir=tmp)
+    c = Cluster(cfg)
+    c.add_node(num_cpus=2)
+    try:
+        ray_tpu.init(address=c.address, num_cpus=0, config=cfg)
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        a = Counter.options(name="vc", lifetime="detached").remote()
+        assert ray_tpu.get(a.inc.remote(), timeout=30) == 1
+        c.restart_head()
+        time.sleep(2.0)
+        a2 = ray_tpu.get_actor("vc")
+        assert ray_tpu.get(a2.inc.remote(), timeout=60) == 2
+        # persisted logs exist and were fsynced/compacted sanely
+        logs = [f for f in os.listdir(tmp) if f.endswith(".log")]
+        assert logs, "no persisted table logs written"
+        print(f"restart: OK (named actor survived; logs={sorted(logs)})")
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def drive_join_schema():
+    import ray_tpu.data as rd
+    ray_tpu.init(num_cpus=4)
+    try:
+        left = rd.from_items([{"k": i, "a": i} for i in range(6)])
+        right = rd.from_items([{"k": 0, "v": 5}]).filter(lambda r: False)
+        out = left.join(right, on="k", join_type="left").take_all()
+        assert len(out) == 6 and all("v" in r and np.isnan(r["v"])
+                                     for r in out), out[:2]
+        # populated case unchanged
+        right2 = rd.from_items([{"k": 2, "v": 9}])
+        out2 = {r["k"]: r["v"] for r in
+                left.join(right2, on="k", join_type="left").take_all()}
+        assert out2[2] == 9 and np.isnan(out2[0])
+        print("join: OK (empty-right left join keeps schema)")
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    import tempfile
+    drive_blocking()
+    drive_serve_readopt()
+    with tempfile.TemporaryDirectory() as tmp:
+        drive_control_restart(tmp)
+    drive_join_schema()
+    print("VERIFY-A: ALL OK")

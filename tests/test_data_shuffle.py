@@ -136,3 +136,20 @@ def test_join_column_collision_suffix(two_node):
     right = rd.from_items([{"k": 1, "x": 20}])
     out = left.join(right, on="k").take_all()
     assert out[0]["x"] == 10 and out[0]["x_r"] == 20
+
+
+def test_join_left_empty_right_keeps_schema(two_node):
+    """A left join against an entirely row-less right side still emits
+    the right-side columns as nulls — the output schema must not depend
+    on whether the right side happened to have rows (round-2 advisor
+    finding). Int right columns promote to float64 NaN, as documented."""
+    import numpy as np
+    import ray_tpu.data as rd
+    left = rd.from_items([{"k": i, "a": i * 10} for i in range(3)])
+    right = rd.from_items([{"k": 9, "v": 7}]).filter(lambda r: False)
+    out = sorted(left.join(right, on="k", join_type="left").take_all(),
+                 key=lambda r: r["k"])
+    assert len(out) == 3
+    for r in out:
+        assert "v" in r, f"right column dropped from schema: {r}"
+        assert np.isnan(r["v"])

@@ -92,3 +92,52 @@ def test_nested_wait_on_saturated_node():
         assert ray_tpu.get(root.remote(), timeout=90) == 3
     finally:
         ray_tpu.shutdown()
+
+
+def test_block_rpc_idempotent_under_retries():
+    """worker_blocked/worker_unblocked are retried by the ConnectionPool
+    on timeouts; the agent tracks blocked episodes as a TOKEN SET so a
+    duplicated (retried) RPC cannot double-release or leak the lease's
+    resources (round-2 advisor finding: a counter double-incremented
+    under retry left the node permanently oversubscribed)."""
+    import asyncio
+
+    from ray_tpu.runtime.agent import NodeAgent, _Lease
+    from ray_tpu.runtime.ids import WorkerID
+
+    agent = NodeAgent.__new__(NodeAgent)   # no loop/IO — unit-test state
+    wid = WorkerID.generate()
+
+    class _W:
+        worker_id = wid
+        state = None
+
+    released, acquired = [], []
+    agent.leases = {"L": _Lease(lease_id="L", worker=_W(),
+                               resources={"CPU": 1.0})}
+    agent._release_res = lambda res, pg, bi: released.append(dict(res))
+    agent._try_acquire = lambda res, pg, bi: (acquired.append(dict(res)),
+                                              True)[1]
+    agent._drain_queue = lambda: None
+
+    async def run():
+        # duplicated block (same token) releases exactly once
+        assert (await agent.worker_blocked(wid, "tokA"))["ok"]
+        assert (await agent.worker_blocked(wid, "tokA"))["ok"]
+        assert len(released) == 1
+        # a second concurrent episode doesn't re-release
+        assert (await agent.worker_blocked(wid, "tokB"))["ok"]
+        assert len(released) == 1
+        # duplicated unblock of one episode re-acquires nothing while
+        # the other episode is still parked
+        assert (await agent.worker_unblocked(wid, "tokA"))["ok"]
+        assert not (await agent.worker_unblocked(wid, "tokA"))["ok"]
+        assert len(acquired) == 0
+        # last episode ends -> exactly one re-acquire
+        assert (await agent.worker_unblocked(wid, "tokB"))["ok"]
+        assert len(acquired) == 1
+        # unknown token (block never applied / lease gone): safe no-op
+        assert not (await agent.worker_unblocked(wid, "ghost"))["ok"]
+        assert len(acquired) == 1 and len(released) == 1
+
+    asyncio.run(run())

@@ -234,14 +234,25 @@ def test_upgrade_replaces_replicas(cluster):
         pytest.fail("upgrade never took effect")
 
 
+def _live_replica_ids(dep_name):
+    from ray_tpu.util import state
+    return {a["actor_id"] for a in state.list_actors()
+            if (a.get("name") or "").startswith(f"SERVE_REPLICA:{dep_name}:")
+            and a.get("state") == "ALIVE"}
+
+
 def test_controller_crash_recovery(cluster):
     """The serve control plane survives its controller crashing: app
-    specs persist in the control KV, the restarted controller reaps
-    orphan replicas and redeploys (reference: serve controller
-    checkpoint/recovery)."""
+    specs persist in the control KV, and the restarted controller
+    RE-ADOPTS the live replicas instead of restarting them — a control
+    plane crash must not be a data-plane outage (reference: serve
+    controller checkpoint/recovery, deployment_state.py
+    _recover_from_checkpoint)."""
     h = serve.run(Echo.options(name="EchoFT").bind("ft"), name="app_ft",
                   route_prefix=None)
     assert ray_tpu.get(h.remote(1), timeout=30) == "ft:1"
+    before = _live_replica_ids("EchoFT")
+    assert len(before) == 2
     ctrl = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
     ray_tpu.kill(ctrl, no_restart=False)       # crash + auto-restart
     # the restarted controller recovers the app; routing resumes
@@ -255,3 +266,7 @@ def test_controller_crash_recovery(cluster):
         except Exception:
             time.sleep(0.5)
     assert ok, "serve never recovered after controller crash"
+    # the surviving replicas were adopted, not killed-and-replaced
+    after = _live_replica_ids("EchoFT")
+    assert after == before, \
+        f"controller restart churned replicas: {before} -> {after}"
