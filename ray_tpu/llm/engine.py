@@ -36,6 +36,11 @@ class _Request:
     max_new_tokens: int
     temperature: float
     eos_id: Optional[int]
+    top_p: float = 1.0                      # 1.0 = disabled
+    top_k: int = 0                          # 0 = disabled
+    # stop sequences (token-id lists); on a suffix match generation
+    # ends and the matched suffix is trimmed from the result
+    stop: Optional[List[List[int]]] = None
     out: List[int] = field(default_factory=list)
     fut: Optional[asyncio.Future] = None
     stream: Optional[asyncio.Queue] = None
@@ -52,9 +57,22 @@ class LLMEngine:
                  prefill_buckets: Sequence[int] = (64, 128, 256, 512),
                  cache_dtype="bfloat16", seed: int = 0,
                  steps_per_sync: int = 8,
+                 mesh=None, tensor_axis: str = "tensor",
                  detokenize: Optional[Callable[[List[int]], str]] = None):
+        """With ``mesh``, the engine runs TENSOR-PARALLEL: params shard
+        per lm.serve_param_specs (Megatron layout), the KV cache shards
+        its kv-head dim, and every prefill/decode jit runs SPMD over the
+        mesh with GSPMD inserting the two psums per layer. This is how a
+        model larger than one chip's HBM serves (reference:
+        llm/_internal/serve/configs/llm_config.py:181-186
+        tensor_parallel_size + placement bundles per replica)."""
         import jax.numpy as jnp
         self.cfg = cfg
+        self.mesh = mesh
+        self.tensor_axis = tensor_axis
+        if mesh is not None:
+            params = lm.shard_params_for_serving(params, mesh, cfg,
+                                                 tensor_axis)
         self.params = params
         self.max_slots = max_slots
         self.max_len = max_len
@@ -63,7 +81,8 @@ class LLMEngine:
         self.detokenize = detokenize
         import jax
         self._cache = lm.init_cache(cfg, max_slots, max_len,
-                                    dtype=jnp.dtype(cache_dtype))
+                                    dtype=jnp.dtype(cache_dtype),
+                                    mesh=mesh, axis=tensor_axis)
         self._slots: List[Optional[_Request]] = [None] * max_slots
         self._waiting: "asyncio.Queue[_Request]" = asyncio.Queue()
         self._rng = np.random.default_rng(seed)
@@ -84,13 +103,19 @@ class LLMEngine:
                        max_new_tokens: int = 64,
                        temperature: float = 0.0,
                        eos_id: Optional[int] = None,
+                       top_p: float = 1.0, top_k: int = 0,
+                       stop: Optional[Sequence[Sequence[int]]] = None,
                        prefilled: Optional[dict] = None) -> dict:
         """``prefilled`` skips the in-engine prompt forward pass: it is
         the KV payload a remote PrefillEngine computed for these tokens
         (prefill/decode disaggregation, ray_tpu/llm/pd.py; reference:
         llm/_internal/serve/serving_patterns/prefill_decode/, KV moved
-        via NIXL there, via the object plane here)."""
+        via NIXL there, via the object plane here). ``top_p``/``top_k``
+        filter the on-device sampler (1.0/0 disable); ``stop`` is a list
+        of token-id sequences that end generation (matched suffix
+        trimmed from the result)."""
         r = self._submit(tokens, max_new_tokens, temperature, eos_id,
+                         top_p=top_p, top_k=top_k, stop=stop,
                          prefilled=prefilled)
         r.fut = asyncio.get_running_loop().create_future()
         await r.fut
@@ -100,9 +125,16 @@ class LLMEngine:
                               max_new_tokens: int = 64,
                               temperature: float = 0.0,
                               eos_id: Optional[int] = None,
+                              top_p: float = 1.0, top_k: int = 0,
+                              stop: Optional[Sequence[Sequence[int]]] = None,
                               prefilled: Optional[dict] = None):
-        """Async generator of token ids as they are produced."""
+        """Async generator of token ids as they are produced. NOTE:
+        tokens belonging to a stop sequence may already have been
+        yielded by the time the match completes — streaming consumers
+        that care should trim client-side (the non-streaming result is
+        always trimmed)."""
         r = self._submit(tokens, max_new_tokens, temperature, eos_id,
+                         top_p=top_p, top_k=top_k, stop=stop,
                          prefilled=prefilled)
         r.stream = asyncio.Queue()
         while True:
@@ -121,7 +153,7 @@ class LLMEngine:
         return self.generate_stream(tokens, prefilled=prefilled, **kw)
 
     def _submit(self, tokens, max_new_tokens, temperature, eos_id,
-                prefilled=None):
+                top_p=1.0, top_k=0, stop=None, prefilled=None):
         if self._stopped:
             raise RuntimeError("engine is stopped")
         tokens = list(map(int, tokens))
@@ -129,14 +161,19 @@ class LLMEngine:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if len(tokens) > self.buckets[-1]:
-            raise ValueError(
-                f"prompt of {len(tokens)} tokens exceeds the largest "
-                f"prefill bucket {self.buckets[-1]}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        # prompts longer than the largest bucket stream through chunked
+        # prefill (lm.prefill_chunk); only max_len bounds them
         if len(tokens) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt+generation ({len(tokens)}+{max_new_tokens}) "
                 f"exceeds max_len {self.max_len}")
+        stop = [list(map(int, s)) for s in stop] if stop else None
+        if stop and any(not s for s in stop):
+            raise ValueError("empty stop sequence")
         if prefilled is not None:
             # validate at submission: a malformed payload must fail THIS
             # request, not blow up the shared scheduler loop mid-admit
@@ -153,6 +190,7 @@ class LLMEngine:
                     f"positions > decode max_len {self.max_len} "
                     "(prefill/decode bucket configs disagree)")
         r = _Request(tokens, max_new_tokens, temperature, eos_id,
+                     top_p=float(top_p), top_k=int(top_k), stop=stop,
                      prefilled=prefilled)
         self._waiting.put_nowait(r)
         self.stats["requests"] += 1
@@ -226,11 +264,16 @@ class LLMEngine:
                 block = 1 << (max(1, block).bit_length() - 1)  # pow2 dn
                 tokens = np.zeros((self.max_slots,), np.int32)
                 temps = np.zeros((self.max_slots,), np.float32)
+                top_ps = np.ones((self.max_slots,), np.float32)
+                top_ks = np.zeros((self.max_slots,), np.int32)
                 for i in active:
                     tokens[i] = self._slots[i].out[-1]
                     temps[i] = self._slots[i].temperature
+                    top_ps[i] = self._slots[i].top_p
+                    top_ks[i] = self._slots[i].top_k
                 out = await loop.run_in_executor(
-                    None, self._decode_sync, tokens, temps, block)
+                    None, self._decode_sync, tokens, temps, top_ps,
+                    top_ks, block)
                 for step in range(block):
                     for i in active:
                         r = self._slots[i]
@@ -265,16 +308,59 @@ class LLMEngine:
                 self._cache, kv, slot, jnp.int32(n))
             self._slots[slot] = r
             return self._sample_one(np.asarray(p["logits"]), r)
-        b = self._bucket_for(n)
-        padded = lm.pad_prompt(r.tokens, b)
-        logits, kv = lm.prefill(self.params, jnp.asarray(padded),
-                                jnp.int32(n), self.cfg, self.max_len)
+        if n <= self.buckets[-1]:
+            b = self._bucket_for(n)
+            padded = lm.pad_prompt(r.tokens, b)
+            logits, kv = lm.prefill(self.params, jnp.asarray(padded),
+                                    jnp.int32(n), self.cfg, self.max_len)
+        else:
+            logits, kv = self._chunked_prefill(r.tokens)
         self._cache = lm.write_prefill_to_cache(
             self._cache, kv, slot, jnp.int32(n))
         self._slots[slot] = r
         return self._sample_one(np.asarray(logits), r)
 
+    def _chunked_prefill(self, tokens: List[int]):
+        """Prompts past the largest bucket stream through
+        lm.prefill_chunk in bucket-sized pieces, each attending to the
+        accumulated KV of the pieces before it. Returns (last-token
+        logits, {"k","v"} (layers, max_len, kvh, hd)) — the same shape
+        contract as lm.prefill, so the cache write is identical."""
+        import jax
+        import jax.numpy as jnp
+        cdt = self._cache["k"].dtype
+        chunk = self.buckets[-1]
+        # accumulator length is a BUCKET MULTIPLE >= max_len: a padded
+        # final chunk written at a chunk-multiple offset then never
+        # overruns it (dynamic_update_slice CLAMPS the start index on
+        # overrun, which would silently shift the chunk and corrupt
+        # earlier positions); sliced back to max_len before the cache
+        # write
+        acc_len = ((self.max_len + chunk - 1) // chunk) * chunk
+        shape = (self.cfg.n_layers, acc_len, self.cfg.n_kv_heads,
+                 self.cfg.head_dim)
+        acc = {"k": jnp.zeros(shape, cdt), "v": jnp.zeros(shape, cdt)}
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            s = NamedSharding(self.mesh,
+                              P(None, None, self.tensor_axis, None))
+            acc = {k: jax.device_put(v, s) for k, v in acc.items()}
+        off = 0
+        logits = None
+        while off < len(tokens):
+            part = tokens[off:off + chunk]
+            b = self._bucket_for(len(part))
+            padded = lm.pad_prompt(part, b)
+            logits, acc = lm.prefill_chunk(
+                self.params, jnp.asarray(padded), jnp.int32(len(part)),
+                jnp.int32(off), acc, self.cfg)
+            off += len(part)
+        if acc_len > self.max_len:
+            acc = {k: v[:, :self.max_len] for k, v in acc.items()}
+        return logits, acc
+
     def _decode_sync(self, tokens: np.ndarray, temps: np.ndarray,
+                     top_ps: np.ndarray, top_ks: np.ndarray,
                      block: int) -> np.ndarray:
         """Returns (block, slots) int32 sampled tokens."""
         import jax
@@ -283,14 +369,31 @@ class LLMEngine:
         key = jax.random.fold_in(self._key, self._step)
         out, self._cache = lm.decode_steps(
             self.params, self._cache, jnp.asarray(tokens),
-            jnp.asarray(temps), key, self.cfg, block)
+            jnp.asarray(temps), key, self.cfg, block,
+            jnp.asarray(top_ps), jnp.asarray(top_ks))
         return np.asarray(out)
 
     def _sample_one(self, logits: np.ndarray, r: _Request) -> int:
+        """Host-side sampling for the FIRST token (prefill output is a
+        single logits vector). Mirrors lm.sample's temperature ->
+        top-k -> top-p order; also serves as the numpy reference the
+        on-device sampler is parity-tested against."""
         if r.temperature <= 0:
             return int(np.argmax(logits))
         z = logits.astype(np.float64) / r.temperature
-        z -= z.max()
+        if r.top_k > 0:
+            kth = np.sort(z)[::-1][min(r.top_k, len(z)) - 1]
+            z = np.where(z < kth, -np.inf, z)
+        if r.top_p < 1.0:
+            zm = z - z[np.isfinite(z)].max()
+            p = np.exp(zm)
+            p /= p.sum()
+            order = np.argsort(p)[::-1]
+            sp = p[order]
+            keep_sorted = (np.cumsum(sp) - sp) < r.top_p
+            thresh = sp[keep_sorted].min()
+            z = np.where(p < thresh, -np.inf, z)
+        z -= z[np.isfinite(z)].max()
         p = np.exp(z)
         p /= p.sum()
         return int(self._rng.choice(len(p), p=p))
@@ -305,6 +408,12 @@ class LLMEngine:
         self.stats["tokens_generated"] += 1
         if r.stream is not None:
             r.stream.put_nowait(tok)
+        if r.stop:
+            for seq in r.stop:
+                if len(r.out) >= len(seq) and r.out[-len(seq):] == seq:
+                    del r.out[-len(seq):]   # trim the stop sequence
+                    self._finish(r, slot)
+                    return
         if (len(r.out) >= r.max_new_tokens
                 or (r.eos_id is not None and tok == r.eos_id)):
             self._finish(r, slot)

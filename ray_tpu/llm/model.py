@@ -17,11 +17,12 @@ per shape bucket):
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.models.llama import (LlamaConfig, _rmsnorm, _rope,
                                   _rope_tables)
@@ -46,10 +47,68 @@ def pad_prompt(tokens, bucket: int):
 
 
 def init_cache(cfg: LlamaConfig, slots: int, max_len: int,
-               dtype=jnp.bfloat16) -> dict:
+               dtype=jnp.bfloat16, mesh: Optional[Mesh] = None,
+               axis: str = "tensor") -> dict:
+    """Static KV slot cache. With a mesh, k/v shard their KV-head dim
+    over the tensor axis — the engine's decode attention then runs
+    fully local per tensor shard (Megatron layout)."""
     shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
-            "length": jnp.zeros((slots,), jnp.int32)}
+    cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+             "length": jnp.zeros((slots,), jnp.int32)}
+    if mesh is not None:
+        kv_s = NamedSharding(mesh, P(None, None, None, axis, None))
+        rep = NamedSharding(mesh, P())
+        cache = {"k": jax.device_put(cache["k"], kv_s),
+                 "v": jax.device_put(cache["v"], kv_s),
+                 "length": jax.device_put(cache["length"], rep)}
+    return cache
+
+
+def serve_param_specs(cfg: LlamaConfig, axis: str = "tensor") -> dict:
+    """Megatron tensor-parallel PartitionSpecs for INFERENCE: attention
+    heads and ffn split over `axis`; the row-parallel matmuls (wo,
+    w_down) reduce over it (GSPMD inserts the psum). Unlike training's
+    param_shardings there is no fsdp dim — serving replicates what it
+    doesn't tensor-split, trading memory for zero gather latency on the
+    decode critical path. Reference capability: vLLM's
+    tensor_parallel_size per replica
+    (llm/_internal/serve/configs/llm_config.py:181-186)."""
+    t = axis
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, t),
+            "wk": P(None, None, t),
+            "wv": P(None, None, t),
+            "wo": P(None, t, None),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, t),
+            "w_up": P(None, None, t),
+            "w_down": P(None, t, None),
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, t),
+    }
+
+
+def shard_params_for_serving(params: dict, mesh: Mesh, cfg: LlamaConfig,
+                             axis: str = "tensor") -> dict:
+    """Place params on the mesh per serve_param_specs. Validates the
+    divisibility the layout needs (heads, kv heads, ffn, vocab all
+    split over the tensor axis)."""
+    tp = mesh.shape[axis]
+    for name, n in (("n_heads", cfg.n_heads),
+                    ("n_kv_heads", cfg.n_kv_heads),
+                    ("ffn_dim", cfg.ffn_dim),
+                    ("vocab_size", cfg.vocab_size)):
+        if n % tp:
+            raise ValueError(
+                f"{name}={n} not divisible by tensor-parallel size {tp}")
+    specs = serve_param_specs(cfg, axis)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: isinstance(x, P))
 
 
 def _qkv(y, lp, cfg: LlamaConfig):
@@ -123,24 +182,118 @@ def prefill(params: dict, tokens: jax.Array, length: jax.Array,
     return logits, {"k": jnp.pad(ks, pad), "v": jnp.pad(vs, pad)}
 
 
-def sample(logits: jax.Array, temps: jax.Array,
-           key: jax.Array) -> jax.Array:
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(4,))
+def prefill_chunk(params: dict, tokens: jax.Array, length: jax.Array,
+                  offset: jax.Array, acc: dict,
+                  cfg: LlamaConfig) -> Tuple[jax.Array, dict]:
+    """One CHUNK of a long prompt: process `tokens` (one padded bucket)
+    starting at absolute position `offset`, attending to all earlier
+    chunks' K/V in `acc` plus causally within the chunk. Lets prompts
+    longer than the largest prefill bucket stream through in
+    bucket-sized pieces at O(chunk x max_len) attention per piece —
+    long-prompt serving without a max_len-sized compile per prompt
+    (reference capability: vLLM chunked prefill).
+
+    tokens: (s,) int32 padded chunk; length: () valid tokens in it;
+    offset: () absolute start position; acc: {"k","v"}
+    (layers, max_len, kvh, hd), donated — earlier chunks' KV, updated
+    in place with this chunk's. Returns (logits of the chunk's last
+    valid token (vocab,), updated acc). Positions in acc beyond
+    offset+length may hold pad garbage; every consumer masks by total
+    length, so it is never attended to."""
+    s = tokens.shape[0]
+    L = acc["k"].shape[1]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    g = h // kvh
+    x = jnp.take(params["embed"], tokens[None], axis=0)     # (1, s, emb)
+    positions = (offset + jnp.arange(s, dtype=jnp.int32))[None]
+    rc, rs = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    q_pos = positions[0]                                    # (s,)
+    k_pos = jnp.arange(L, dtype=jnp.int32)                  # (L,)
+    # causal over ABSOLUTE positions (covers both earlier chunks and
+    # intra-chunk order), limited to valid keys
+    m = (k_pos[None, :] <= q_pos[:, None]) & \
+        (k_pos[None, :] < offset + length)
+
+    def layer(carry, xs):
+        x = carry
+        lp, ak, av = xs     # ak/av: (L, kvh, hd) this layer's acc
+        y = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(y, lp, cfg)
+        q, k = _rope(q, rc, rs), _rope(k, rc, rs)
+        ak = lax.dynamic_update_slice(
+            ak, k[0].astype(ak.dtype), (offset, jnp.int32(0), jnp.int32(0)))
+        av = lax.dynamic_update_slice(
+            av, v[0].astype(av.dtype), (offset, jnp.int32(0), jnp.int32(0)))
+        qg = q[0].reshape(s, kvh, g, hd).astype(jnp.float32)
+        kf = ak.astype(jnp.float32)                         # (L, kvh, hd)
+        scores = jnp.einsum("skgd,lkd->kgsl", qg, kf) / jnp.sqrt(hd)
+        scores = jnp.where(m[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("kgsl,lkd->skgd", probs,
+                       av.astype(jnp.float32))
+        o = o.reshape(1, s, h * hd).astype(x.dtype)
+        x = x + o @ lp["wo"]
+        y = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + ((jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"]))
+                 @ lp["w_down"])
+        return x, (ak, av)
+
+    x, (nk, nv) = lax.scan(layer, x, (params["layers"],
+                                      acc["k"], acc["v"]))
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take(x[0], length - 1, axis=0)
+    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": nk, "v": nv}
+
+
+def sample(logits: jax.Array, temps: jax.Array, key: jax.Array,
+           top_ps: Optional[jax.Array] = None,
+           top_ks: Optional[jax.Array] = None) -> jax.Array:
     """Per-slot sampling ON DEVICE: greedy where temp<=0, else
-    temperature-scaled categorical. Keeping sampling inside the jitted
-    step means each decode ships 4 bytes per slot to the host instead of
-    the full vocab logits — the device->host link (PCIe, or a network
-    tunnel in this environment) must never carry O(vocab) per token."""
-    b = logits.shape[0]
+    temperature -> top-k -> top-p -> categorical (the standard filter
+    order; reference capability = vLLM's SamplingParams temperature/
+    top_p/top_k). Keeping sampling inside the jitted step means each
+    decode ships 4 bytes per slot to the host instead of the full vocab
+    logits — the device->host link (PCIe, or a network tunnel in this
+    environment) must never carry O(vocab) per token.
+
+    top_ks: (slots,) int32, 0 disables; top_ps: (slots,) f32 in (0,1],
+    1.0 disables. Both filters run as sorts + masks over the vocab —
+    O(V log V) on the VPU, negligible next to the decode matmuls."""
+    b, v = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    keys = jax.random.split(key, b)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    drawn = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
+    masked = scaled
+    if top_ks is not None:
+        desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        kth = jnp.take_along_axis(
+            desc, jnp.clip(top_ks - 1, 0, v - 1)[:, None], axis=1)
+        masked = jnp.where((top_ks[:, None] > 0) & (scaled < kth),
+                           -jnp.inf, masked)
+    if top_ps is not None:
+        probs = jax.nn.softmax(masked, axis=-1)
+        sp = jnp.sort(probs, axis=-1)[:, ::-1]
+        cum = jnp.cumsum(sp, axis=-1)
+        # nucleus rule: keep the smallest prefix of the sorted probs
+        # whose mass reaches p — i.e. tokens whose EXCLUSIVE cumulative
+        # mass is still < p (the top token always survives)
+        keep = (cum - sp) < top_ps[:, None]
+        thresh = jnp.min(jnp.where(keep, sp, jnp.inf), axis=-1)
+        enabled = (top_ps < 1.0)[:, None]
+        masked = jnp.where(enabled & (probs < thresh[:, None]),
+                           -jnp.inf, masked)
+    keys = jax.random.split(key, b)
+    drawn = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
     return jnp.where(temps <= 0, greedy, drawn)
 
 
 def _decode_core(params: dict, cache: dict, tokens: jax.Array,
                  temps: jax.Array, key: jax.Array,
-                 cfg: LlamaConfig) -> Tuple[jax.Array, dict]:
+                 cfg: LlamaConfig,
+                 top_ps: Optional[jax.Array] = None,
+                 top_ks: Optional[jax.Array] = None
+                 ) -> Tuple[jax.Array, dict]:
     """One token for every slot. tokens: (slots,) int32 (last sampled
     token per slot); temps: (slots,) f32 sampling temperatures; key: rng
     for this step; cache["length"]: (slots,) current lengths (cache
@@ -172,7 +325,7 @@ def _decode_core(params: dict, cache: dict, tokens: jax.Array,
                                       cache["k"], cache["v"]))
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
-    out = sample(logits, temps, key)
+    out = sample(logits, temps, key, top_ps, top_ks)
     return out, {"k": nk, "v": nv, "length": cache["length"] + 1}
 
 
@@ -186,7 +339,9 @@ def decode_step(params: dict, cache: dict, tokens: jax.Array,
 @partial(jax.jit, static_argnames=("cfg", "n"), donate_argnums=(1,))
 def decode_steps(params: dict, cache: dict, tokens: jax.Array,
                  temps: jax.Array, key: jax.Array, cfg: LlamaConfig,
-                 n: int) -> Tuple[jax.Array, dict]:
+                 n: int, top_ps: Optional[jax.Array] = None,
+                 top_ks: Optional[jax.Array] = None
+                 ) -> Tuple[jax.Array, dict]:
     """n chained decode steps in ONE dispatch (lax.scan on device).
     Amortizes the host<->device roundtrip — essential when the link is
     a network tunnel (each sync costs a full RTT) and still worthwhile
@@ -196,7 +351,8 @@ def decode_steps(params: dict, cache: dict, tokens: jax.Array,
     def body(carry, i):
         cache, toks = carry
         out, cache = _decode_core(params, cache, toks, temps,
-                                  jax.random.fold_in(key, i), cfg)
+                                  jax.random.fold_in(key, i), cfg,
+                                  top_ps, top_ks)
         return (cache, out), out
 
     (cache, _), outs = lax.scan(body, (cache, tokens),

@@ -47,24 +47,55 @@ class PrefillEngine:
     def prefill(self, tokens: Sequence[int]) -> dict:
         """Runs the prompt forward pass; returns host numpy
         {"k","v": (layers, bucket, kvh, hd), "logits": (vocab,),
-        "length": n} ready to ship to a decode engine."""
+        "length": n} ready to ship to a decode engine. Prompts longer
+        than the largest bucket stream through lm.prefill_chunk in
+        bucket-sized pieces (chunked prefill — long prompts are the
+        very case disaggregation targets), shipping KV padded to the
+        smallest bucket multiple that holds them."""
         import jax.numpy as jnp
         tokens = list(map(int, tokens))
         n = len(tokens)
         if n == 0:
             raise ValueError("empty prompt")
-        if n > self.buckets[-1]:
+        if n > self.max_len:
             raise ValueError(
-                f"prompt of {n} tokens exceeds the largest prefill "
-                f"bucket {self.buckets[-1]}")
-        b = lm.bucket_for(self.buckets, n)
-        padded = lm.pad_prompt(tokens, b)
-        # pad KV only to the bucket (not max_len): the shipped payload
-        # scales with the prompt
-        logits, kv = lm.prefill(self.params, jnp.asarray(padded),
-                                jnp.int32(n), self.cfg, b)
+                f"prompt of {n} tokens exceeds max_len {self.max_len}")
         dt = jnp.dtype(self.cache_dtype)
-        return {"k": np.asarray(kv["k"].astype(dt)),
-                "v": np.asarray(kv["v"].astype(dt)),
+        big = self.buckets[-1]
+        if n <= big:
+            b = lm.bucket_for(self.buckets, n)
+            padded = lm.pad_prompt(tokens, b)
+            # pad KV only to the bucket (not max_len): the shipped
+            # payload scales with the prompt
+            logits, kv = lm.prefill(self.params, jnp.asarray(padded),
+                                    jnp.int32(n), self.cfg, b)
+            k, v = kv["k"], kv["v"]
+        else:
+            cfg = self.cfg
+            # accumulate into the smallest bucket-multiple >= n: chunk
+            # compile shapes and the shipped payload stay bucketed
+            # (bounded compile variants, prompt-proportional transfer),
+            # AND a padded final chunk can never overrun the buffer —
+            # dynamic_update_slice would CLAMP the start on overrun and
+            # silently corrupt earlier chunks' KV
+            ship = ((n + big - 1) // big) * big
+            shape = (cfg.n_layers, ship, cfg.n_kv_heads, cfg.head_dim)
+            acc = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+            off = 0
+            logits = None
+            while off < n:
+                part = tokens[off:off + big]
+                b = lm.bucket_for(self.buckets, len(part))
+                padded = lm.pad_prompt(part, b)
+                logits, acc = lm.prefill_chunk(
+                    self.params, jnp.asarray(padded),
+                    jnp.int32(len(part)), jnp.int32(off), acc, cfg)
+                off += len(part)
+            # decode caches span max_len positions; the bucket-rounded
+            # tail beyond it is pad garbage
+            k = acc["k"][:, :self.max_len]
+            v = acc["v"][:, :self.max_len]
+        return {"k": np.asarray(k.astype(dt)),
+                "v": np.asarray(v.astype(dt)),
                 "logits": np.asarray(logits),
                 "length": n}

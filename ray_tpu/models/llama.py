@@ -89,7 +89,13 @@ class LlamaConfig:
 
 
 def llama2_7b(**kw) -> LlamaConfig:
-    return LlamaConfig(**kw)
+    """Llama-2-7B dims, set EXPLICITLY (they coincide with
+    LlamaConfig's defaults, but "7b" in code must mean 7B even if the
+    defaults drift)."""
+    defaults = dict(vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+                    n_kv_heads=32, ffn_dim=11008, max_seq_len=4096)
+    defaults.update(kw)
+    return LlamaConfig(**defaults)
 
 
 def llama2_13b(**kw) -> LlamaConfig:

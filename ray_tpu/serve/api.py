@@ -184,6 +184,7 @@ def _collect_specs(app: Application, specs: Dict[str, dict]):
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/",
         http_port: Optional[int] = None,
+        ready_timeout_s: float = 120.0,
         _blocking_ready: bool = True) -> DeploymentHandle:
     """Deploy an application; returns a handle to the ingress deployment
     (reference: serve/api.py:821)."""
@@ -201,8 +202,9 @@ def run(app: Application, *, name: str = "default",
     core_api.get(controller.deploy_app.remote(name, list(specs.values())),
                  timeout=60)
     if _blocking_ready:
-        r = core_api.get(controller.wait_ready.remote(name, 120.0),
-                         timeout=150)
+        r = core_api.get(
+            controller.wait_ready.remote(name, ready_timeout_s),
+            timeout=ready_timeout_s + 30)
         if not r.get("ok"):
             raise RuntimeError(r.get("error", "serve app failed to start"))
 

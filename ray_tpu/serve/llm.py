@@ -39,6 +39,27 @@ class LLMConfig:
     seed: int = 0
     num_replicas: object = 1
     max_ongoing_requests: int = 64
+    # >1: each replica runs its engine tensor-parallel over this many
+    # local devices (Megatron sharding via lm.serve_param_specs) — how
+    # models larger than one chip's HBM serve (reference:
+    # llm_config.py:181-186 tensor_parallel_size)
+    tensor_parallel: int = 1
+
+
+def _serving_mesh(tensor_parallel: int):
+    """A ("tensor",)-axis mesh over the replica's local devices, or
+    None when tensor_parallel == 1 (single-chip engine)."""
+    if tensor_parallel <= 1:
+        return None
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    if len(devices) < tensor_parallel:
+        raise ValueError(
+            f"tensor_parallel={tensor_parallel} but only "
+            f"{len(devices)} local devices are visible")
+    return Mesh(np.asarray(devices[:tensor_parallel]), ("tensor",))
 
 
 def _load_model(cfg: LLMConfig):
@@ -71,15 +92,19 @@ class _LLMServer:
             model_cfg, params, max_slots=cfg.max_slots,
             max_len=cfg.max_len, prefill_buckets=cfg.prefill_buckets,
             cache_dtype=cfg.cache_dtype,
-            steps_per_sync=cfg.steps_per_sync, seed=cfg.seed)
+            steps_per_sync=cfg.steps_per_sync, seed=cfg.seed,
+            mesh=_serving_mesh(cfg.tensor_parallel))
         self._streams: dict = {}
 
     async def generate(self, tokens, max_new_tokens: int = 64,
                        temperature: float = 0.0,
-                       eos_id: Optional[int] = None) -> dict:
+                       eos_id: Optional[int] = None,
+                       top_p: float = 1.0, top_k: int = 0,
+                       stop=None) -> dict:
         return await self.engine.generate(
             tokens, max_new_tokens=max_new_tokens,
-            temperature=temperature, eos_id=eos_id)
+            temperature=temperature, eos_id=eos_id,
+            top_p=top_p, top_k=top_k, stop=stop)
 
     # --- streaming (cursor-polling over plain handle calls) -----------
     # The reference streams via HTTP SSE from the replica; here the
@@ -155,12 +180,16 @@ class _LLMServer:
         return dict(self.engine.stats)
 
     async def __call__(self, request: dict) -> dict:
-        """HTTP/JSON entry: {"tokens": [...], "max_new_tokens": N}."""
+        """HTTP/JSON entry: {"tokens": [...], "max_new_tokens": N,
+        "temperature", "top_p", "top_k", "stop", "eos_id"}."""
         return await self.generate(
             request["tokens"],
             max_new_tokens=int(request.get("max_new_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),
-            eos_id=request.get("eos_id"))
+            eos_id=request.get("eos_id"),
+            top_p=float(request.get("top_p", 1.0)),
+            top_k=int(request.get("top_k", 0)),
+            stop=request.get("stop"))
 
 
 def stream_generate(handle, tokens, **kw):

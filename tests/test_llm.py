@@ -142,12 +142,20 @@ def test_prompt_validation(tiny_model):
     async def go():
         eng = LLMEngine(cfg, params, max_slots=1, max_len=16,
                         prefill_buckets=(8,), cache_dtype="float32")
-        with pytest.raises(ValueError, match="bucket"):
+        # prompts past the largest bucket now CHUNK (no bucket cap);
+        # only max_len bounds them
+        with pytest.raises(ValueError, match="max_len"):
             await eng.generate(list(range(99)), max_new_tokens=1)
         with pytest.raises(ValueError, match="max_len"):
             await eng.generate([1, 2, 3], max_new_tokens=64)
         with pytest.raises(ValueError, match="max_new_tokens"):
             await eng.generate([1, 2], max_new_tokens=0)
+        with pytest.raises(ValueError, match="top_p"):
+            await eng.generate([1, 2], max_new_tokens=1, top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            await eng.generate([1, 2], max_new_tokens=1, top_k=-2)
+        with pytest.raises(ValueError, match="stop"):
+            await eng.generate([1, 2], max_new_tokens=1, stop=[[]])
         await eng.stop()
         with pytest.raises(RuntimeError, match="stopped"):
             await eng.generate([1, 2], max_new_tokens=1)

@@ -104,7 +104,10 @@ def test_pd_serve_app():
 
         app = build_pd_llm_deployment(cfg, num_prefill_replicas=2,
                                       num_decode_replicas=1, name="pd")
-        h = serve.run(app, name="pd_app", route_prefix=None)
+        # 4 replicas x first-jax-init on a 1-core box can exceed the
+        # default readiness window when the whole suite runs
+        h = serve.run(app, name="pd_app", route_prefix=None,
+                      ready_timeout_s=300.0)
         out = ray_tpu.get(
             h.generate.remote(prompt, max_new_tokens=10),
             timeout=120)
@@ -163,3 +166,40 @@ def test_sse_streaming_over_http_proxy():
             serve.shutdown()
         finally:
             ray_tpu.shutdown()
+
+
+def test_pd_long_prompt_chunked(tiny_model):
+    """A 4k-token prompt — far past the largest prefill bucket — runs
+    through the disaggregated path via chunked prefill and matches the
+    unified engine exactly. Long prompts are the very case
+    disaggregation targets (round-2 verdict weak #10)."""
+    import numpy as np
+    cfg, params = tiny_model
+    prompt = [int(x) for x in
+              np.random.default_rng(9).integers(1, 120, size=4096)]
+
+    async def main():
+        unified = LLMEngine(cfg, params, max_slots=1, max_len=4352,
+                            prefill_buckets=(256, 512),
+                            cache_dtype="float32")
+        want = (await unified.generate(
+            prompt, max_new_tokens=8))["tokens"]
+        await unified.stop()
+
+        pre = PrefillEngine(cfg, params, prefill_buckets=(256, 512),
+                            max_len=4352, cache_dtype="float32")
+        shipped = pre.prefill(prompt)
+        # payload rounds up to a bucket multiple, not max_len
+        assert shipped["k"].shape[1] == 4096
+        assert shipped["length"] == 4096
+
+        decode = LLMEngine(cfg, params, max_slots=1, max_len=4352,
+                           prefill_buckets=(256, 512),
+                           cache_dtype="float32")
+        got = (await decode.generate_prefilled(
+            prompt, shipped, max_new_tokens=8))["tokens"]
+        await decode.stop()
+        assert got == want, (got, want)
+        assert len(got) == 8
+
+    asyncio.run(main())
