@@ -161,6 +161,27 @@ def cmd_status(args) -> int:
 
 def cmd_list(args) -> int:
     addr = _resolve_address(args)
+    if args.what == "tasks":
+        # recent executions off the tracing archive (reference:
+        # `ray list tasks` over GCS task events)
+        import time as _time
+
+        from ray_tpu.util.state import tasks_from_events
+        r = _call_head(addr, "collect_timeline")
+        rows = tasks_from_events(r.get("events", []),
+                                 limit=int(getattr(args, "limit", 200)
+                                           or 200))
+        if args.json:
+            print(json.dumps(rows, default=str, indent=2))
+            return 0
+        for t in rows:
+            started = _time.strftime(
+                "%H:%M:%S", _time.localtime(t["start_time"] or 0))
+            status = "ERROR" if t["error"] else "ok"
+            print(f"{started}  {t['kind']:15s} {str(t['name']):32s} "
+                  f"{(t['duration_s'] or 0.0) * 1e3:9.2f} ms  "
+                  f"node={str(t['node_id'] or '')[:8]}  {status}")
+        return 0
     method = {"nodes": "get_nodes", "actors": "list_actors",
               "jobs": "list_jobs", "pgs": "list_pgs"}[args.what]
     rows = _call_head(addr, method)
@@ -311,9 +332,11 @@ def main(argv=None) -> int:
     pu.set_defaults(fn=cmd_status)
 
     pl = sub.add_parser("list", help="list cluster state")
-    pl.add_argument("what", choices=["nodes", "actors", "jobs", "pgs"])
+    pl.add_argument("what",
+                    choices=["nodes", "actors", "jobs", "pgs", "tasks"])
     pl.add_argument("--address")
     pl.add_argument("--json", action="store_true")
+    pl.add_argument("--limit", type=int, default=200)
     pl.set_defaults(fn=cmd_list)
 
     pg = sub.add_parser("logs", help="list / show worker logs on this host")

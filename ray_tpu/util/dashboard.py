@@ -1,0 +1,279 @@
+"""Server-rendered cluster dashboard.
+
+The capability analog of the reference's dashboard (reference:
+python/ray/dashboard/head.py:49 module system +
+dashboard/modules/{node,actor,job,serve,state} + a React client),
+collapsed TPU-first: the cluster state already lives in the control
+service's tables, so the dashboard is a handful of HTML renderers over
+the same RPCs the state API uses — no build step, no JS framework, one
+process. Pages: / (overview), /nodes, /actors, /jobs, /pgs, /serve,
+/tasks (recent spans off the tracing archive).
+
+Served by util.metrics.MetricsServer on every node's metrics port; the
+node agent registers a `fetch` callable that proxies to the head.
+"""
+
+from __future__ import annotations
+
+import html
+import time
+from typing import Awaitable, Callable, List, Optional, Sequence
+
+Fetch = Callable[..., Awaitable]
+
+_STYLE = """
+body{font-family:system-ui,sans-serif;margin:2em;background:#14161a;
+     color:#d7dae0}
+h1{font-size:1.3em} h2{font-size:1.05em;margin-top:1.4em}
+a{color:#7ab7ff;text-decoration:none} a:hover{text-decoration:underline}
+nav a{margin-right:1.2em}
+table{border-collapse:collapse;margin-top:.6em;font-size:.92em}
+td,th{border:1px solid #3a3f46;padding:4px 10px;text-align:left}
+th{background:#20242a} .num{text-align:right}
+.ok{color:#7dd87d} .bad{color:#ff7a7a} .dim{color:#8a8f98}
+.pill{padding:1px 8px;border-radius:9px;background:#2a2f36}
+"""
+
+_NAV = ("<nav><a href='/'>overview</a><a href='/nodes'>nodes</a>"
+        "<a href='/actors'>actors</a><a href='/jobs'>jobs</a>"
+        "<a href='/pgs'>placement groups</a><a href='/serve'>serve</a>"
+        "<a href='/tasks'>tasks</a><a href='/metrics'>metrics</a></nav>")
+
+
+def _esc(v) -> str:
+    return html.escape(str(v))
+
+
+def _page(title: str, body: str) -> bytes:
+    return (f"<!doctype html><html><head><title>ray-tpu: {_esc(title)}"
+            f"</title><style>{_STYLE}</style>"
+            f"<meta http-equiv='refresh' content='5'></head>"
+            f"<body><h1>ray-tpu &mdash; {_esc(title)}</h1>{_NAV}"
+            f"{body}</body></html>").encode()
+
+
+def _table(headers: Sequence[str], rows: List[Sequence]) -> str:
+    if not rows:
+        return "<p class=dim>(none)</p>"
+    head = "".join(f"<th>{_esc(h)}</th>" for h in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{c}</td>" for c in row) + "</tr>"
+        for row in rows)
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def _res(r: dict) -> str:
+    return _esc(", ".join(f"{k}={v:g}" for k, v in sorted(
+        (r or {}).items()))) or "<span class=dim>-</span>"
+
+
+def _hex(v) -> str:
+    return v.hex() if hasattr(v, "hex") else str(v)
+
+
+def _state(s, good=("ALIVE", "CREATED", "RUNNING", "SUCCEEDED")) -> str:
+    cls = "ok" if s in good else ("dim" if s in ("PENDING",) else "bad")
+    return f"<span class={cls}>{_esc(s)}</span>"
+
+
+# --- pages -------------------------------------------------------------
+
+
+async def _overview(fetch: Fetch) -> bytes:
+    nodes = await fetch("get_nodes")
+    actors = await fetch("list_actors")
+    jobs = await fetch("list_jobs")
+    pgs = await fetch("list_pgs")
+    alive = [n for n in nodes if n["alive"]]
+    total: dict = {}
+    avail: dict = {}
+    for n in alive:
+        for k, v in (n.get("resources_total") or {}).items():
+            total[k] = total.get(k, 0.0) + v
+        for k, v in (n.get("resources_available") or {}).items():
+            avail[k] = avail.get(k, 0.0) + v
+    by_state: dict = {}
+    for a in actors:
+        if a:
+            by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+    res_rows = [(_esc(k), f"{avail.get(k, 0):g}", f"{total[k]:g}")
+                for k in sorted(total)]
+    body = (
+        f"<h2>cluster</h2>"
+        f"<p><span class=pill>{len(alive)} / {len(nodes)} nodes alive"
+        f"</span> <span class=pill>{len(actors)} actors</span> "
+        f"<span class=pill>{len(jobs)} jobs</span> "
+        f"<span class=pill>{len(pgs)} placement groups</span></p>"
+        f"<h2>resources (available / total)</h2>"
+        + _table(("resource", "available", "total"), res_rows)
+        + "<h2>actors by state</h2>"
+        + _table(("state", "count"),
+                 [(_state(s), str(c))
+                  for s, c in sorted(by_state.items())]))
+    return _page("overview", body)
+
+
+async def _nodes(fetch: Fetch) -> bytes:
+    nodes = await fetch("get_nodes")
+    rows = []
+    for n in sorted(nodes, key=lambda x: not x["alive"]):
+        rows.append((
+            _esc(_hex(n["node_id"])[:12]),
+            _esc(f"{n['addr'][0]}:{n['addr'][1]}"
+                 if isinstance(n.get("addr"), (tuple, list))
+                 else n.get("addr", "-")),
+            _state("ALIVE" if n["alive"] else "DEAD"),
+            _res(n.get("resources_available")),
+            _res(n.get("resources_total")),
+            _esc(", ".join(f"{k}={v}" for k, v in
+                           (n.get("labels") or {}).items()) or "-"),
+        ))
+    return _page("nodes", _table(
+        ("node", "address", "state", "available", "total", "labels"),
+        rows))
+
+
+async def _actors(fetch: Fetch) -> bytes:
+    actors = [a for a in await fetch("list_actors") if a]
+    rows = []
+    order = {"ALIVE": 0, "RESTARTING": 1, "PENDING": 2, "DEAD": 3}
+    for a in sorted(actors, key=lambda x: order.get(x["state"], 9)):
+        rows.append((
+            _esc(_hex(a["actor_id"])[:12]),
+            _esc(a.get("name") or "-"),
+            _esc(a.get("class_name") or "-"),
+            _state(a["state"]),
+            _esc(_hex(a["node_id"])[:12] if a.get("node_id") else "-"),
+            str(a.get("num_restarts", 0)),
+            _esc(a.get("death_cause") or ""),
+        ))
+    return _page("actors", _table(
+        ("actor", "name", "class", "state", "node", "restarts",
+         "death cause"), rows))
+
+
+async def _jobs(fetch: Fetch) -> bytes:
+    jobs = await fetch("list_jobs")
+    sub = await fetch("list_submitted_jobs")
+    rows = [(_esc(_hex(j["job_id"])[:12]), _state(j["state"]),
+             _esc(time.strftime("%H:%M:%S",
+                                time.localtime(j.get("start_time", 0)))))
+            for j in jobs]
+    srows = [(_esc(j["submission_id"]), _esc(j.get("entrypoint", ""))[:80],
+              _state(j.get("status", "?")),
+              _esc(j.get("log_path", "")))
+             for j in sub]
+    body = ("<h2>driver jobs</h2>"
+            + _table(("job", "state", "started"), rows)
+            + "<h2>submitted jobs</h2>"
+            + _table(("submission", "entrypoint", "status", "log"),
+                     srows))
+    return _page("jobs", body)
+
+
+async def _pgs(fetch: Fetch) -> bytes:
+    pgs = await fetch("list_pgs")
+    rows = []
+    for p in pgs:
+        if not p:
+            continue
+        nodes = {_hex(n)[:12] for n in (p.get("bundle_nodes") or [])
+                 if n is not None}
+        rows.append((
+            _esc(_hex(p["pg_id"])[:12]),
+            _esc(p.get("name") or "-"),
+            _state(p["state"]),
+            _esc(p.get("strategy", "")),
+            str(len(p.get("bundles") or [])),
+            _esc(", ".join(sorted(nodes)) or "-"),
+        ))
+    return _page("placement groups", _table(
+        ("pg", "name", "state", "strategy", "bundles", "nodes"), rows))
+
+
+async def _serve(fetch: Fetch) -> bytes:
+    """Serve view derived from the actor table: deployments are the
+    SERVE_REPLICA:<dep>:<rid> groups, the control plane is the
+    SERVE_CONTROLLER/SERVE_PROXY actors."""
+    actors = [a for a in await fetch("list_actors") if a]
+    deps: dict = {}
+    plane = []
+    for a in actors:
+        name = a.get("name") or ""
+        if name.startswith("SERVE_REPLICA:"):
+            _, dep, rid = name.split(":", 2)
+            deps.setdefault(dep, []).append((rid, a))
+        elif name.startswith("SERVE_"):
+            plane.append((name, a))
+    rows = []
+    for dep, reps in sorted(deps.items()):
+        n_alive = sum(1 for _, a in reps if a["state"] == "ALIVE")
+        rows.append((
+            _esc(dep), f"{n_alive} / {len(reps)}",
+            ", ".join(
+                f"{_esc(rid)}&nbsp;{_state(a['state'])}"
+                for rid, a in sorted(reps)),
+        ))
+    prows = [(_esc(n), _state(a["state"]),
+              _esc(_hex(a["node_id"])[:12] if a.get("node_id") else "-"))
+             for n, a in sorted(plane)]
+    body = ("<h2>deployments</h2>"
+            + _table(("deployment", "alive replicas", "replicas"), rows)
+            + "<h2>control plane</h2>"
+            + _table(("actor", "state", "node"), prows))
+    return _page("serve", body)
+
+
+async def _tasks(fetch: Fetch) -> bytes:
+    """Recent task/actor spans from the cluster timeline (tracing
+    archive + live node buffers) — the `ray list tasks` analog."""
+    from ray_tpu.util.state import tasks_from_events
+    r = await fetch("collect_timeline")
+    tasks = tasks_from_events(r.get("events", []), limit=200)
+    rows = []
+    for t in tasks:
+        where = f"{str(t['node_id'] or '')[:8]}/pid {t['pid'] or '?'}"
+        rows.append((
+            _esc(t["name"]),
+            _esc(t["kind"]),
+            _esc(where),
+            f"{(t['duration_s'] or 0.0) * 1e3:.2f}",
+            _esc(time.strftime("%H:%M:%S",
+                               time.localtime(t["start_time"] or 0))),
+            _state("ok" if not t["error"] else "ERROR", good=("ok",)),
+        ))
+    body = (f"<p class=dim>newest {len(rows)} task executions. "
+            f"Full chrome trace: <code>ray-tpu timeline</code></p>"
+            + _table(("task", "kind", "where", "duration (ms)",
+                      "started", "status"), rows))
+    return _page("tasks", body)
+
+
+_PAGES = {"/": _overview, "/overview": _overview, "/nodes": _nodes,
+          "/actors": _actors, "/jobs": _jobs, "/pgs": _pgs,
+          "/serve": _serve, "/tasks": _tasks}
+
+
+async def render(path: str, fetchers) -> Optional[bytes]:
+    """Render a dashboard page, or None if `path` isn't one.
+    `fetchers`: candidate fetch callables, preferred first (a stale one
+    from a dead agent is skipped when a later candidate works). With
+    none registered (no agent in this process) pages explain that
+    instead of 404ing."""
+    page = _PAGES.get(path.rstrip("/") or "/")
+    if page is None:
+        return None
+    if callable(fetchers):
+        fetchers = [fetchers]
+    if not fetchers:
+        return _page("unavailable",
+                     "<p class=bad>no cluster connection in this "
+                     "process</p>")
+    err: Optional[Exception] = None
+    for fetch in fetchers:
+        try:
+            return await page(fetch)
+        except Exception as e:  # noqa: BLE001 — try the next candidate
+            err = e
+    return _page("error", f"<p class=bad>{_esc(type(err).__name__)}: "
+                          f"{_esc(err)}</p>")

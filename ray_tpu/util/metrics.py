@@ -142,6 +142,33 @@ class Histogram(Metric):
         return "\n".join(lines)
 
 
+_STATE_FETCHERS: List[Callable] = []
+
+
+def register_state_fetcher(fn: Callable) -> None:
+    """Register an async `fetch(method, **kw)` that proxies control
+    RPCs to the head — the dashboard's data source (the node agent
+    registers one; any agent in the process can serve every page)."""
+    with _LOCK:
+        _STATE_FETCHERS.append(fn)
+
+
+def unregister_state_fetcher(fn: Callable) -> None:
+    with _LOCK:
+        try:
+            _STATE_FETCHERS.remove(fn)
+        except ValueError:
+            pass
+
+
+def _state_fetchers() -> List[Callable]:
+    """Newest first: a prior test/session's dead agent may not have
+    unregistered; the most recently registered fetcher is the one whose
+    cluster is actually alive."""
+    with _LOCK:
+        return list(reversed(_STATE_FETCHERS))
+
+
 def register_collector(fn: Callable[[], str]) -> None:
     """Add a scrape-time text producer (already Prometheus-formatted)."""
     with _LOCK:
@@ -241,14 +268,19 @@ class MetricsServer:
                 code = "200 OK"
             elif path.startswith("/healthz"):
                 body, ctype, code = b"ok\n", "text/plain", "200 OK"
-            elif path == "/" or path.startswith("/index"):
-                # Minimal live dashboard (reference ships a full React
-                # dashboard/; this renders the same gauges from
-                # /metrics client-side with zero dependencies).
+            elif path.startswith("/raw"):
+                # the original metric-table page, kept at /raw
                 body, ctype, code = _DASH_HTML, "text/html", "200 OK"
             else:
-                body, ctype, code = b"not found\n", "text/plain", \
-                    "404 Not Found"
+                # server-rendered cluster dashboard (nodes/actors/jobs/
+                # pgs/serve/tasks off the control-plane state API)
+                from ray_tpu.util import dashboard
+                page = await dashboard.render(path, _state_fetchers())
+                if page is not None:
+                    body, ctype, code = page, "text/html", "200 OK"
+                else:
+                    body, ctype, code = b"not found\n", "text/plain", \
+                        "404 Not Found"
             writer.write(
                 f"HTTP/1.1 {code}\r\nContent-Type: {ctype}\r\n"
                 f"Content-Length: {len(body)}\r\n"
