@@ -1,0 +1,12 @@
+"""Cloud node providers for the autoscaler.
+
+The pluggable counterpart of the reference's provider tree (reference:
+python/ray/autoscaler/_private/{gcp,aws,kuberay}/node_provider.py).
+TPU-first, the one that matters is GCP's queued-resources API for TPU
+slices: ray_tpu.providers.gcp.
+"""
+
+from ray_tpu.providers.gcp import (GCPClient, TPUQueuedResourceProvider,
+                                   TPUSliceAutoscaler)
+
+__all__ = ["GCPClient", "TPUQueuedResourceProvider", "TPUSliceAutoscaler"]

@@ -1,0 +1,152 @@
+"""GCP TPU queued-resource provider + slice autoscaler.
+
+Reference shape: python/ray/autoscaler/_private/gcp/node_provider.py
+(create/terminate/list against the cloud API) exercised offline through
+an injected fake transport — the 'recorded HTTP' strategy.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.config import Config
+from ray_tpu.providers.gcp import (GCPClient, SliceScalerConfig,
+                                   TPUQueuedResourceProvider,
+                                   TPUSliceAutoscaler, accelerator_type)
+from ray_tpu.runtime import rpc
+
+
+class FakeTPUApi:
+    """In-memory tpu.googleapis.com: records calls, serves state."""
+
+    def __init__(self):
+        self.calls = []
+        self.resources = {}          # qr_id -> body
+
+    def request(self, method, url, body):
+        self.calls.append((method, url, body))
+        if method == "POST" and "queuedResources" in url:
+            qr_id = url.rsplit("queued_resource_id=", 1)[-1]
+            self.resources[qr_id] = {
+                "name": f"projects/p/locations/z/queuedResources/{qr_id}",
+                "state": {"state": "ACTIVE"},
+                "tpu": body["tpu"],
+            }
+            return 200, {"name": f"operations/create-{qr_id}"}
+        if method == "DELETE":
+            qr_id = url.rsplit("/", 1)[-1].split("?")[0]
+            if self.resources.pop(qr_id, None) is None:
+                return 404, {}
+            return 200, {"name": f"operations/delete-{qr_id}"}
+        if method == "GET":
+            return 200, {"queuedResources": list(self.resources.values())}
+        return 400, {"error": f"unhandled {method} {url}"}
+
+
+@pytest.fixture
+def fake_client():
+    api = FakeTPUApi()
+    return api, GCPClient("proj", "us-central2-b", request=api.request)
+
+
+def test_accelerator_type_naming():
+    assert accelerator_type("v5e-16") == "v5litepod-16"
+    assert accelerator_type("v4-8") == "v4-8"
+    assert accelerator_type("v6e-32") == "v6e-32"
+
+
+def test_provider_create_delete_list(fake_client):
+    import asyncio
+    api, client = fake_client
+    prov = TPUQueuedResourceProvider(client, "10.0.0.1:7000",
+                                     default_pod_type="v5e-8")
+
+    async def go():
+        h = await prov.launch({"TPU": 8.0}, {"tpu_pod_type": "v5e-16"})
+        assert h in await prov.alive_handles()
+        # the create carried the right topology + a join startup script
+        method, url, body = api.calls[0]
+        assert method == "POST"
+        node = body["tpu"]["node_spec"][0]["node"]
+        assert node["acceleratorType"] == "v5litepod-16"
+        assert "10.0.0.1:7000" in node["metadata"]["startup-script"]
+        assert node["labels"]["ray-tpu-cluster"] == "true"
+        await prov.terminate(h)
+        assert h not in await prov.alive_handles()
+        # deleting an unknown handle is a no-op, not an error
+        await prov.terminate("ghost")
+
+    asyncio.run(go())
+
+
+def test_pending_slice_pg_creates_and_deletes_slice(fake_client):
+    """The VERDICT's done-criterion: a pending v5e-16 slice PG makes
+    the provider receive a create call with the correct topology; the
+    slice is deleted once the PG is removed."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.tpu import slice_placement_group
+    api, client = fake_client
+    cfg = Config.from_env(infeasible_wait_window_s=60.0)
+    c = Cluster(config=cfg)
+    c.add_node(num_cpus=0)
+    ray_tpu.init(address=c.address, num_cpus=0, config=cfg)
+    elt = rpc.EventLoopThread("gcp_scaler_test")
+    prov = TPUQueuedResourceProvider(client, c.address)
+    scaler = TPUSliceAutoscaler(
+        c.address, prov,
+        SliceScalerConfig(generation="v5e", max_slices=2,
+                          slice_idle_timeout_s=0.0,
+                          reconcile_interval_s=0.2))
+    try:
+        # v5e-16: 2 hosts x 8 chips. placement_group() BLOCKS while
+        # PENDING (patient reservation), and no TPU node ever joins in
+        # this offline test — so reserve on a side thread and observe
+        # the pending gang through the control service.
+        import threading
+        t = threading.Thread(
+            target=lambda: _swallow(
+                slice_placement_group, pod_type="v5e-16", name="s16"),
+            daemon=True)
+        t.start()
+
+        def _pg_rows():
+            return c.elt.run(c.head.pool.call(c.head_addr, "list_pgs"))
+
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not api.resources:
+            if any(p["state"] == "PENDING" for p in _pg_rows()):
+                elt.run(scaler.reconcile_once(), timeout=30)
+            time.sleep(0.1)
+        assert api.resources, "no queued-resource create issued"
+        (qr_id, qr), = api.resources.items()
+        node = qr["tpu"]["node_spec"][0]["node"]
+        assert node["acceleratorType"] == "v5litepod-16"
+        assert node["labels"]["tpu-pod-type"] == "v5e-16"
+        # idempotent: more reconciles must NOT create more slices
+        for _ in range(3):
+            elt.run(scaler.reconcile_once(), timeout=30)
+        assert len(api.resources) == 1
+
+        # scale-down: removing the PG deletes the queued resource
+        pg_id = next(p["pg_id"] for p in _pg_rows()
+                     if p["state"] == "PENDING")
+        c.elt.run(c.head.pool.call(c.head_addr, "remove_pg", pg_id=pg_id))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and api.resources:
+            elt.run(scaler.reconcile_once(), timeout=30)
+            time.sleep(0.1)
+        assert not api.resources, "slice not deleted after PG removal"
+        assert any(m == "DELETE" for m, _, _ in api.calls)
+        t.join(timeout=30)
+    finally:
+        elt.stop()
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def _swallow(fn, *a, **kw):
+    try:
+        fn(*a, **kw)
+    except Exception:
+        pass   # the reservation is deliberately aborted by remove_pg
