@@ -23,6 +23,12 @@ class ScalingConfig:
     topology: Optional[str] = None          # e.g. "v5e-32" (pod type)
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
+    # Elastic GROW: how often the running group checks whether new
+    # capacity allows more workers, and how long the capacity must be
+    # sustained before paying a restart-from-checkpoint (reference:
+    # v2/_internal/execution/scaling_policy/elastic.py:29 resize
+    # decisions in both directions). 0 disables grow checks.
+    elastic_grow_interval_s: float = 5.0
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker:

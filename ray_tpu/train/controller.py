@@ -29,6 +29,16 @@ class TrainGroupError(RuntimeError):
     pass
 
 
+class _ResizeRequested(Exception):
+    """Internal: the elastic policy wants a different group size; the
+    run loop restarts from the latest checkpoint WITHOUT consuming a
+    failure budget (a resize is not a failure)."""
+
+    def __init__(self, target: int):
+        super().__init__(f"elastic resize to {target} workers")
+        self.target = target
+
+
 class TrainController:
     def __init__(self, train_fn: Callable,
                  scaling: ScalingConfig,
@@ -60,6 +70,30 @@ class TrainController:
         feasible = int(total // per) if per else want
         n = max(self.scaling.min_workers, min(want, feasible))
         return n
+
+    def _grow_target(self) -> Optional[int]:
+        """While a group runs: can spare capacity host MORE workers?
+        Returns the larger world size, or None. The running group's own
+        resources are leased, so `available` counts only headroom
+        (reference: elastic.py resizes up when the cluster grows)."""
+        if not self.scaling.elastic:
+            return None
+        current = len(self._workers)
+        if current >= self.scaling.max_workers:
+            return None
+        res = self.scaling.worker_resources()
+        try:
+            avail = ray_tpu.available_resources()
+        except Exception:
+            return None
+        # headroom must satisfy EVERY resource the worker needs — a
+        # TPU-rich/CPU-starved cluster must not trigger a restart the
+        # new placement group can never place
+        extra = min(
+            (int(avail.get(k, 0.0) // v) for k, v in res.items() if v),
+            default=0)
+        target = min(self.scaling.max_workers, current + extra)
+        return target if target > current else None
 
     # --- group lifecycle ---
 
@@ -206,6 +240,7 @@ class TrainController:
     def run(self) -> Result:
         failures = 0
         max_failures = self.run_config.failure_config.max_failures
+        resize_to: Optional[int] = None
         while True:
             if self._stop_requested:
                 return Result(
@@ -215,7 +250,14 @@ class TrainController:
                     metrics_history=list(self.metrics_history),
                     error=TrainGroupError("stopped"))
             try:
-                n = self._decide_num_workers()
+                # A grow decision carries its target explicitly: right
+                # after teardown the old group's resources may not have
+                # released yet, so re-deriving the size from
+                # available_resources() would undershoot (the patient
+                # placement group absorbs the release lag instead).
+                n = resize_to if resize_to is not None \
+                    else self._decide_num_workers()
+                resize_to = None
                 self._create_group(n)
                 self._bootstrap_distributed(n)
                 self._start_train()
@@ -225,6 +267,12 @@ class TrainController:
                              if self.metrics_history else {}),
                     checkpoint=self.ckpt_manager.best(),
                     metrics_history=list(self.metrics_history))
+            except _ResizeRequested as rr:
+                # elastic grow: not a failure — restart the group at the
+                # new size from the latest checkpoint
+                self._teardown_group()
+                resize_to = rr.target
+                continue
             except (api.RayTpuError, TrainGroupError) as e:
                 # RayTpuError covers actor death, worker crash, task errors
                 # AND placement failures (create_pg raising) — all of them
@@ -246,6 +294,9 @@ class TrainController:
 
     def _poll_until_done(self, poll_s: float = 0.2):
         pending = set(range(len(self._workers)))
+        grow_iv = self.scaling.elastic_grow_interval_s
+        next_grow_check = time.monotonic() + grow_iv
+        grow_seen: Optional[int] = None
         while pending:
             polls = ray_tpu.get(
                 [self._workers[i].poll.remote() for i in sorted(pending)],
@@ -261,6 +312,17 @@ class TrainController:
                         f"{p['error']}")
                 if p["done"]:
                     pending.discard(p["rank"])
+            # elastic GROW: capacity that appeared mid-run (autoscaler
+            # added a node, another job released one) widens the group.
+            # Requires seeing the grow target on two consecutive checks
+            # so a transient blip doesn't pay a restart-from-checkpoint.
+            if pending and grow_iv > 0 and \
+                    time.monotonic() >= next_grow_check:
+                next_grow_check = time.monotonic() + grow_iv
+                target = self._grow_target()
+                if target is not None and target == grow_seen:
+                    raise _ResizeRequested(target)
+                grow_seen = target
             if pending:
                 time.sleep(poll_s)
 
