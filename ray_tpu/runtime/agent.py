@@ -86,6 +86,8 @@ class NodeAgent:
         self.bundle_avail: Dict[Tuple[PlacementGroupID, int], dict] = {}
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self.leases: Dict[str, _Lease] = {}
+        # env_hash -> last venv setup failure (surfaced in lease errors)
+        self._venv_errors: Dict[str, str] = {}
         self._lease_seq = 0
         self._worker_claims: Dict[str, int] = {}  # env_hash -> claims
         self._wait_queue: List[Tuple[dict, asyncio.Future]] = []
@@ -144,7 +146,15 @@ class NodeAgent:
         self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
         from ray_tpu.util import metrics as _m
         self._collector = self._render_metrics
+
+        async def _dash_fetch(method, **kw):
+            # dashboard pages proxy control RPCs to the head
+            return await self.pool.call(self.head_addr, method,
+                                        timeout=10.0, **kw)
+
+        self._dash_fetch = _dash_fetch
         _m.register_collector(self._collector)
+        _m.register_state_fetcher(self._dash_fetch)
         if self.config.metrics_port >= 0:
             self.metrics_addr = await _m.acquire_shared_server(
                 host, self.config.metrics_port)
@@ -189,6 +199,8 @@ class NodeAgent:
         from ray_tpu.util import metrics as _m
         if getattr(self, "_collector", None) is not None:
             _m.unregister_collector(self._collector)
+        if getattr(self, "_dash_fetch", None) is not None:
+            _m.unregister_state_fetcher(self._dash_fetch)
         if getattr(self, "_metrics_held", False):
             self._metrics_held = False
             await _m.release_shared_server()
@@ -430,13 +442,39 @@ class NodeAgent:
 
     # --- worker pool ---------------------------------------------------------
 
+    def _no_worker_error(self, env_hash: str) -> str:
+        """'no worker available' is kept as the transient-retry marker
+        (core._lease_err_transient matches on it); a venv setup failure
+        for this env is appended so the user can tell a broken
+        runtime_env from cluster saturation."""
+        ve = self._venv_errors.get(env_hash)
+        if ve:
+            return f"no worker available (runtime_env setup failed: {ve})"
+        return "no worker available"
+
     async def _spawn_worker(self, runtime_env: Optional[dict] = None,
                             env_hash: str = "") -> Optional[WorkerHandle]:
-        from ray_tpu.runtime.runtime_env import apply_to_env
+        from ray_tpu.runtime.runtime_env import apply_to_env, venv_python
         wid = WorkerID.generate()
         env = dict(os.environ)
         env.update(self.env_extra)
         env = apply_to_env(runtime_env, env)
+        python = sys.executable
+        if runtime_env and (runtime_env.get("pip")
+                            or runtime_env.get("uv")):
+            # cached per-requirements venv; creation (first use only)
+            # runs off-loop — it may pip-install for minutes
+            loop = asyncio.get_running_loop()
+            try:
+                python = await loop.run_in_executor(
+                    None, venv_python, runtime_env) or sys.executable
+            except Exception as e:  # noqa: BLE001 — env broken, not agent
+                from ray_tpu.util import events
+                events.record("worker", "venv_failed", error=str(e))
+                # remembered per env so the lease reply can tell the
+                # caller WHY no worker appeared (vs mere saturation)
+                self._venv_errors[env_hash] = str(e)[:500]
+                return None
         if runtime_env:
             # Nested tasks submitted FROM this worker inherit its env
             # (reference: runtime_env inheritance parent -> child).
@@ -462,7 +500,7 @@ class NodeAgent:
             stdout = stderr = open(logpath, "ab", buffering=0)
         try:
             proc = await asyncio.create_subprocess_exec(
-                sys.executable, "-m", "ray_tpu.runtime.worker", env=env,
+                python, "-m", "ray_tpu.runtime.worker", env=env,
                 stdout=stdout, stderr=stderr)
         finally:
             if stdout is not None:
@@ -692,11 +730,12 @@ class NodeAgent:
             except asyncio.TimeoutError:
                 return {"error": "lease timeout"}
         from ray_tpu.runtime.runtime_env import env_hash as _ehash
-        w = await self._get_worker(runtime_env, _ehash(runtime_env))
+        eh = _ehash(runtime_env)
+        w = await self._get_worker(runtime_env, eh)
         if w is None:
             self._release_res(resources, pg_id, bundle_index)
             self._drain_queue()
-            return {"error": "no worker available"}
+            return {"error": self._no_worker_error(eh)}
         self._lease_seq += 1
         lease_id = f"{self.node_id.hex()[:8]}:{self._lease_seq}"
         w.state = LEASED
@@ -863,10 +902,11 @@ class NodeAgent:
                         "error": f"insufficient resources for actor "
                                  f"{resources} (timed out queued)"}
         from ray_tpu.runtime.runtime_env import env_hash as _ehash
-        w = await self._get_worker(runtime_env, _ehash(runtime_env))
+        eh = _ehash(runtime_env)
+        w = await self._get_worker(runtime_env, eh)
         if w is None:
             self._release_res(resources, pg_id, bundle_index)
-            return {"ok": False, "error": "no worker available"}
+            return {"ok": False, "error": self._no_worker_error(eh)}
         w.state = ACTOR
         w.actor_id = actor_id
         w.actor_resources = dict(resources)

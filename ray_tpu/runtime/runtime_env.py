@@ -3,12 +3,17 @@
 Scoped analog of the reference's runtime_env plugin system (reference:
 python/ray/_private/runtime_env/plugin.py, runtime_env/agent/main.py):
 supported fields are `env_vars`, `working_dir` (a local path the worker
-chdirs into), and `py_modules` (paths prepended to PYTHONPATH). Workers
-are pooled PER runtime env — a task never executes in a worker carrying
-another env's variables (reference keys its worker pool the same way,
-raylet/worker_pool.cc runtime_env_hash). Network-dependent fields (pip,
-conda, container, uv) are rejected up front: this runtime targets
-hermetic TPU pods where images carry the deps.
+chdirs into), `py_modules` (paths prepended to PYTHONPATH), and
+`pip`/`uv` (extra packages in a CACHED per-requirements venv, reference:
+_private/runtime_env/{pip,uv}.py). Workers are pooled PER runtime env —
+a task never executes in a worker carrying another env's variables
+(reference keys its worker pool the same way, raylet/worker_pool.cc
+runtime_env_hash). pip/uv venvs are created with --system-site-packages
+so the image's jax/ray_tpu stay importable and only the delta installs;
+cache lives under $RAY_TPU_VENV_CACHE (default ~/.cache/ray_tpu/venvs)
+keyed by the requirement set, so the second task with the same deps
+pays nothing. conda/container stay rejected (image-level concerns on
+hermetic TPU pods).
 """
 
 from __future__ import annotations
@@ -16,10 +21,22 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-from typing import Optional
+from typing import List, Optional
 
-SUPPORTED = ("env_vars", "working_dir", "py_modules")
-UNSUPPORTED = ("pip", "conda", "container", "uv", "java_jars")
+SUPPORTED = ("env_vars", "working_dir", "py_modules", "pip", "uv")
+UNSUPPORTED = ("conda", "container", "java_jars")
+
+
+def _normalize_pkgs(v, field: str) -> List[str]:
+    if isinstance(v, dict):
+        v = v.get("packages", [])
+    if isinstance(v, str):
+        v = [v]
+    if not isinstance(v, (list, tuple)) or \
+            not all(isinstance(x, str) for x in v):
+        raise ValueError(f"{field} must be a list of requirement "
+                         f"strings (or {{'packages': [...]}})")
+    return sorted(set(v))
 
 
 def validate(runtime_env: Optional[dict]) -> Optional[dict]:
@@ -29,14 +46,21 @@ def validate(runtime_env: Optional[dict]) -> Optional[dict]:
     bad = [k for k in runtime_env if k in UNSUPPORTED]
     if bad:
         raise ValueError(
-            f"runtime_env fields {bad} are not supported (no package "
-            f"installation at task time — bake dependencies into the "
-            f"image); supported: {list(SUPPORTED)}")
+            f"runtime_env fields {bad} are not supported (image-level "
+            f"concerns — bake them into the pod image); supported: "
+            f"{list(SUPPORTED)}")
     unknown = [k for k in runtime_env if k not in SUPPORTED]
     if unknown:
         raise ValueError(f"unknown runtime_env fields {unknown}; "
                          f"supported: {list(SUPPORTED)}")
     out = {}
+    if runtime_env.get("pip") and runtime_env.get("uv"):
+        raise ValueError("specify pip OR uv, not both")
+    for field in ("pip", "uv"):
+        if runtime_env.get(field):
+            pkgs = _normalize_pkgs(runtime_env[field], field)
+            if pkgs:
+                out[field] = pkgs
     ev = runtime_env.get("env_vars")
     if ev:
         if not all(isinstance(k, str) and isinstance(v, str)
@@ -91,7 +115,7 @@ def from_key(key) -> Optional[dict]:
     for k, v in key:
         if k == "env_vars":
             out[k] = dict(v)
-        elif k == "py_modules":
+        elif k in ("py_modules", "pip", "uv"):
             out[k] = list(v)
         else:
             out[k] = v
@@ -104,6 +128,105 @@ def env_hash(runtime_env: Optional[dict]) -> str:
         return ""
     blob = json.dumps(runtime_env, sort_keys=True).encode()
     return hashlib.sha1(blob).hexdigest()[:16]
+
+
+# --- pip/uv cached venvs ----------------------------------------------
+
+def _venv_cache_dir() -> str:
+    return os.environ.get(
+        "RAY_TPU_VENV_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "ray_tpu",
+                     "venvs"))
+
+
+def venv_key(packages: List[str]) -> str:
+    import sys
+    blob = json.dumps([sys.version_info[:2], sorted(packages)],
+                      default=str).encode()
+    return hashlib.sha1(blob).hexdigest()[:16]
+
+
+# requirement-set key -> (monotonic ts, error). A failed install is not
+# retried for _FAIL_TTL_S: without this, every task with the same broken
+# requirements pays the full multi-minute install-and-fail again.
+_FAILED_VENVS: dict = {}
+_FAIL_TTL_S = 60.0
+
+
+def ensure_venv(packages: List[str], prefer_uv: bool = False) -> str:
+    """Create-or-reuse a venv holding `packages`; returns its python.
+    Cached per requirement set + interpreter minor version; concurrent
+    creators serialize on a file lock and the build lands via atomic
+    rename, so a crashed installer never leaves a half-venv behind
+    (reference: _private/runtime_env/{pip.py,uv.py} cached per-URI
+    environments)."""
+    import fcntl
+    import shutil
+    import subprocess
+    import sys
+    import time as _time
+    root = _venv_cache_dir()
+    os.makedirs(root, exist_ok=True)
+    key = venv_key(packages)
+    final = os.path.join(root, key)
+    py = os.path.join(final, "bin", "python")
+    if os.path.exists(py):
+        return py
+    failed = _FAILED_VENVS.get(key)
+    if failed is not None:
+        ts, err = failed
+        if _time.monotonic() - ts < _FAIL_TTL_S:
+            raise RuntimeError(
+                f"runtime_env install recently failed (cached "
+                f"{_FAIL_TTL_S:.0f}s): {err}")
+        del _FAILED_VENVS[key]
+    lock_path = os.path.join(root, f".{key}.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if os.path.exists(py):      # built while we waited on the lock
+            return py
+        tmp = f"{final}.tmp{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            # --system-site-packages: the delta installs on top of the
+            # image's jax/ray_tpu instead of re-resolving the world
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 tmp], check=True, capture_output=True)
+            tmp_py = os.path.join(tmp, "bin", "python")
+            uv = shutil.which("uv") if prefer_uv else None
+            if uv:
+                cmd = [uv, "pip", "install", "--python", tmp_py,
+                       *packages]
+            else:
+                cmd = [tmp_py, "-m", "pip", "install",
+                       "--disable-pip-version-check", *packages]
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=600)
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"runtime_env package install failed "
+                    f"({' '.join(packages)}): {r.stderr[-2000:]}")
+            os.replace(tmp, final)
+        except Exception as e:  # noqa: BLE001 — negative-cache + rethrow
+            _FAILED_VENVS[key] = (_time.monotonic(), str(e)[:500])
+            raise
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return py
+
+
+def venv_python(runtime_env: Optional[dict]) -> Optional[str]:
+    """The interpreter a worker for this env must run under, or None
+    for the base interpreter. BLOCKS on first use of a requirement set
+    (the agent calls it off-loop in an executor)."""
+    if not runtime_env:
+        return None
+    if runtime_env.get("uv"):
+        return ensure_venv(runtime_env["uv"], prefer_uv=True)
+    if runtime_env.get("pip"):
+        return ensure_venv(runtime_env["pip"], prefer_uv=False)
+    return None
 
 
 def apply_to_env(runtime_env: Optional[dict], env: dict) -> dict:

@@ -18,7 +18,11 @@ from ray_tpu.runtime.runtime_env import env_hash, merge, validate
 
 def test_validate_and_merge(tmp_path):
     with pytest.raises(ValueError, match="not supported"):
-        validate({"pip": ["requests"]})
+        validate({"conda": "env.yml"})
+    with pytest.raises(ValueError, match="pip OR uv"):
+        validate({"pip": ["a"], "uv": ["b"]})
+    assert validate({"pip": ["b", "a", "a"]}) == {"pip": ["a", "b"]}
+    assert validate({"uv": {"packages": ["x"]}}) == {"uv": ["x"]}
     with pytest.raises(ValueError, match="unknown"):
         validate({"envvars": {}})
     with pytest.raises(ValueError, match="Dict\\[str, str\\]"):
@@ -97,7 +101,79 @@ def test_unsupported_runtime_env_raises(cluster):
         return 1
 
     with pytest.raises(ValueError, match="not supported"):
-        f.options(runtime_env={"pip": ["x"]}).remote()
+        f.options(runtime_env={"container": {"image": "x"}}).remote()
+
+
+def _make_wheel(tmp_path, name="tinydep", version="0.7") -> str:
+    """Hand-roll a minimal pure-python wheel (a zip + dist-info) so the
+    pip-venv path is testable OFFLINE — no index access needed for a
+    dependency-free local wheel."""
+    import base64
+    import hashlib
+    import zipfile
+    whl = tmp_path / f"{name}-{version}-py3-none-any.whl"
+    di = f"{name}-{version}.dist-info"
+    files = {
+        f"{name}.py": f"VALUE = '{name}-{version}'\n",
+        f"{di}/METADATA": (f"Metadata-Version: 2.1\nName: {name}\n"
+                           f"Version: {version}\n"),
+        f"{di}/WHEEL": ("Wheel-Version: 1.0\nGenerator: test\n"
+                        "Root-Is-Purelib: true\nTag: py3-none-any\n"),
+    }
+    record_rows = []
+    with zipfile.ZipFile(whl, "w") as z:
+        for arc, content in files.items():
+            data = content.encode()
+            z.writestr(arc, data)
+            digest = base64.urlsafe_b64encode(
+                hashlib.sha256(data).digest()).rstrip(b"=").decode()
+            record_rows.append(f"{arc},sha256={digest},{len(data)}")
+        record_rows.append(f"{di}/RECORD,,")
+        z.writestr(f"{di}/RECORD", "\n".join(record_rows) + "\n")
+    return str(whl)
+
+
+def test_pip_runtime_env_cached_venv(cluster, tmp_path, monkeypatch):
+    """A task runs with a package the driver lacks, in a cached venv
+    (reference: _private/runtime_env/pip.py, uv.py). Offline-safe: the
+    'package' is a local dependency-free wheel. Second use must hit the
+    cache (exactly one venv dir)."""
+    import subprocess
+    import sys as _sys
+    monkeypatch.setenv("RAY_TPU_VENV_CACHE", str(tmp_path / "venvs"))
+    # venv creation itself must work in this image
+    probe = subprocess.run([_sys.executable, "-m", "venv",
+                            str(tmp_path / "probe")],
+                           capture_output=True)
+    if probe.returncode != 0:
+        pytest.skip("python -m venv unavailable")
+    wheel = _make_wheel(tmp_path)
+
+    @ray_tpu.remote
+    def use_dep():
+        import tinydep
+        return tinydep.VALUE, _sys.prefix
+
+    with pytest.raises(ImportError):
+        import tinydep  # noqa: F401 — the driver must NOT have it
+
+    rt = {"pip": [wheel]}
+    v1, prefix1 = ray_tpu.get(
+        use_dep.options(runtime_env=rt).remote(), timeout=300)
+    assert v1 == "tinydep-0.7"
+    assert str(tmp_path / "venvs") in prefix1   # ran under the venv
+    # second call: same cached venv, no new build
+    v2, prefix2 = ray_tpu.get(
+        use_dep.options(runtime_env=rt).remote(), timeout=120)
+    assert (v2, prefix2) == (v1, prefix1)
+    venvs = [d for d in (tmp_path / "venvs").iterdir() if d.is_dir()]
+    assert len(venvs) == 1, venvs
+
+
+def test_venv_key_stability():
+    from ray_tpu.runtime.runtime_env import venv_key
+    assert venv_key(["a", "b"]) == venv_key(["b", "a"])
+    assert venv_key(["a"]) != venv_key(["a", "b"])
 
 
 def test_job_submission_end_to_end(tmp_path):
