@@ -202,10 +202,10 @@ class ShmRingChannel:
         except Exception:
             pass
         self._seqs = None
-        try:
-            self._shm.close()
-        except Exception:
-            pass
+        # tolerant close: a reader may still hold a zero-copy payload
+        # view; leak the mapping rather than arm a raising finalizer
+        from ray_tpu.runtime.object_store import _safe_close
+        _safe_close(self._shm)
 
     def unlink(self):
         try:
