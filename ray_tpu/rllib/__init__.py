@@ -8,7 +8,8 @@ off-policy with a replay-buffer actor (DQN).
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.env import CartPoleVec, make_env
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
-__all__ = ["DQN", "DQNConfig", "PPO", "PPOConfig", "ReplayBuffer",
-           "CartPoleVec", "make_env"]
+__all__ = ["DQN", "DQNConfig", "IMPALA", "IMPALAConfig", "PPO",
+           "PPOConfig", "ReplayBuffer", "CartPoleVec", "make_env"]

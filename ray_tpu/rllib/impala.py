@@ -1,0 +1,211 @@
+"""IMPALA: asynchronous actor-learner with V-trace off-policy correction.
+
+Reference: python/ray/rllib/algorithms/impala/impala.py (async
+EnvRunner sampling pipelined against the learner, importance-weighted
+V-trace targets per Espeholt et al. 2018). The TPU-idiomatic shape:
+
+- rollout actors (the same EnvRunner PPO uses) sample with whatever
+  params they were LAST handed — the learner never blocks on a full
+  round of fragments,
+- the learner drains whichever fragments are ready (`ray_tpu.wait`),
+  applies one jitted V-trace update per fragment, and immediately
+  re-dispatches that runner with fresh weights,
+- staleness is therefore bounded by the pipeline depth (one in-flight
+  fragment per runner), and the V-trace rho/c clips correct for it —
+  the defining IMPALA trade.
+
+The whole V-trace recursion is a `lax.scan` (reverse) inside one jit:
+no per-step host work, static shapes (T, N) per fragment.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.ppo import EnvRunner, init_policy, policy_forward
+
+
+@partial(jax.jit, static_argnames=("gamma",))
+def _vtrace(behavior_logp, target_logp, rewards, dones, values,
+            last_value, gamma, rho_bar=1.0, c_bar=1.0):
+    """V-trace targets (Espeholt et al. 2018, eqs. 1-2). All inputs
+    (T, N); values under the TARGET policy. Returns (vs (T, N),
+    pg_advantages (T, N))."""
+    import jax.numpy as jnp
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), rho_bar)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), c_bar)
+    v_next = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    not_done = 1.0 - dones
+    deltas = rho * (rewards + gamma * v_next * not_done - values)
+
+    def step(acc, xs):
+        delta, c_t, nd = xs
+        acc = delta + gamma * c_t * nd * acc
+        return acc, acc
+
+    _, corrections = jax.lax.scan(
+        step, jnp.zeros_like(last_value),
+        (deltas, c, not_done), reverse=True)
+    vs = values + corrections
+    vs_next = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    pg_adv = rho * (rewards + gamma * vs_next * not_done - values)
+    return vs, pg_adv
+
+
+@partial(jax.jit, static_argnames=("lr", "gamma"))
+def impala_update(params, opt_state, batch, *, lr=6e-4, gamma=0.99,
+                  vf_coef=0.5, ent_coef=0.01, rho_bar=1.0, c_bar=1.0):
+    """One fragment's V-trace update. batch: obs (T, N, D), actions /
+    behavior_logp / rewards / dones (T, N), last_obs (N, D)."""
+    import jax.numpy as jnp
+    import optax
+
+    opt = optax.adam(lr)
+    T, N = batch["actions"].shape
+    obs_flat = batch["obs"].reshape(T * N, -1)
+
+    def loss_fn(p):
+        logits, values = policy_forward(p, obs_flat)
+        logits = logits.reshape(T, N, -1)
+        values = values.reshape(T, N)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        _, last_value = policy_forward(p, batch["last_obs"])
+        vs, pg_adv = _vtrace(
+            batch["behavior_logp"], target_logp, batch["rewards"],
+            batch["dones"], values, last_value, gamma,
+            rho_bar=rho_bar, c_bar=c_bar)
+        # targets don't backprop into the value baseline
+        vs = jax.lax.stop_gradient(vs)
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+        pi_loss = -(target_logp * pg_adv).mean()
+        v_loss = ((values - vs) ** 2).mean()
+        probs = jax.nn.softmax(logits)
+        entropy = -(probs * jnp.log(probs + 1e-9)).sum(-1).mean()
+        total = pi_loss + vf_coef * v_loss - ent_coef * entropy
+        return total, (pi_loss, v_loss, entropy,
+                       jnp.exp(target_logp - batch["behavior_logp"]))
+
+    (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = opt.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss, aux[3].mean()
+
+
+@dataclass
+class IMPALAConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 8
+    rollout_len: int = 64
+    lr: float = 6e-4
+    gamma: float = 0.99
+    vf_coef: float = 0.5
+    ent_coef: float = 0.01
+    rho_bar: float = 1.0        # V-trace importance clips
+    c_bar: float = 1.0
+    # fragments consumed per train() call; runners keep sampling
+    # regardless (async pipeline)
+    fragments_per_iter: int = 2
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    runner_options: dict = field(default_factory=dict)
+
+
+class IMPALA:
+    """Async actor-learner. `train()` consumes whatever fragments are
+    ready (never a barrier over all runners) and re-dispatches each
+    producer with fresh weights."""
+
+    def __init__(self, config: IMPALAConfig):
+        import optax
+        self.cfg = config
+        env = make_env(config.env, 1, 0)
+        self.obs_dim, self.n_actions = env.OBS_DIM, env.N_ACTIONS
+        self.params = init_policy(
+            jax.random.PRNGKey(config.seed), self.obs_dim,
+            self.n_actions, config.hidden)
+        self.opt_state = optax.adam(config.lr).init(self.params)
+        self.runners: List = [
+            EnvRunner.options(**config.runner_options).remote(
+                config.env, config.num_envs_per_runner,
+                config.rollout_len, config.seed + 100 + i)
+            for i in range(config.num_env_runners)]
+        # ref -> runner index; every runner always has one fragment
+        # in flight (sampled with the weights it was last handed)
+        self._inflight: Dict = {}
+        host_params = jax.device_get(self.params)
+        for i, r in enumerate(self.runners):
+            self._inflight[r.sample.remote(host_params)] = i
+        self._iter = 0
+        self._returns = []
+
+    def train(self) -> dict:
+        import jax.numpy as jnp
+        self._iter += 1
+        consumed = 0
+        losses, rhos = [], []
+        while consumed < self.cfg.fragments_per_iter:
+            ready, _ = ray_tpu.wait(
+                list(self._inflight), num_returns=1, timeout=300)
+            if not ready:
+                raise TimeoutError("no rollout fragment within 300s")
+            for ref in ready:
+                idx = self._inflight.pop(ref)
+                frag = ray_tpu.get(ref, timeout=60)
+                batch = {
+                    "obs": jnp.asarray(frag["obs"]),
+                    "actions": jnp.asarray(frag["actions"]),
+                    "behavior_logp": jnp.asarray(frag["logp"]),
+                    "rewards": jnp.asarray(frag["rewards"]),
+                    "dones": jnp.asarray(frag["dones"]),
+                    # bootstrap from the runner's final observation,
+                    # evaluated under the CURRENT params in-update
+                    "last_obs": jnp.asarray(frag["last_obs"]),
+                }
+                self.params, self.opt_state, loss, rho = impala_update(
+                    self.params, self.opt_state, batch,
+                    lr=self.cfg.lr, gamma=self.cfg.gamma,
+                    vf_coef=self.cfg.vf_coef,
+                    ent_coef=self.cfg.ent_coef,
+                    rho_bar=self.cfg.rho_bar, c_bar=self.cfg.c_bar)
+                losses.append(float(loss))
+                rhos.append(float(rho))
+                if len(frag["episode_returns"]):
+                    self._returns.extend(
+                        frag["episode_returns"].tolist())
+                    self._returns = self._returns[-100:]
+                # re-dispatch the SAME runner with fresh weights —
+                # the other runners' in-flight fragments stay stale
+                # (V-trace corrects them on arrival)
+                host_params = jax.device_get(self.params)
+                self._inflight[
+                    self.runners[idx].sample.remote(host_params)] = idx
+                consumed += 1
+        return {
+            "training_iteration": self._iter,
+            "episode_reward_mean": float(np.mean(self._returns))
+            if self._returns else 0.0,
+            "loss": float(np.mean(losses)),
+            "mean_rho": float(np.mean(rhos)),
+            "timesteps_this_iter": consumed
+            * self.cfg.num_envs_per_runner * self.cfg.rollout_len,
+        }
+
+    def get_policy_params(self):
+        return jax.device_get(self.params)
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -103,6 +103,9 @@ class EnvRunner:
         _, last_v = map(np.asarray, self._forward(params, self.obs))
         batch = {k: np.stack(v) for k, v in out.items()}  # (T, N, ...)
         batch["last_value"] = np.asarray(last_v)          # (N,)
+        # final observation: off-policy learners (IMPALA) bootstrap
+        # from it under the CURRENT params instead of trusting last_value
+        batch["last_obs"] = np.asarray(self.obs)          # (N, D)
         batch["episode_returns"] = np.array(
             self.done_returns, np.float32)
         return batch
