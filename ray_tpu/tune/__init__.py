@@ -5,7 +5,8 @@ schedulers/async_hyperband.py, search/sample.py)."""
 
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      PopulationBasedTraining)
-from ray_tpu.tune.search import (Categorical, Domain, Float, Integer,
+from ray_tpu.tune.search import (BasicVariantSearcher, Categorical, Domain,
+                                 Float, Integer, Searcher, TPESearcher,
                                  choice, grid_search, loguniform, randint,
                                  uniform)
 from ray_tpu.tune.tuner import (Result, ResultGrid, TrialStopped,
@@ -13,7 +14,8 @@ from ray_tpu.tune.tuner import (Result, ResultGrid, TrialStopped,
 
 __all__ = [
     "ASHAScheduler", "FIFOScheduler", "PopulationBasedTraining",
-    "Categorical", "Domain", "Float", "Integer", "choice", "grid_search",
-    "loguniform", "randint", "uniform", "Result", "ResultGrid",
-    "TrialStopped", "TuneConfig", "Tuner", "get_checkpoint", "report",
+    "BasicVariantSearcher", "Categorical", "Domain", "Float", "Integer",
+    "Searcher", "TPESearcher", "choice", "grid_search", "loguniform",
+    "randint", "uniform", "Result", "ResultGrid", "TrialStopped",
+    "TuneConfig", "Tuner", "get_checkpoint", "report",
 ]

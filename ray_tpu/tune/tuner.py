@@ -156,6 +156,10 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Any = None
+    # a tune.search.Searcher (e.g. TPESearcher) that proposes configs
+    # sequentially from observed results; None = pre-expanded
+    # grid x random variants (reference: tune_config.py search_alg)
+    search_alg: Any = None
     seed: Optional[int] = None
     resources_per_trial: Optional[Dict[str, float]] = None
 
@@ -329,8 +333,15 @@ class Tuner:
         if getattr(scheduler, "metric", None) is None and cfg.metric:
             scheduler.metric = cfg.metric
             scheduler.mode = cfg.mode
-        configs = generate_variants(self._space, cfg.num_samples, cfg.seed)
-        trials = [_Trial(uuid.uuid4().hex[:8], c) for c in configs]
+        searcher = cfg.search_alg
+        if searcher is not None:
+            searcher.set_search_properties(cfg.metric, cfg.mode,
+                                           self._space)
+            trials = []          # suggested lazily as slots free up
+        else:
+            configs = generate_variants(self._space, cfg.num_samples,
+                                        cfg.seed)
+            trials = [_Trial(uuid.uuid4().hex[:8], c) for c in configs]
         nested = getattr(self._fn, "_nested_trainer", None)
         if nested is not None:
             # Trainer trials: the trial actor only coordinates (the
@@ -376,6 +387,8 @@ class Tuner:
                 checkpoint=checkpoint, all_reports=list(t.reports),
                 status=status)
             scheduler.on_trial_complete(t.trial_id, final_metrics)
+            if searcher is not None:
+                searcher.on_trial_complete(t.trial_id, final_metrics)
             try:
                 ray_tpu.kill(t.actor)
             except Exception:
@@ -393,7 +406,31 @@ class Tuner:
             r = results.get(donor_id)
             return r.checkpoint if r is not None else None
 
-        while pending or running:
+        suggested = 0
+
+        def _refill_from_searcher():
+            """Ask the searcher for new trials as slots free (sequential
+            model-based search: each suggest() may condition on every
+            result observed so far)."""
+            nonlocal suggested
+            while suggested < cfg.num_samples and \
+                    len(pending) + len(running) < limit:
+                tid = uuid.uuid4().hex[:8]
+                c = searcher.suggest(tid)
+                if c is None:
+                    suggested = cfg.num_samples   # searcher exhausted
+                    break
+                suggested += 1
+                t = _Trial(tid, c)
+                trials.append(t)    # ResultGrid orders by `trials`
+                pending.append(t)
+
+        while True:
+            if searcher is not None:
+                _refill_from_searcher()
+            if not pending and not running and (
+                    searcher is None or suggested >= cfg.num_samples):
+                break
             while pending and len(running) < limit:
                 t = pending.pop(0)
                 t.actor = actor_cls.remote()
