@@ -84,6 +84,11 @@ class ServeController:
         # while recovering, reconcile must not start replacement replicas
         # for deployments whose survivors are about to be adopted
         self._recovering = False
+        # recovery must SUCCEED once (KV read or legitimately empty)
+        # before the orphan sweep may kill anything — otherwise a head
+        # outage during recovery would turn survivors into "orphans"
+        self._recover_done = False
+        self._next_recover_retry = 0.0
         self._creating: set = set()    # replica names mid-create_actor
         self._last_orphan_sweep = 0.0
 
@@ -158,20 +163,28 @@ class ServeController:
                         blob = await ctx.pool.call(
                             ctx.head_addr, "kv_get", key=self.APPS_KV_KEY)
                         if not blob:
-                            return      # genuinely nothing deployed
+                            # genuinely nothing deployed
+                            self._recover_done = True
+                            return
                     actors = await ctx.pool.call(ctx.head_addr,
                                                  "list_actors")
                     break
                 except Exception:
                     if attempt == 4:
-                        if blob is None:
-                            return
-                        actors = []     # adopt nothing; reconcile heals
-                    else:
-                        await asyncio.sleep(0.5 * (attempt + 1))
+                        # head unreachable for the whole window: leave
+                        # _recover_done False — the reconcile loop
+                        # re-runs recovery until the KV is readable, and
+                        # the orphan sweep stays disarmed so survivors
+                        # keep serving in the meantime
+                        self._next_recover_retry = time.time() + 5.0
+                        return
+                    await asyncio.sleep(0.5 * (attempt + 1))
             try:
                 apps = cloudpickle.loads(blob)
             except Exception:
+                # corrupt blob: retrying cannot help; arm the sweep so
+                # the cluster at least converges on explicit redeploys
+                self._recover_done = True
                 return
             # name -> (rid, actor_id) of live replicas left behind
             survivors: Dict[str, List] = {}
@@ -215,6 +228,7 @@ class ServeController:
                             await ctx.kill_actor(actor_id, no_restart=True)
                         except Exception:
                             pass
+            self._recover_done = True
         finally:
             self._recovering = False
 
@@ -397,6 +411,14 @@ class ServeController:
         if self._recovering:
             return
         now = time.time()
+        if not self._recover_done:
+            # recovery gave up on a transient head outage: keep
+            # retrying until the KV is readable; replicas are neither
+            # adopted nor reaped until then
+            if now >= self._next_recover_retry:
+                self._next_recover_retry = now + 5.0
+                await self._recover()
+            return
         if now - getattr(self, "_last_orphan_sweep", 0.0) > \
                 self.ORPHAN_SWEEP_INTERVAL_S:
             self._last_orphan_sweep = now

@@ -1,0 +1,159 @@
+"""Serve-level LLM benchmark: HTTP proxy -> replica TTFT + throughput.
+
+Unlike scripts/llm_bench.py (engine-level), this drives the FULL serving
+path the north star names: client -> HTTP proxy (SSE streaming) ->
+router -> replica -> continuous-batching engine on the chip. TTFT is
+measured at the CLIENT: time from request start to the first SSE data
+event.
+
+Run: PYTHONPATH=. python scripts/serve_bench.py [--requests N]
+Prints one JSON line (commit to SERVE_BENCH.json). On tunneled-TPU dev
+boxes both TTFT and tok/s are tunnel-RTT-bound (~120ms/sync) — see the
+caveat field.
+
+Reference harness shape: release/llm_tests/serve/ (vLLM serve benchmark
+drives the HTTP endpoint and reports TTFT percentiles).
+"""
+
+import argparse
+import http.client
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def _one_request(addr, prompt, max_new, out, idx):
+    t0 = time.monotonic()
+    conn = http.client.HTTPConnection(addr["host"], addr["port"],
+                                      timeout=600)
+    conn.request(
+        "POST", "/bench",
+        body=json.dumps({"tokens": prompt, "max_new_tokens": max_new}),
+        headers={"Content-Type": "application/json",
+                 "Accept": "text/event-stream"})
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.status
+    ttft = None
+    n_tokens = 0
+    buf = b""
+    while True:
+        chunk = resp.read(1)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            if line.startswith(b"data: ") and b"token" in line:
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+                n_tokens += 1
+    conn.close()
+    out[idx] = {"ttft_s": ttft, "tokens": n_tokens,
+                "total_s": time.monotonic() - t0}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="bench340m")
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=48)
+    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--steps-per-sync", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMConfig, build_llm_deployment
+
+    if args.model == "bench340m":
+        overrides = dict(
+            vocab_size=32000, dim=1024, n_layers=16, n_heads=16,
+            n_kv_heads=16, ffn_dim=2816, max_seq_len=1024,
+            dtype="bfloat16", logits_dtype="float32",
+            attn_impl="reference")
+        model = "tiny"
+    else:
+        overrides = dict(dtype="bfloat16", logits_dtype="float32",
+                         attn_impl="reference")
+        model = args.model
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        cfg = LLMConfig(
+            model=model, model_overrides=overrides,
+            max_slots=args.slots, max_len=1024,
+            prefill_buckets=(64, 256),
+            steps_per_sync=args.steps_per_sync)
+        serve.run(build_llm_deployment(cfg, name="bench"),
+                  name="bench_app", route_prefix="/bench",
+                  ready_timeout_s=600)
+        addr = serve.proxy_address()
+
+        # warmup: compile prefill buckets + decode block on the chip
+        warm = {}
+        _one_request(addr, [1, 2, 3], args.steps_per_sync + 1, warm, 0)
+
+        rng = np.random.default_rng(0)
+        prompts = [
+            [int(x) for x in rng.integers(1, 31999,
+                                          size=args.prompt_len)]
+            for _ in range(args.requests)]
+        results = [None] * args.requests
+        t0 = time.monotonic()
+        cursor = 0
+        while cursor < args.requests:
+            batch = range(cursor,
+                          min(cursor + args.concurrency, args.requests))
+            threads = [
+                threading.Thread(target=_one_request,
+                                 args=(addr, prompts[i], args.max_new,
+                                       results, i))
+                for i in batch]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            cursor += args.concurrency
+        wall = time.monotonic() - t0
+
+        ttfts = sorted(r["ttft_s"] for r in results
+                       if r and r["ttft_s"] is not None)
+        toks = sum(r["tokens"] for r in results if r)
+        assert ttfts and toks, results[:3]
+        dev = jax.devices()[0]
+        p = lambda q: ttfts[min(len(ttfts) - 1,  # noqa: E731
+                                int(q * len(ttfts)))]
+        print(json.dumps({
+            "metric": "llm_serve_ttft_p50",
+            "value": round(p(0.50) * 1000, 1), "unit": "ms",
+            "ttft_p95_ms": round(p(0.95) * 1000, 1),
+            "ttft_max_ms": round(ttfts[-1] * 1000, 1),
+            "throughput_tok_s": round(toks / wall, 1),
+            "requests": args.requests,
+            "concurrency": args.concurrency,
+            "prompt_len": args.prompt_len, "max_new": args.max_new,
+            "slots": args.slots, "steps_per_sync": args.steps_per_sync,
+            "path": "client->HTTP proxy (SSE)->router->replica->engine",
+            "device": getattr(dev, "device_kind", str(dev)),
+            "caveat": ("dev-box numbers are tunnel-RTT-bound "
+                       "(~120ms per device<->host sync)"),
+        }))
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
