@@ -65,6 +65,10 @@ class Config:
     default_max_task_retries: int = 3
     default_max_actor_restarts: int = 0
     actor_call_queue_depth: int = 10_000
+    # how long an actor's __init__ may run (model-loading actors — an
+    # LLM replica binding hundreds of MB of weights over a slow device
+    # link — legitimately take minutes)
+    actor_init_timeout_s: float = 600.0
 
     # --- memory monitor (0 = disabled) ---
     memory_monitor_interval_s: float = 0.0

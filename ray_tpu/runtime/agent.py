@@ -914,7 +914,8 @@ class NodeAgent:
         try:
             r = await self.pool.call(
                 w.addr, "host_actor", actor_id=actor_id,
-                creation_spec=creation_spec, timeout=120.0)
+                creation_spec=creation_spec,
+                timeout=self.config.actor_init_timeout_s)
             if not r.get("ok"):
                 raise RuntimeError(r.get("error", "host_actor failed"))
         except Exception as e:  # noqa: BLE001

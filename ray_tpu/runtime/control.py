@@ -542,7 +542,8 @@ class ControlService:
                 resources["_pg"] = info.pg[0]
                 resources["_pg_bundle"] = info.pg[1]
             r = await self.pool.call(
-                node.addr, "start_actor", timeout=120.0,
+                node.addr, "start_actor",
+                timeout=self.config.actor_init_timeout_s + 30.0,
                 actor_id=info.actor_id, creation_spec=info.creation_spec,
                 resources=resources, runtime_env=info.runtime_env)
             if not r.get("ok"):

@@ -53,6 +53,7 @@ class _DeploymentState:
         self.target = self._initial_target()
         self.last_scale_up_signal = time.time()
         self.last_scale_change = 0.0
+        self.creating = 0     # replica create_actor calls in flight
         # gang scheduling (spec["gang"]): one PG, one bundle per replica
         self.pg_id = None
         self.pg_creating = False
@@ -90,6 +91,7 @@ class ServeController:
         self._recover_done = False
         self._next_recover_retry = 0.0
         self._creating: set = set()    # replica names mid-create_actor
+        self._gang_slots_creating: Dict[str, set] = {}
         self._last_orphan_sweep = 0.0
 
     # -- internal async cluster ops ---------------------------------------
@@ -491,12 +493,14 @@ class ServeController:
                     dep.pg_creating = True
                     asyncio.ensure_future(self._create_gang_pg(dep))
                 return
-        # 3b. scale toward target
+        # 3b. scale toward target (in-flight creations count: actor
+        # __init__ may load a model for minutes and must not be
+        # double-started — or stall this loop — meanwhile)
         alive = [r for r in dep.replicas.values()
                  if r.state in ("STARTING", "RUNNING")]
-        missing = dep.target - len(alive)
+        missing = dep.target - len(alive) - dep.creating
         for _ in range(max(0, missing)):
-            await self._start_replica(dep)
+            self._start_replica(dep)
         if missing < 0:
             # stop the youngest excess replicas (oldest keep serving)
             excess = sorted(alive, key=lambda r: r.started_at)[missing:]
@@ -569,7 +573,11 @@ class ServeController:
         except Exception:
             return True  # can't tell; don't churn on a control hiccup
 
-    async def _start_replica(self, dep: _DeploymentState):
+    def _start_replica(self, dep: _DeploymentState):
+        """Schedule one replica creation WITHOUT blocking the reconcile
+        loop: an actor __init__ that loads a model can legitimately run
+        for minutes (config.actor_init_timeout_s), during which health
+        checks and other deployments must keep converging."""
         from ray_tpu.serve.replica import Replica
         rid = uuid.uuid4().hex[:8]
         name = f"SERVE_REPLICA:{dep.name}:{rid}"
@@ -580,32 +588,57 @@ class ServeController:
         if dep.pg_id is not None:
             used = {r.bundle_index for r in dep.replicas.values()
                     if r.bundle_index is not None}
+            used |= {i for i in self._gang_slots_creating.get(dep.name,
+                                                             set())}
             free = [i for i in range(dep.target) if i not in used]
             if not free:
                 return  # every gang slot is occupied
             bundle_index = free[0]
             pg = (dep.pg_id, bundle_index)
+            self._gang_slots_creating.setdefault(
+                dep.name, set()).add(bundle_index)
         self._creating.add(name)
-        try:
-            actor_id = await self._ctx().create_actor(
-                Replica,
-                (dep.name, rid, spec["cls_payload"],
-                 tuple(spec.get("init_args") or ()),
-                 dict(spec.get("init_kwargs") or {}),
-                 spec.get("user_config")),
-                {},
-                name=name, namespace="serve",
-                resources=resources,
-                pg=pg,
-                max_concurrency=int(spec.get("max_ongoing_requests", 16)),
-                lifetime="detached")
-            info = _ReplicaInfo(actor_id, name)
-            info.bundle_index = bundle_index
-            dep.replicas[rid] = info
-        except Exception:
-            return
-        finally:
-            self._creating.discard(name)
+        dep.creating += 1
+        gen = dep.pg_gen
+
+        async def create():
+            try:
+                actor_id = await self._ctx().create_actor(
+                    Replica,
+                    (dep.name, rid, spec["cls_payload"],
+                     tuple(spec.get("init_args") or ()),
+                     dict(spec.get("init_kwargs") or {}),
+                     spec.get("user_config")),
+                    {},
+                    name=name, namespace="serve",
+                    resources=resources,
+                    pg=pg,
+                    max_concurrency=int(
+                        spec.get("max_ongoing_requests", 16)),
+                    lifetime="detached")
+                info = _ReplicaInfo(actor_id, name)
+                info.bundle_index = bundle_index
+                if self.deployments.get(dep.name) is dep and \
+                        dep.pg_gen == gen:
+                    dep.replicas[rid] = info
+                else:
+                    # redeployed/deleted while creating: don't adopt
+                    # into stale state — the orphan sweep would race
+                    try:
+                        await self._ctx().kill_actor(actor_id,
+                                                     no_restart=True)
+                    except Exception:
+                        pass
+            except Exception:
+                pass
+            finally:
+                dep.creating -= 1
+                self._creating.discard(name)
+                if bundle_index is not None:
+                    self._gang_slots_creating.get(
+                        dep.name, set()).discard(bundle_index)
+
+        asyncio.ensure_future(create())
 
     # -- autoscaling -------------------------------------------------------
 

@@ -96,7 +96,20 @@ def main():
             steps_per_sync=args.steps_per_sync)
         serve.run(build_llm_deployment(cfg, name="bench"),
                   name="bench_app", route_prefix="/bench",
-                  ready_timeout_s=600)
+                  _blocking_ready=False)
+        # poll readiness with visible replica states (a silent 600s
+        # block makes tunnel-slow replica inits undiagnosable)
+        ctrl = ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+        deadline = time.monotonic() + 600
+        while True:
+            st = ray_tpu.get(ctrl.status.remote(), timeout=30)
+            reps = st.get("bench", {}).get("replicas", {})
+            if any(r["state"] == "RUNNING" for r in reps.values()):
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"replica never RUNNING: {st}")
+            print(f"# waiting: {st}", file=sys.stderr)
+            time.sleep(5)
         addr = serve.proxy_address()
 
         # warmup: compile prefill buckets + decode block on the chip
