@@ -99,6 +99,12 @@ class ServeController:
     def _ctx(self):
         return api._g.ctx
 
+    def _starting_timeout_s(self) -> float:
+        try:
+            return float(self._ctx().config.actor_init_timeout_s) + 60.0
+        except Exception:
+            return 660.0
+
     async def _acall(self, actor_id: ActorID, method: str, *args,
                      timeout: Optional[float] = 30.0, **kwargs):
         ctx = self._ctx()
@@ -458,7 +464,13 @@ class ServeController:
                     r.last_healthy = time.time()
                     dep.version += 1
                 except Exception:
-                    if time.time() - r.started_at > 120.0:
+                    # budget tracks the cluster's actor-init allowance:
+                    # create_actor returns at registration, so a
+                    # model-loading __init__ spends its minutes HERE in
+                    # STARTING — a short hardcoded cap would churn
+                    # replicas forever
+                    if time.time() - r.started_at > \
+                            self._starting_timeout_s():
                         r.state = "STOPPING"
             elif r.state == "RUNNING" and \
                     time.time() - r.last_healthy > HEALTH_CHECK_INTERVAL_S:
@@ -501,9 +513,14 @@ class ServeController:
         missing = dep.target - len(alive) - dep.creating
         for _ in range(max(0, missing)):
             self._start_replica(dep)
-        if missing < 0:
+        # Excess is judged against LIVE replicas only: an in-flight
+        # create can't serve traffic and can't be cancelled, so it must
+        # never cause a healthy replica to be stopped in its place.
+        excess_n = len(alive) - dep.target
+        if excess_n > 0:
             # stop the youngest excess replicas (oldest keep serving)
-            excess = sorted(alive, key=lambda r: r.started_at)[missing:]
+            excess = sorted(alive,
+                            key=lambda r: r.started_at)[-excess_n:]
             for r in excess:
                 r.state = "STOPPING"
                 dep.version += 1
@@ -619,7 +636,8 @@ class ServeController:
                 info = _ReplicaInfo(actor_id, name)
                 info.bundle_index = bundle_index
                 if self.deployments.get(dep.name) is dep and \
-                        dep.pg_gen == gen:
+                        dep.pg_gen == gen and \
+                        not dep.spec.get("_deleted"):
                     dep.replicas[rid] = info
                 else:
                     # redeployed/deleted while creating: don't adopt
