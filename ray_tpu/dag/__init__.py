@@ -15,9 +15,12 @@ overlapped and bounded buffering for backpressure.
     results = [f.get() for f in futs]
     cd.teardown()
 
-Channels are intra-host (POSIX shm) — the right transport for a TPU
-host driving multi-stage inference; cross-host tensor movement belongs
-to jit'd collectives over ICI, not the object plane.
+Same-node edges ride POSIX shm rings (two memcpys, no RPC); cross-node
+edges ride TCP channels with the same bounded-ring semantics
+(dag/channel.py TcpChannel) — the DCN substrate for pipeline-parallel
+inference across hosts/slices. WITHIN a slice, cross-chip tensor
+movement still belongs to jit'd collectives over ICI, not the object
+plane.
 """
 
 from __future__ import annotations
@@ -100,9 +103,16 @@ class CompiledDag:
         self._nodes.append(node)  # post-order == topological
 
     def _validate(self):
-        """Reject dag shapes that would hang opaquely at runtime."""
+        """Reject dag shapes that would hang opaquely at runtime, and
+        record each node's placement: same-node edges get shm rings,
+        cross-node edges get TCP channels (the DCN substrate for
+        pipeline-parallel inference across hosts/slices — reference:
+        experimental/channel/ crosses nodes via plasma + torch channel;
+        here a credit-windowed socket preserves ring semantics)."""
         from ray_tpu.api import _require_init, _run
         ctx = _require_init()
+        self._driver_node = ctx.node_id
+        self._node_placement = []      # node idx -> cluster node_id
         seen_actors = set()
         for n in self._nodes:
             aid = n.handle._actor_id
@@ -117,18 +127,51 @@ class CompiledDag:
                                actor_id=aid, wait_timeout=60.0))
             info = _run(ctx.pool.call(ctx.head_addr, "get_actor",
                                       actor_id=aid))
-            if info and info.get("node_id") not in (None, ctx.node_id):
-                # Channels are POSIX shm — same-host only.
-                raise ValueError(
-                    "compiled dags require all actors on the driver's "
-                    "host (shm channels); schedule them with node labels "
-                    f"(actor {aid} is on {info['node_id']})")
+            self._node_placement.append(
+                (info or {}).get("node_id") or ctx.node_id)
 
-    def _new_chan(self) -> ShmRingChannel:
-        ch = ShmRingChannel(create=True, nslots=self._nslots,
-                            slot_bytes=self._slot_bytes)
-        self._channels.append(ch)
-        return ch
+    def _local(self, i: Optional[int]) -> bool:
+        """True when dag node i (None = the driver) runs on the
+        driver's cluster node — only then is a POSIX shm ring valid
+        (created driver-side, attached by name)."""
+        return i is None or self._node_placement[i] == self._driver_node
+
+    def _new_edge(self, producer: Optional[int],
+                  consumer: Optional[int]) -> dict:
+        """Channel spec for one edge; driver-owned endpoints are
+        constructed eagerly (shm segment, or the tcp endpoint for the
+        driver's side of a cross-node edge). Co-located NON-driver
+        stages get a lazily-created shm ring (consumer creates it at
+        attach); only genuinely cross-node edges pay TCP."""
+        import uuid as _uuid
+
+        from ray_tpu.dag.channel import TcpChannel, new_tcp_spec
+        if self._local(producer) and self._local(consumer):
+            ch = ShmRingChannel(create=True, nslots=self._nslots,
+                                slot_bytes=self._slot_bytes)
+            self._channels.append(ch)
+            if producer is None:
+                self._input_chans.append(ch)
+            if consumer is None:
+                self._sink_chan = ch
+            return ch.spec()
+        if producer is not None and consumer is not None and \
+                self._node_placement[producer] == \
+                self._node_placement[consumer]:
+            # same remote node: shm ring created by the consumer side
+            return {"name": f"rtch-{_uuid.uuid4().hex[:16]}",
+                    "nslots": self._nslots,
+                    "slot_bytes": self._slot_bytes, "lazy": True}
+        spec = new_tcp_spec(self._nslots, self._slot_bytes)
+        if producer is None:
+            ch = TcpChannel(spec, "producer")
+            self._channels.append(ch)
+            self._input_chans.append(ch)
+        if consumer is None:
+            ch = TcpChannel(spec, "consumer")  # publishes endpoint now
+            self._channels.append(ch)
+            self._sink_chan = ch
+        return spec
 
     def _build(self, sink: MethodNode):
         idx = {id(n): i for i, n in enumerate(self._nodes)}
@@ -139,20 +182,19 @@ class CompiledDag:
         for i, n in enumerate(self._nodes):
             for a in n.args:
                 if isinstance(a, InputNode):
-                    ch = self._new_chan()
-                    self._input_chans.append(ch)
-                    self._in_chans[i].append(ch.spec())
+                    spec = self._new_edge(None, i)
+                    self._in_chans[i].append(spec)
                     self._templates[i].append(("chan", None))
                 elif isinstance(a, MethodNode):
-                    ch = self._new_chan()
-                    self._out_chans[idx[id(a)]].append(ch.spec())
-                    self._in_chans[i].append(ch.spec())
+                    spec = self._new_edge(idx[id(a)], i)
+                    self._out_chans[idx[id(a)]].append(spec)
+                    self._in_chans[i].append(spec)
                     self._templates[i].append(("chan", None))
                 else:
                     self._templates[i].append(("const", dumps_oob(a)))
         # sink -> driver
-        self._sink_chan = self._new_chan()
-        self._out_chans[idx[id(sink)]].append(self._sink_chan.spec())
+        self._out_chans[idx[id(sink)]].append(
+            self._new_edge(idx[id(sink)], None))
 
     def _start(self):
         from ray_tpu.api import ActorMethod

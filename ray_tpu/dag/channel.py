@@ -221,3 +221,324 @@ class ShmRingChannel:
     def attach(cls, spec: dict) -> "ShmRingChannel":
         return cls(spec["name"], nslots=spec["nslots"],
                    slot_bytes=spec["slot_bytes"])
+
+
+# --- cross-host channel ------------------------------------------------
+
+_FRAME_HDR = 5          # u32 length (LE) + u8 kind
+_ACK = b"\x06"
+
+
+def _kv(method, **kw):
+    from ray_tpu import api
+    ctx = api._require_init()
+    return api._run(ctx.pool.call(ctx.head_addr, method, **kw))
+
+
+def _advertise_host() -> str:
+    """The address peers on OTHER hosts can reach this process at: the
+    node agent's bind host (workers carry it in RAY_TPU_AGENT_HOST;
+    real multi-host deployments start nodes with --node-host <ip>).
+    The listener itself binds 0.0.0.0, so any routable name works."""
+    import os
+    h = os.environ.get("RAY_TPU_AGENT_HOST")
+    if h and h != "0.0.0.0":
+        return h
+    from ray_tpu import api
+    ctx = api._require_init()
+    if getattr(api._g, "agent", None) is not None and \
+            api._g.agent.addr and api._g.agent.addr[0] != "0.0.0.0":
+        return api._g.agent.addr[0]
+    return ctx.addr[0] if ctx.addr else "127.0.0.1"
+
+
+class TcpChannel:
+    """SPSC channel across HOSTS: the DCN substrate compiled graphs
+    need for pipeline-parallel inference across slices (reference:
+    experimental/channel/shared_memory_channel.py crosses nodes by
+    round-tripping plasma; here frames flow producer -> consumer over
+    one TCP connection with credit-based flow control that preserves
+    the shm ring's bounded-buffer semantics: at most `nslots` frames
+    in flight, each ACKed when the consumer releases its slot).
+
+    Endpoint negotiation rides the control KV — the one address every
+    participant already shares: the consumer binds an ephemeral port on
+    its host and publishes ``host:port`` under the channel id; the
+    producer polls the key and connects. Same duck-type as
+    ShmRingChannel (write / read_with / read_bytes / has_space /
+    close / unlink / spec), so the dag runtime treats edges uniformly.
+    """
+
+    KV_PREFIX = "__dagch:"
+
+    def __init__(self, spec: dict, role: str):
+        assert role in ("producer", "consumer"), role
+        self.id = spec["id"]
+        self.nslots = spec["nslots"]
+        self.slot_bytes = spec["slot_bytes"]
+        self.role = role
+        self._sock = None
+        self._listener = None
+        self._inflight = 0          # producer: un-ACKed frames
+        self._rbuf = bytearray()    # consumer: partial-read resume
+        self._ident_left = 0        # consumer: handshake bytes pending
+        self._pending_hdr = None    # consumer: parsed frame header
+        if role == "consumer":
+            import socket
+            self._listener = socket.socket(socket.AF_INET,
+                                           socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET,
+                                      socket.SO_REUSEADDR, 1)
+            self._listener.bind(("0.0.0.0", 0))
+            self._listener.listen(1)
+            port = self._listener.getsockname()[1]
+            _kv("kv_put", key=self.KV_PREFIX + self.id,
+                value=f"{_advertise_host()}:{port}".encode())
+
+    # --- connection ----------------------------------------------------
+
+    def _ensure_conn(self, timeout: Optional[float]):
+        if self._sock is not None:
+            if self._ident_left:     # resume a half-done handshake
+                self._check_ident(timeout)
+            return
+        import socket
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        if self.role == "consumer":
+            self._listener.settimeout(timeout)
+            try:
+                self._sock, _ = self._listener.accept()
+            except (socket.timeout, BlockingIOError):
+                # BlockingIOError: timeout == 0.0 puts the socket in
+                # non-blocking mode (driver-side opportunistic polls)
+                raise ChannelTimeout("no producer connected")
+            self._sock.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+            self._ident_left = len(self.id)
+            self._check_ident(timeout)
+            return
+        else:
+            while True:
+                blob = _kv("kv_get", key=self.KV_PREFIX + self.id)
+                if blob:
+                    break
+                if deadline is not None and \
+                        time.monotonic() > deadline:
+                    raise ChannelTimeout("consumer endpoint not "
+                                         "published")
+                time.sleep(0.02)
+            host, port = blob.decode().rsplit(":", 1)
+            self._sock = socket.create_connection(
+                (host, int(port)), timeout=timeout)
+            self._sock.sendall(self.id.encode())
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _check_ident(self, timeout: Optional[float]):
+        """Finish the producer's channel-id handshake; resumable — a
+        0-timeout poll that catches the connection mid-handshake keeps
+        its progress instead of desynchronizing the frame stream."""
+        while self._ident_left > 0:
+            got = self._fill(self._ident_left, timeout)
+            self._ident_left -= got
+        ident = bytes(self._rbuf[:len(self.id)])
+        del self._rbuf[:len(self.id)]
+        if ident.decode(errors="replace") != self.id:
+            self._sock.close()
+            self._sock = None
+            raise ChannelClosed("wrong channel id from producer")
+
+    def _fill(self, want: int, timeout: Optional[float]) -> int:
+        """recv up to `want` bytes into the resume buffer; returns the
+        count (>=1) or raises ChannelTimeout with progress KEPT."""
+        import socket
+        self._sock.settimeout(timeout)
+        try:
+            chunk = self._sock.recv(max(want, 1))
+        except (socket.timeout, BlockingIOError):
+            raise ChannelTimeout("channel recv timed out")
+        if not chunk:
+            raise ChannelClosed("peer closed")
+        self._rbuf += chunk
+        return len(chunk)
+
+    def _recv_exact(self, n: int, timeout: Optional[float]) -> bytes:
+        """Read exactly n bytes honoring the caller's TOTAL budget;
+        partial progress survives a timeout in self._rbuf, so the next
+        call resumes the same frame instead of tearing the protocol."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while len(self._rbuf) < n:
+            left = None
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if len(self._rbuf) < n and left <= 0 \
+                        and timeout != 0.0:
+                    raise ChannelTimeout("channel recv timed out")
+                left = max(left, 0.0)
+            self._fill(n - len(self._rbuf), left)
+        out = bytes(self._rbuf[:n])
+        del self._rbuf[:n]
+        return out
+
+    # --- producer ------------------------------------------------------
+
+    def _drain_acks(self, block_timeout: Optional[float] = 0.0):
+        """Consume pending ACK bytes; with a timeout, wait for at least
+        one (credit recovery when the window is full)."""
+        import select
+        import socket
+        if self._sock is None or self._inflight == 0:
+            return
+        want_block = block_timeout != 0.0
+        while self._inflight > 0:
+            r, _, _ = select.select([self._sock], [], [],
+                                    block_timeout if want_block else 0.0)
+            if not r:
+                if want_block:
+                    raise ChannelTimeout("channel full (no ACK)")
+                return
+            self._sock.settimeout(0.0)
+            try:
+                data = self._sock.recv(self._inflight)
+            except (BlockingIOError, socket.timeout):
+                return
+            if not data:
+                raise ChannelClosed("peer closed")
+            self._inflight -= len(data)
+            want_block = False   # got credit; opportunistic from here
+
+    def has_space(self) -> bool:
+        # ChannelClosed propagates: reporting space on a dead peer
+        # would let a fan-in driver write the other inputs first and
+        # skew the streams permanently (the invariant execute() keeps)
+        if self._sock is None:
+            return True          # connection not yet up: first write ok
+        self._drain_acks(0.0)
+        return self._inflight < self.nslots
+
+    def write(self, payload, kind: int = DATA,
+              timeout: Optional[float] = None):
+        if hasattr(payload, "write_into"):
+            n = payload.frame_nbytes
+            data = bytearray(n)
+            payload.write_into(memoryview(data))
+        else:
+            data = payload if isinstance(payload, (bytes, bytearray)) \
+                else bytes(payload)
+            n = len(data)
+        if n > self.slot_bytes:
+            raise ValueError(
+                f"frame of {n} B exceeds channel slot size "
+                f"{self.slot_bytes} B; compile the dag with a larger "
+                f"slot_bytes")
+        self._ensure_conn(timeout)
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while self._inflight >= self.nslots:
+            left = None
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise ChannelTimeout("channel full (no ACK)")
+            self._drain_acks(left)
+        self._sock.settimeout(timeout)
+        # one gathered syscall, zero concatenation copies
+        hdr = n.to_bytes(4, "little") + bytes([kind])
+        sent = self._sock.sendmsg([hdr, data])
+        want = len(hdr) + n
+        if sent < want:          # short gathered send: finish the rest
+            rest = (hdr + bytes(data))[sent:] if sent < len(hdr) \
+                else memoryview(data)[sent - len(hdr):]
+            self._sock.sendall(rest)
+        self._inflight += 1
+
+    # --- consumer ------------------------------------------------------
+
+    def read_with(self, fn, timeout: Optional[float] = None):
+        """Resumable frame read: a timeout mid-header or mid-payload
+        keeps all progress (buffered bytes + parsed header) for the
+        next call — driver-side 0-timeout polls interleave safely with
+        blocking gets on the same channel."""
+        self._ensure_conn(timeout)
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        if self._pending_hdr is None:
+            hdr = self._recv_exact(_FRAME_HDR, timeout)
+            self._pending_hdr = (int.from_bytes(hdr[:4], "little"),
+                                 hdr[4])
+        n, kind = self._pending_hdr
+        left = timeout
+        if deadline is not None and timeout != 0.0:
+            left = max(deadline - time.monotonic(), 0.0)
+        payload = self._recv_exact(n, left) if n else b""
+        self._pending_hdr = None
+        try:
+            return fn(kind, memoryview(payload))
+        finally:
+            try:
+                self._sock.sendall(_ACK)   # slot released: return credit
+            except OSError:
+                pass
+
+    def read_bytes(self, timeout: Optional[float] = None):
+        return self.read_with(lambda k, mv: (k, bytes(mv)), timeout)
+
+    # --- lifecycle ------------------------------------------------------
+
+    def close(self):
+        for s in (self._sock, self._listener):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._sock = self._listener = None
+        if self.role == "consumer":
+            try:
+                _kv("kv_del", key=self.KV_PREFIX + self.id)
+            except Exception:
+                pass
+
+    def unlink(self):
+        pass                     # no named OS resource beyond the socket
+
+    def spec(self) -> dict:
+        return {"type": "tcp", "id": self.id, "nslots": self.nslots,
+                "slot_bytes": self.slot_bytes}
+
+
+def new_tcp_spec(nslots: int, slot_bytes: int) -> dict:
+    return {"type": "tcp", "id": uuid.uuid4().hex[:16],
+            "nslots": nslots, "slot_bytes": slot_bytes}
+
+
+def attach_channel(spec: dict, role: str, timeout: float = 60.0):
+    """Attach either channel flavor: shm specs are role-agnostic, tcp
+    specs bind/connect per role ('producer' | 'consumer').
+
+    ``lazy`` shm specs cover co-located NON-driver stages: the driver
+    can't create a segment on a remote host, so the consumer creates it
+    at attach (and owns the unlink) while the producer polls for the
+    name — same-host peers still get the two-memcpy ring instead of
+    paying the TCP path."""
+    if spec.get("type") == "tcp":
+        return TcpChannel(spec, role)
+    if spec.get("lazy"):
+        if role == "consumer":
+            ch = ShmRingChannel(spec["name"], nslots=spec["nslots"],
+                                slot_bytes=spec["slot_bytes"],
+                                create=True)
+            ch._lazy_owner = True
+            return ch
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return ShmRingChannel.attach(spec)
+            except FileNotFoundError:
+                if time.monotonic() > deadline:
+                    raise ChannelTimeout(
+                        f"lazy shm channel {spec['name']} never "
+                        f"created by its consumer")
+                time.sleep(0.01)
+    return ShmRingChannel.attach(spec)

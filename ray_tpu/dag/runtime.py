@@ -19,7 +19,8 @@ from typing import List, Optional
 
 import numpy as np
 
-from ray_tpu.dag.channel import DATA, ERROR, STOP, ShmRingChannel
+from ray_tpu.dag.channel import (DATA, ERROR, STOP, ShmRingChannel,
+                                 attach_channel)
 from ray_tpu.runtime.serialization import dumps_oob, loads_oob, serialize
 
 
@@ -53,10 +54,12 @@ def exec_loop(instance, spec: dict) -> dict:
       out_channels: list of channel specs (broadcast to every consumer)
     """
     method = getattr(instance, spec["method"])
+    # shm rings attach by name (same host); tcp edges bind/connect per
+    # role — this stage CONSUMES its in-edges, PRODUCES its out-edges
     ins: List[ShmRingChannel] = [
-        ShmRingChannel.attach(s) for s in spec["in_channels"]]
+        attach_channel(s, "consumer") for s in spec["in_channels"]]
     outs: List[ShmRingChannel] = [
-        ShmRingChannel.attach(s) for s in spec["out_channels"]]
+        attach_channel(s, "producer") for s in spec["out_channels"]]
     template = [loads_oob(frame) if k == "const" else None
                 for k, frame in spec["arg_template"]]
     chan_pos = [i for i, (k, _) in enumerate(spec["arg_template"])
@@ -127,4 +130,6 @@ def exec_loop(instance, spec: dict) -> dict:
     finally:
         for ch in ins + outs:
             ch.close()
+            if getattr(ch, "_lazy_owner", False):
+                ch.unlink()   # consumer created this same-node segment
     return {"processed": processed}
