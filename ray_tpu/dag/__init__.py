@@ -164,7 +164,10 @@ class CompiledDag:
                     "slot_bytes": self._slot_bytes, "lazy": True}
         spec = new_tcp_spec(self._nslots, self._slot_bytes)
         if producer is None:
-            ch = TcpChannel(spec, "producer")
+            # nonblocking: the driver must always be able to return to
+            # draining the sink (it is the sink's only reader); frames
+            # enqueue under credit and flush from the sink pump
+            ch = TcpChannel(spec, "producer", nonblocking_writes=True)
             self._channels.append(ch)
             self._input_chans.append(ch)
         if consumer is None:
@@ -242,7 +245,15 @@ class CompiledDag:
 
     def _pump_sink(self, blocking: bool, timeout: Optional[float] = None):
         """Move any completed frames sink -> _results. Caller holds
-        self._lock."""
+        self._lock. Also flushes any enqueued (nonblocking) input
+        frames — the pump is the driver's one guaranteed-periodic
+        touchpoint, so a tail frame can never starve unflushed."""
+        for ch in self._input_chans:
+            if hasattr(ch, "flush"):
+                try:
+                    ch.flush(0.0)
+                except Exception:
+                    pass   # surfaced by the next write/get on that edge
         while True:
             try:
                 kind, payload = self._sink_chan.read_bytes(
@@ -275,11 +286,15 @@ class CompiledDag:
         self._torn_down = True
         deadline = time.monotonic() + timeout
         from ray_tpu import api
+        from ray_tpu.dag.channel import ChannelClosed
         for ch in self._input_chans:
             try:
                 ch.write(b"", STOP, timeout=timeout)
-            except ChannelTimeout:
-                pass
+                if hasattr(ch, "flush"):
+                    ch.flush(min(timeout, 5.0))
+            except (ChannelTimeout, ChannelClosed):
+                pass    # stalled or dead stage: the drain below and
+                        # close() still run
         # Drain the sink until STOP flows out: stages blocked writing
         # results into a full sink must unblock to ever see the STOP —
         # otherwise their loops would spin (holding the actor's executor
@@ -289,6 +304,8 @@ class CompiledDag:
                 kind, _ = self._sink_chan.read_bytes(timeout=1.0)
             except ChannelTimeout:
                 continue
+            except ChannelClosed:
+                break     # sink stage died: nothing more will arrive
             if kind == STOP:
                 break
         try:

@@ -270,17 +270,28 @@ class TcpChannel:
     """
 
     KV_PREFIX = "__dagch:"
+    CONNECT_TIMEOUT_S = 120.0   # bound for "consumer never came up"
 
-    def __init__(self, spec: dict, role: str):
+    def __init__(self, spec: dict, role: str,
+                 nonblocking_writes: bool = False):
+        """``nonblocking_writes``: write() ENQUEUES the frame (credit
+        permitting) and flushes opportunistically instead of blocking
+        on the kernel send buffer. The DRIVER's input channels use this
+        — the driver is the sink's only drainer, so a write that blocks
+        on a stalled pipeline would deadlock it (stage channels keep
+        blocking writes: a stage SHOULD stall when downstream is
+        full)."""
         assert role in ("producer", "consumer"), role
         self.id = spec["id"]
         self.nslots = spec["nslots"]
         self.slot_bytes = spec["slot_bytes"]
         self.role = role
+        self.nonblocking_writes = nonblocking_writes
         self._sock = None
         self._listener = None
         self._inflight = 0          # producer: un-ACKed frames
         self._rbuf = bytearray()    # consumer: partial-read resume
+        self._wbuf = bytearray()    # producer: unflushed frame bytes
         self._ident_left = 0        # consumer: handshake bytes pending
         self._pending_hdr = None    # consumer: parsed frame header
         if role == "consumer":
@@ -319,19 +330,31 @@ class TcpChannel:
             self._check_ident(timeout)
             return
         else:
+            # never poll forever: a consumer that died before attaching
+            # would otherwise hang the producer with no diagnosis
+            if deadline is None:
+                deadline = time.monotonic() + self.CONNECT_TIMEOUT_S
             while True:
                 blob = _kv("kv_get", key=self.KV_PREFIX + self.id)
                 if blob:
                     break
-                if deadline is not None and \
-                        time.monotonic() > deadline:
-                    raise ChannelTimeout("consumer endpoint not "
-                                         "published")
+                if time.monotonic() > deadline:
+                    raise ChannelTimeout(
+                        f"consumer endpoint for channel {self.id} not "
+                        f"published (peer dead before attach?)")
                 time.sleep(0.02)
             host, port = blob.decode().rsplit(":", 1)
-            self._sock = socket.create_connection(
-                (host, int(port)), timeout=timeout)
-            self._sock.sendall(self.id.encode())
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port)),
+                    timeout=max(1.0, deadline - time.monotonic()))
+                self._sock.sendall(self.id.encode())
+            except socket.timeout:
+                self._sock = None
+                raise ChannelTimeout("connect to consumer timed out")
+            except OSError as e:
+                self._sock = None
+                raise ChannelClosed(f"connect failed: {e}")
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def _check_ident(self, timeout: Optional[float]):
@@ -357,6 +380,8 @@ class TcpChannel:
             chunk = self._sock.recv(max(want, 1))
         except (socket.timeout, BlockingIOError):
             raise ChannelTimeout("channel recv timed out")
+        except OSError as e:           # reset/aborted: channel-typed
+            raise ChannelClosed(f"peer connection lost: {e}")
         if not chunk:
             raise ChannelClosed("peer closed")
         self._rbuf += chunk
@@ -403,6 +428,8 @@ class TcpChannel:
                 data = self._sock.recv(self._inflight)
             except (BlockingIOError, socket.timeout):
                 return
+            except OSError as e:
+                raise ChannelClosed(f"peer connection lost: {e}")
             if not data:
                 raise ChannelClosed("peer closed")
             self._inflight -= len(data)
@@ -414,11 +441,39 @@ class TcpChannel:
         # skew the streams permanently (the invariant execute() keeps)
         if self._sock is None:
             return True          # connection not yet up: first write ok
+        self.flush(0.0)
         self._drain_acks(0.0)
         return self._inflight < self.nslots
 
+    def flush(self, timeout: Optional[float] = 0.0):
+        """Push enqueued frame bytes to the socket. 0.0 = best-effort
+        non-blocking (the driver calls this from its sink pump); None /
+        >0 = block for full drain within the budget."""
+        import socket
+        if not self._wbuf or self._sock is None:
+            return
+        self._sock.settimeout(timeout)
+        while self._wbuf:
+            try:
+                sent = self._sock.send(self._wbuf)
+            except (socket.timeout, BlockingIOError):
+                if timeout == 0.0:
+                    return
+                raise ChannelTimeout("channel flush timed out")
+            except OSError as e:
+                raise ChannelClosed(f"peer connection lost: {e}")
+            del self._wbuf[:sent]
+
     def write(self, payload, kind: int = DATA,
               timeout: Optional[float] = None):
+        """Blocking-mode (stages): the whole frame is on the wire when
+        this returns — a frame is never abandoned mid-send, so the
+        length-prefixed stream cannot desynchronize (the timeout covers
+        connect + credit; transmission completes unconditionally).
+        Nonblocking-mode (driver inputs): the frame is ENQUEUED once
+        credit allows and flushed opportunistically — the driver can
+        always return to draining the sink, which is what ultimately
+        frees the pipeline."""
         if hasattr(payload, "write_into"):
             n = payload.frame_nbytes
             data = bytearray(n)
@@ -436,22 +491,33 @@ class TcpChannel:
         deadline = None if timeout is None \
             else time.monotonic() + timeout
         while self._inflight >= self.nslots:
+            self.flush(0.0)
             left = None
             if deadline is not None:
                 left = deadline - time.monotonic()
                 if left <= 0:
                     raise ChannelTimeout("channel full (no ACK)")
             self._drain_acks(left)
-        self._sock.settimeout(timeout)
-        # one gathered syscall, zero concatenation copies
         hdr = n.to_bytes(4, "little") + bytes([kind])
-        sent = self._sock.sendmsg([hdr, data])
-        want = len(hdr) + n
-        if sent < want:          # short gathered send: finish the rest
-            rest = (hdr + bytes(data))[sent:] if sent < len(hdr) \
-                else memoryview(data)[sent - len(hdr):]
-            self._sock.sendall(rest)
         self._inflight += 1
+        if self.nonblocking_writes:
+            self._wbuf += hdr
+            self._wbuf += data
+            self.flush(0.0)
+            return
+        import socket
+        # one gathered syscall, zero concatenation copies; completion
+        # is unconditional (see docstring)
+        self._sock.settimeout(None)
+        try:
+            sent = self._sock.sendmsg([hdr, data])
+            want = len(hdr) + n
+            if sent < want:      # short gathered send: finish the rest
+                rest = (hdr + bytes(data))[sent:] if sent < len(hdr) \
+                    else memoryview(data)[sent - len(hdr):]
+                self._sock.sendall(rest)
+        except OSError as e:
+            raise ChannelClosed(f"peer connection lost: {e}")
 
     # --- consumer ------------------------------------------------------
 
@@ -487,6 +553,10 @@ class TcpChannel:
     # --- lifecycle ------------------------------------------------------
 
     def close(self):
+        try:
+            self.flush(1.0)      # best-effort: don't strand a frame
+        except Exception:
+            pass
         for s in (self._sock, self._listener):
             if s is not None:
                 try:

@@ -53,13 +53,33 @@ class Dataset:
     def map(self, fn: Callable[[dict], dict]) -> "Dataset":
         return self._with(_Op("map", "map_rows", fn))
 
-    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = 4096,
+    def map_batches(self, fn: Callable, *,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None,
+                    num_cpus: Optional[float] = None,
+                    max_in_flight_bytes: Optional[int] = None,
+                    batch_size: Optional[int] = 4096,
                     batch_format: BatchFormat = "numpy",
                     concurrency: Optional[int] = None) -> "Dataset":
+        """``fn`` may be a FUNCTION (stateless: runs inline, or as a
+        task pool with `concurrency`) or a callable CLASS (stateful —
+        e.g. a model loaded once per worker: runs on an ACTOR POOL of
+        `concurrency` actors, constructed with fn_constructor_args;
+        reference: ActorPoolMapOperator / ActorPoolStrategy).
+        ``max_in_flight_bytes`` bounds the bytes of input batches
+        concurrently in flight — fan-out stages otherwise have no
+        memory ceiling (reference:
+        data/_internal/execution/backpressure_policy/)."""
         return self._with(_Op("map_batches", "map_batches", fn,
                               {"batch_size": batch_size,
                                "batch_format": batch_format,
-                               "concurrency": concurrency}))
+                               "concurrency": concurrency,
+                               "fn_constructor_args": fn_constructor_args,
+                               "fn_constructor_kwargs":
+                                   fn_constructor_kwargs or {},
+                               "num_cpus": num_cpus,
+                               "max_in_flight_bytes":
+                                   max_in_flight_bytes}))
 
     def flat_map(self, fn: Callable[[dict], List[dict]]) -> "Dataset":
         return self._with(_Op("flat_map", "flat_map", fn))
@@ -460,8 +480,20 @@ def _map_batches_stream(stream: Iterator[Block], op: _Op) -> Iterator[Block]:
     batches = _rebatch(stream, args.get("batch_size"))
     fn = op.fn
 
+    if isinstance(fn, type):
+        # stateful UDF: one instance per pool worker
+        if concurrency and _runtime_up():
+            yield from _actor_pool_map(batches, fn, fmt, args)
+            return
+        inst = fn(*args.get("fn_constructor_args", ()),
+                  **args.get("fn_constructor_kwargs", {}))
+        for b in batches:
+            yield _convert_out(inst(_convert_in(b, fmt)))
+        return
     if concurrency and concurrency > 1 and _runtime_up():
-        yield from _parallel_map(batches, fn, fmt, concurrency)
+        yield from _parallel_map(batches, fn, fmt, concurrency,
+                                 args.get("num_cpus"),
+                                 args.get("max_in_flight_bytes"))
         return
     for b in batches:
         yield _convert_out(fn(_convert_in(b, fmt)))
@@ -475,24 +507,119 @@ def _runtime_up() -> bool:
         return False
 
 
+def _block_nbytes(b: Block) -> int:
+    return sum(np.asarray(v).nbytes for v in b.values())
+
+
+def _windowed(batches: Iterator[Block], submit, cap: int,
+              max_bytes: Optional[int],
+              on_done=None) -> Iterator[Block]:
+    """THE in-order fan-out scheduler shared by the task-pool and
+    actor-pool map operators: at most `cap` submissions (and, when
+    set, `max_bytes` of input bytes) in flight; results yield in
+    submission order (reference: TaskPoolMapOperator /
+    ActorPoolMapOperator + the execution backpressure policies that
+    bound per-op memory). `submit(block) -> (ref, meta)`;
+    `on_done(meta)` runs when that submission's result is yielded."""
+    import ray_tpu
+
+    window: List = []        # (ref, meta, input_nbytes) in order
+    in_bytes = 0
+
+    def drain_one():
+        nonlocal in_bytes
+        ref, meta, nb = window.pop(0)
+        in_bytes -= nb
+        out = ray_tpu.get(ref, timeout=600)
+        if on_done is not None:
+            on_done(meta)
+        return out
+
+    for b in batches:
+        nb = _block_nbytes(b)
+        while window and (
+                len(window) >= cap
+                or (max_bytes is not None
+                    and in_bytes + nb > max_bytes)):
+            yield drain_one()
+        ref, meta = submit(b)
+        window.append((ref, meta, nb))
+        in_bytes += nb
+    while window:
+        yield drain_one()
+
+
 def _parallel_map(batches: Iterator[Block], fn, fmt: str,
-                  concurrency: int) -> Iterator[Block]:
-    """Fan batches out to runtime tasks, keep at most `concurrency` in
-    flight, yield in order (reference: TaskPoolMapOperator with its
-    resource-budgeted in-flight window)."""
+                  concurrency: int, num_cpus: Optional[float] = None,
+                  max_in_flight_bytes: Optional[int] = None
+                  ) -> Iterator[Block]:
+    """Stateless fan-out: one runtime task per batch."""
     import ray_tpu
 
     @ray_tpu.remote
     def _run_batch(fn_, b, fmt_):
         return _convert_out(fn_(_convert_in(b, fmt_)))
 
-    window: List = []
-    for b in batches:
-        window.append(_run_batch.remote(fn, b, fmt))
-        if len(window) >= concurrency:
-            yield ray_tpu.get(window.pop(0), timeout=600)
-    for ref in window:
-        yield ray_tpu.get(ref, timeout=600)
+    task = _run_batch.options(num_cpus=num_cpus) \
+        if num_cpus is not None else _run_batch
+    yield from _windowed(batches,
+                         lambda b: (task.remote(fn, b, fmt), None),
+                         concurrency, max_in_flight_bytes)
+
+
+class _MapWorker:
+    """Actor-pool worker hosting ONE instance of a stateful map UDF
+    (reference: ActorPoolMapOperator's _MapWorker)."""
+
+    def __init__(self, cls_payload: bytes, ctor_args, ctor_kwargs):
+        import cloudpickle
+        cls = cloudpickle.loads(cls_payload)
+        self.fn = cls(*ctor_args, **(ctor_kwargs or {}))
+
+    def run(self, b, fmt: str):
+        return _convert_out(self.fn(_convert_in(b, fmt)))
+
+
+def _actor_pool_map(batches: Iterator[Block], cls, fmt: str,
+                    args: dict) -> Iterator[Block]:
+    """Stateful map over an actor pool: `concurrency` actors each
+    construct the UDF once (model load amortized across every batch),
+    batches go to the least-loaded actor, results yield in input
+    order. In-flight work is bounded by 2 batches per actor plus the
+    optional byte budget."""
+    import cloudpickle
+
+    import ray_tpu
+    concurrency = int(args.get("concurrency") or 1)
+    num_cpus = args.get("num_cpus")
+    max_bytes = args.get("max_in_flight_bytes")
+    payload = cloudpickle.dumps(cls, protocol=5)
+    opts = {"num_cpus": num_cpus} if num_cpus is not None else {}
+    Worker = ray_tpu.remote(_MapWorker).options(**opts) \
+        if opts else ray_tpu.remote(_MapWorker)
+    actors = [Worker.remote(payload, args.get("fn_constructor_args", ()),
+                            args.get("fn_constructor_kwargs", {}))
+              for _ in range(concurrency)]
+    try:
+        loads = [0] * concurrency
+
+        def submit(b):
+            ai = min(range(concurrency), key=lambda i: loads[i])
+            loads[ai] += 1
+            return actors[ai].run.remote(b, fmt), ai
+
+        def done(ai):
+            loads[ai] -= 1
+
+        # cap = 2 per actor: every actor busy + one queued
+        yield from _windowed(batches, submit, concurrency * 2,
+                             max_bytes, on_done=done)
+    finally:
+        for a in actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
 
 
 def _limit_stream(stream: Iterator[Block], n: int) -> Iterator[Block]:
