@@ -6,6 +6,13 @@ enough for the framework's JSON-in/JSON-out serving surface. Routing is
 longest-prefix over the controller's ingress table; the request body
 (JSON when the content-type says so, raw bytes otherwise) becomes the
 deployment's argument.
+
+HTTP/1.1 surface: persistent connections (1.1 default-on, 1.0 opt-in
+via Connection: keep-alive) with an idle timeout, chunked
+transfer-encoded request bodies, Expect: 100-continue, bounded header/
+body sizes (431/413), and malformed-request 400s. HTTP/2 and gRPC
+ingress are out of scope by design (the image carries no h2/grpc deps;
+the reference gets both from uvicorn/grpcio).
 """
 
 from __future__ import annotations
@@ -16,6 +23,12 @@ import time
 from typing import Dict, Optional, Tuple
 
 from ray_tpu import api
+
+
+class _BadRequest(Exception):
+    def __init__(self, msg: str, code: int = 400):
+        super().__init__(msg)
+        self.code = code
 
 
 class HTTPProxy:
@@ -65,18 +78,37 @@ class HTTPProxy:
 
     # -- http --------------------------------------------------------------
 
+    IDLE_TIMEOUT_S = 75.0          # keep-alive connections reap after
+    MAX_HEADER_BYTES = 64 * 1024
+    MAX_BODY_BYTES = 64 * 1024 * 1024
+
     async def _on_conn(self, reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter):
         try:
             while True:
-                req = await self._read_request(reader)
+                try:
+                    req = await asyncio.wait_for(
+                        self._read_request(reader, writer),
+                        self.IDLE_TIMEOUT_S)
+                except asyncio.TimeoutError:
+                    return            # idle keep-alive connection
+                except _BadRequest as e:
+                    self._respond(writer, e.code, {"error": str(e)},
+                                  close=True)
+                    await writer.drain()
+                    return
                 if req is None:
                     return
-                method, path, headers, body = req
+                method, path, headers, body, version = req
+                conn = headers.get("connection", "").lower()
+                # RFC 7230: 1.1 persists unless 'close'; 1.0 only with
+                # an explicit keep-alive
+                keep = (conn != "close") if version == "HTTP/1.1" \
+                    else (conn == "keep-alive")
                 r = await self._dispatch(writer, method, path, headers,
                                          body)
-                if r == "close" or \
-                        headers.get("connection", "").lower() == "close":
+                await writer.drain()
+                if r == "close" or not keep:
                     return
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 BrokenPipeError):
@@ -87,25 +119,91 @@ class HTTPProxy:
             except Exception:
                 pass
 
-    async def _read_request(self, reader):
-        line = await reader.readline()
+    @staticmethod
+    async def _line(reader) -> bytes:
+        """readline that maps an over-long line (StreamReader limit)
+        to a protocol error instead of an unhandled ValueError."""
+        try:
+            return await reader.readline()
+        except (ValueError, asyncio.LimitOverrunError):
+            raise _BadRequest("line too long", 431)
+
+    async def _read_request(self, reader, writer):
+        line = await self._line(reader)
         if not line:
             return None
         try:
-            method, target, _version = line.decode().split()
-        except ValueError:
-            return None
+            method, target, version = line.decode().split()
+        except (ValueError, UnicodeDecodeError):
+            raise _BadRequest("malformed request line")
         headers: Dict[str, str] = {}
+        hdr_bytes = 0
         while True:
-            h = await reader.readline()
+            h = await self._line(reader)
             if h in (b"\r\n", b"\n", b""):
                 break
-            k, _, v = h.decode().partition(":")
+            hdr_bytes += len(h)
+            if hdr_bytes > self.MAX_HEADER_BYTES:
+                raise _BadRequest("header section too large", 431)
+            k, sep, v = h.decode(errors="replace").partition(":")
+            if not sep:
+                raise _BadRequest("malformed header line")
             headers[k.strip().lower()] = v.strip()
-        n = int(headers.get("content-length", 0))
-        body = await reader.readexactly(n) if n else b""
+        chunked = "chunked" in headers.get("transfer-encoding",
+                                           "").lower()
+        n = 0
+        if not chunked:
+            try:
+                n = int(headers.get("content-length", 0))
+            except ValueError:
+                raise _BadRequest("bad Content-Length")
+            if n < 0:
+                raise _BadRequest("bad Content-Length")
+            # validate BEFORE any 100 Continue: the interim response
+            # exists precisely so oversized uploads are rejected
+            # without transferring the body
+            if n > self.MAX_BODY_BYTES:
+                raise _BadRequest("body too large", 413)
+        if headers.get("expect", "").lower() == "100-continue":
+            writer.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+            await writer.drain()
+        if chunked:
+            body = await self._read_chunked(reader)
+        else:
+            body = await reader.readexactly(n) if n else b""
         path = target.split("?", 1)[0]
-        return method, path, headers, body
+        return method, path, headers, body, version
+
+    async def _read_chunked(self, reader) -> bytes:
+        """RFC 7230 §4.1 chunked request body (clients that stream
+        uploads don't know Content-Length up front)."""
+        out = bytearray()
+        while True:
+            size_line = await self._line(reader)
+            if not size_line.strip():
+                # EOF / blank where a chunk size belongs: the body is
+                # TRUNCATED — reject rather than accept a partial
+                # payload as complete
+                raise _BadRequest("truncated chunked body")
+            try:
+                # chunk extensions (';...') are tolerated and ignored
+                n = int(size_line.split(b";", 1)[0].strip(), 16)
+            except ValueError:
+                raise _BadRequest("bad chunk size")
+            if n < 0:
+                raise _BadRequest("bad chunk size")
+            if len(out) + n > self.MAX_BODY_BYTES:
+                raise _BadRequest("body too large", 413)
+            if n == 0:
+                # trailers (ignored) up to the final blank line
+                while True:
+                    t = await self._line(reader)
+                    if t in (b"\r\n", b"\n", b""):
+                        return bytes(out)
+            out += await reader.readexactly(n)
+            crlf = await self._line(reader)
+            if crlf not in (b"\r\n", b"\n"):
+                raise _BadRequest("bad chunk terminator")
 
     async def _dispatch(self, writer, method, path, headers, body):
         self._requests += 1
@@ -225,8 +323,11 @@ class HTTPProxy:
                 pass
         return "close"
 
-    def _respond(self, writer, code: int, payload):
-        reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}
+    def _respond(self, writer, code: int, payload, close: bool = False):
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  413: "Payload Too Large",
+                  431: "Request Header Fields Too Large",
+                  500: "Internal Server Error"}
         if isinstance(payload, (bytes, bytearray)):
             body = bytes(payload)
             ctype = "application/octet-stream"
@@ -235,8 +336,9 @@ class HTTPProxy:
             # clients can round-trip any handler return value.
             body = json.dumps(payload).encode()
             ctype = "application/json"
+        conn = "Connection: close\r\n" if close else ""
         head = (f"HTTP/1.1 {code} {reason.get(code, 'OK')}\r\n"
                 f"Content-Type: {ctype}\r\n"
-                f"Content-Length: {len(body)}\r\n"
+                f"Content-Length: {len(body)}\r\n{conn}"
                 f"\r\n").encode()
         writer.write(head + body)
