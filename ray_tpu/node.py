@@ -27,20 +27,22 @@ from ray_tpu.config import Config
 
 def _auto_resources(num_cpus: Optional[float],
                     resources: Optional[Dict[str, float]]) -> Dict[str, float]:
-    """CPU count plus auto-detected TPU chips (reference:
-    _private/accelerators/tpu.py detection feeding node resources)."""
-    from ray_tpu.util import tpu
+    """CPU count plus every accelerator plugin's detected devices
+    (reference: _private/accelerators/ manager registry feeding node
+    resources — TPU first-class, NVIDIA GPUs for mixed clusters,
+    vendor plugins via accelerators.register)."""
+    from ray_tpu.util import accelerators
     res = dict(resources or {})
     res.setdefault("CPU", float(num_cpus if num_cpus is not None
                                 else (os.cpu_count() or 1)))
-    for k, v in tpu.node_tpu_resources().items():
+    for k, v in accelerators.detect_resources().items():
         res.setdefault(k, v)
     return res
 
 
 def _auto_labels(labels: Optional[Dict[str, str]]) -> Dict[str, str]:
-    from ray_tpu.util import tpu
-    out = dict(tpu.node_tpu_labels())
+    from ray_tpu.util import accelerators
+    out = dict(accelerators.detect_labels())
     out.update(labels or {})
     return out
 

@@ -70,12 +70,6 @@ def chips_per_host(pod_type: str) -> int:
     return _CHIPS_PER_HOST.get(gen, 4)
 
 
-def node_tpu_resources() -> Dict[str, float]:
-    """Resources a node agent advertises on a TPU host."""
-    n = num_tpu_chips_on_host()
-    return {"TPU": float(n)} if n else {}
-
-
 def node_tpu_labels() -> Dict[str, str]:
     labels = {}
     if tpu_pod_type():

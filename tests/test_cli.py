@@ -114,3 +114,50 @@ def test_cli_stop_kills_nodes(cli_cluster):
     sd = os.environ["RAY_TPU_SESSION_DIR"]
     assert not [f for f in (os.listdir(sd) if os.path.isdir(sd) else [])
                 if f.endswith(".json")]
+
+
+def test_accelerator_plugin_registry(monkeypatch):
+    """Pluggable accelerator detection (reference:
+    _private/accelerators/): TPU + NVIDIA built in, vendors register
+    their own; node startup advertises whatever the plugins see."""
+    from ray_tpu.node import _auto_labels, _auto_resources
+    from ray_tpu.util import accelerators as acc
+
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST", "4")
+    monkeypatch.setenv("CUDA_VISIBLE_DEVICES", "0,1")
+    res = _auto_resources(2, None)
+    assert res["CPU"] == 2.0 and res["TPU"] == 4.0 and res["GPU"] == 2.0
+
+    monkeypatch.setenv("CUDA_VISIBLE_DEVICES", "-1")  # masked off
+    assert "GPU" not in acc.detect_resources()
+
+    class NPU(acc.AcceleratorPlugin):
+        resource_name = "NPU"
+
+        def count(self):
+            return 3
+
+        def labels(self):
+            return {"npu_gen": "v9"}
+
+    acc.register(NPU())
+    try:
+        res = acc.detect_resources()
+        assert res["NPU"] == 3.0
+        assert _auto_labels(None)["npu_gen"] == "v9"
+        # replacing by resource_name, not appending
+        acc.register(NPU())
+        assert sum(1 for p in acc.plugins()
+                   if p.resource_name == "NPU") == 1
+    finally:
+        acc._PLUGINS = [p for p in acc.plugins()
+                        if p.resource_name != "NPU"]
+
+
+def test_gpu_plugin_cuda_visible_devices_semantics(monkeypatch):
+    from ray_tpu.util.accelerators import NvidiaGPUPlugin
+    p = NvidiaGPUPlugin()
+    for val, want in [("0,1", 2), ("0,-1", 1), ("0,1,", 2), ("-1", 0),
+                      ("", 0), ("GPU-abc,GPU-def", 2), ("0,junk,2", 1)]:
+        monkeypatch.setenv("CUDA_VISIBLE_DEVICES", val)
+        assert p.count() == want, (val, p.count())
