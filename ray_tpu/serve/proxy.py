@@ -64,9 +64,18 @@ class HTTPProxy:
                                    namespace=SERVE_NAMESPACE)
         if not info or info.get("state") == "DEAD":
             return
-        refs = await ctx.submit_actor_call(
-            info["actor_id"], "get_ingress_routes", (), {})
-        self._routes = await ctx.get(refs[0], 10.0)
+        for attempt in (0, 1):
+            try:
+                refs = await ctx.submit_actor_call(
+                    info["actor_id"], "get_ingress_routes", (), {})
+                self._routes = await ctx.get(refs[0], 10.0)
+                break
+            except Exception:
+                # one immediate retry: a crashed-and-restarted
+                # controller leaves a stale actor address in this
+                # worker's cache, and the failure just invalidated it
+                if attempt:
+                    raise
         self._routes_fetched = time.monotonic()
 
     def _match(self, path: str) -> Optional[str]:
@@ -212,9 +221,19 @@ class HTTPProxy:
         try:
             await self._refresh_routes()
         except Exception as e:
-            self._errors += 1
-            return self._respond(
-                writer, 500, {"error": f"route refresh: {e}"})
+            # A refresh can fail transiently (controller just crashed
+            # and restarted; its old address is still cached one call
+            # deep). With a previously-fetched table, serve THAT —
+            # stale routes beat a 500, and the failed call already
+            # invalidated the stale cache for the next refresh.
+            if not self._routes:
+                self._errors += 1
+                return self._respond(
+                    writer, 500, {"error": f"route refresh: {e}"})
+            # stamp NOW: stale routes keep serving and the (expensive,
+            # up-to-10s) failing refresh re-runs at most once per
+            # second, not on every request during a controller outage
+            self._routes_fetched = time.monotonic()
         if path == "/-/routes":
             return self._respond(writer, 200, {"routes": self._routes})
         dep = self._match(path)
