@@ -4,7 +4,8 @@
 def __getattr__(name):
     # Submodules import lazily so `import ray_tpu.util` stays cheap.
     if name in ("events", "metrics", "tpu", "queue", "actor_pool",
-                "multiprocessing", "state", "collective"):
+                "multiprocessing", "state", "collective", "tracing",
+                "dashboard", "accelerators", "joblib_backend"):
         import importlib
         return importlib.import_module(f"ray_tpu.util.{name}")
     if name == "ActorPool":
