@@ -7,11 +7,14 @@ from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.datasource import (from_blocks, from_items, from_numpy,
                                      from_pandas, range, read_csv,
                                      read_json, read_numpy, read_parquet,
-                                     read_text)
+                                     read_text, read_tfrecord,
+                                     write_csv, write_json,
+                                     write_parquet, write_tfrecord)
 from ray_tpu.data.iterator import DataIterator
 
 __all__ = [
     "Dataset", "DataIterator", "from_blocks", "from_items", "from_numpy",
     "from_pandas", "range", "read_csv", "read_json", "read_numpy",
-    "read_parquet", "read_text",
+    "read_parquet", "read_text", "read_tfrecord", "write_csv",
+    "write_json", "write_parquet", "write_tfrecord",
 ]

@@ -297,6 +297,10 @@ class Dataset:
         from ray_tpu.data.datasource import write_json
         write_json(self, path)
 
+    def write_tfrecord(self, path: str) -> None:
+        from ray_tpu.data.datasource import write_tfrecord
+        write_tfrecord(self, path)
+
     def __repr__(self):
         names = "->".join(op.name for op in self._ops)
         return f"Dataset({names})"

@@ -125,6 +125,24 @@ def read_text(paths) -> Dataset:
     return _source_ds("read_text", block_fns=[make(p) for p in files])
 
 
+def read_tfrecord(paths, *, verify_crc: bool = True) -> Dataset:
+    """TFRecord files of tf.train.Example protos, one block per file —
+    WITHOUT TensorFlow (native framing + proto codec, data/tfrecord.py;
+    reference capability: data/read_api.py read_tfrecords)."""
+    from ray_tpu.data import tfrecord as tfr
+    files = _expand(paths)
+
+    def make(path):
+        def fn():
+            rows = [tfr.decode_example(rec)
+                    for rec in tfr.read_records(
+                        path, verify_crc=verify_crc)]
+            return tfr.rows_to_block(rows)
+        return fn
+    return _source_ds("read_tfrecord",
+                      block_fns=[make(p) for p in files])
+
+
 def read_numpy(paths) -> Dataset:
     files = _expand(paths)
 
@@ -153,6 +171,19 @@ def write_csv(ds: Dataset, path: str) -> None:
         if block_num_rows(b):
             pacsv.write_csv(block_to_arrow(b),
                             os.path.join(path, f"part-{i:05d}.csv"))
+
+
+def write_tfrecord(ds: Dataset, path: str) -> None:
+    """One TFRecord file of tf.train.Example protos per block —
+    readable by TF input pipelines (masked-crc32c framing)."""
+    from ray_tpu.data import tfrecord as tfr
+    from ray_tpu.data.block import block_rows
+    os.makedirs(path, exist_ok=True)
+    for i, b in enumerate(ds.iter_blocks()):
+        if block_num_rows(b):
+            tfr.write_records(
+                os.path.join(path, f"part-{i:05d}.tfrecord"),
+                (tfr.encode_example(r) for r in block_rows(b)))
 
 
 def write_json(ds: Dataset, path: str) -> None:

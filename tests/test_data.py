@@ -230,3 +230,75 @@ def test_map_batches_byte_budget_backpressure(runtime):
     rows = ds.take_all()
     assert len(rows) == 4000
     assert rows[-1]["id"] == 3999
+
+
+def test_tfrecord_roundtrip(tmp_path):
+    """TFRecord write -> read without TensorFlow: int64/float/bytes
+    features, multi-value lists, CRC framing (reference capability:
+    data/read_api.py read_tfrecords via TF/pyarrow codecs)."""
+    ds = rd.from_items([
+        {"i": i, "f": float(i) / 2, "s": f"row{i}".encode(),
+         "multi": [i, i + 1, i + 2]}
+        for i in range(10)])
+    ds.write_tfrecord(str(tmp_path / "out"))
+
+    back = rd.read_tfrecord(str(tmp_path / "out") + "/*.tfrecord")
+    rows = sorted(back.take_all(), key=lambda r: r["i"])
+    assert len(rows) == 10
+    for i, r in enumerate(rows):
+        assert r["i"] == i
+        assert abs(r["f"] - i / 2) < 1e-6
+        assert r["s"] == f"row{i}".encode()
+        assert list(r["multi"]) == [i, i + 1, i + 2]
+
+
+def test_tfrecord_spec_vector(tmp_path):
+    """Decode a byte-for-byte hand-assembled record per the TFRecord +
+    tf.train.Example wire specs (no TF available to generate one) —
+    guards the codec against self-consistent-but-wrong encoding."""
+    from ray_tpu.data import tfrecord as tfr
+
+    # Example { features { feature { key: "x" value { int64_list
+    # { value: [7] } } } } }, assembled field by field:
+    int64_list = b"\x0a\x01\x07"          # field1 LEN(1): varint 7
+    feature = b"\x1a\x03" + int64_list    # field3 (int64_list) LEN(3)
+    entry = b"\x0a\x01x" + b"\x12\x05" + feature   # key "x", value
+    features = b"\x0a" + bytes([len(entry)]) + entry
+    example = b"\x0a" + bytes([len(features)]) + features
+    assert tfr.decode_example(example) == {"x": [7]}
+    # and our encoder produces an equivalent decodable stream
+    assert tfr.decode_example(
+        tfr.encode_example({"x": 7})) == {"x": [7]}
+
+    # framing: crc mismatch must raise, not return garbage
+    import struct
+    p = tmp_path / "bad.tfrecord"
+    hdr = struct.pack("<Q", len(example))
+    p.write_bytes(hdr + struct.pack("<I", 0xDEADBEEF) + example
+                  + struct.pack("<I", 0))
+    with pytest.raises(ValueError, match="crc"):
+        list(tfr.read_records(str(p)))
+
+
+def test_tfrecord_crc32c_known_values():
+    """crc32c test vectors (RFC 3720 / googletest suite)."""
+    from ray_tpu.data.tfrecord import _crc32c
+    assert _crc32c(b"") == 0
+    assert _crc32c(b"a") == 0xC1D04330
+    assert _crc32c(b"123456789") == 0xE3069283
+    assert _crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_tfrecord_variable_length_and_missing_features(tmp_path):
+    """Variable-length features (the TF-dataset norm) and rows missing
+    a feature must read back as object columns, not crash."""
+    from ray_tpu.data import tfrecord as tfr
+    recs = [tfr.encode_example({"v": [7], "x": 1}),
+            tfr.encode_example({"v": [1, 2, 3]}),      # no "x"
+            tfr.encode_example({"v": [], "x": 3})]
+    tfr.write_records(str(tmp_path / "v.tfrecord"), iter(recs))
+    rows = rd.read_tfrecord(str(tmp_path / "v.tfrecord")).take_all()
+    assert list(rows[0]["v"]) == [7] and list(rows[1]["v"]) == [1, 2, 3]
+    assert list(rows[2]["v"]) == []
+    assert rows[0]["x"] == 1 and rows[2]["x"] == 3
+    assert list(rows[1]["x"]) == []                    # missing -> empty
