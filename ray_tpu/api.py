@@ -346,11 +346,27 @@ def free(refs: Sequence[ObjectRef]) -> None:
 def _resolve_runtime_env(opts: dict):
     """Task/actor env over the inherited default (the reference layers
     job -> parent -> child the same way). Validation does filesystem
-    checks, so callers cache the result per RemoteFunction/ActorClass
-    instead of re-resolving on the hot path."""
+    checks and working_dir/py_modules paths are PACKAGED into the
+    cluster KV here (content-addressed pkg:// uris — worker nodes don't
+    share the driver's filesystem), so callers cache the result per
+    RemoteFunction/ActorClass instead of re-resolving on the hot
+    path."""
     from ray_tpu.runtime import runtime_env as rt
     override = rt.validate(opts.get("runtime_env"))
-    return rt.merge(_inherited_runtime_env(), override)
+    env = rt.merge(_inherited_runtime_env(), override)
+    if env and (env.get("working_dir") or env.get("py_modules")):
+        ctx = _require_init()
+
+        def kv_put(key, value):
+            _run(ctx.pool.call(ctx.head_addr, "kv_put", key=key,
+                               value=value, overwrite=False))
+
+        def kv_has(key):
+            return bool(_run(ctx.pool.call(ctx.head_addr, "kv_keys",
+                                           prefix=key)))
+
+        env = rt.publish_packages(env, kv_put, kv_has)
+    return env
 
 
 def _inherited_runtime_env():

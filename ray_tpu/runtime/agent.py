@@ -442,6 +442,33 @@ class NodeAgent:
 
     # --- worker pool ---------------------------------------------------------
 
+    async def _resolve_env_packages(self, runtime_env: dict) -> dict:
+        """Swap pkg:// working_dir/py_modules uris for locally-extracted
+        paths: uncached package zips are fetched from the control KV
+        (async, off the spawn path's critical RPCs), extraction runs in
+        an executor (reference: runtime env agent downloading packages
+        per node before worker start)."""
+        from ray_tpu.runtime import runtime_env as rt
+        uris = []
+        wd = runtime_env.get("working_dir")
+        if wd and wd.startswith(rt.PKG_PREFIX):
+            uris.append(wd)
+        uris += [m for m in runtime_env.get("py_modules") or []
+                 if m.startswith(rt.PKG_PREFIX)]
+        if not uris:
+            return runtime_env
+        blobs = {}
+        for uri in uris:
+            key = rt.PKG_KV_PREFIX + rt.pkg_digest(uri)
+            if key not in blobs and not rt.pkg_is_cached(uri):
+                # only uncached digests hit the head — spawn churn on a
+                # warm node must not re-download multi-MB zips
+                blobs[key] = await self.pool.call(
+                    self.head_addr, "kv_get", key=key, timeout=60.0)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, rt.resolve_packages, runtime_env, blobs.get)
+
     def _no_worker_error(self, env_hash: str) -> str:
         """'no worker available' is kept as the transient-retry marker
         (core._lease_err_transient matches on it); a venv setup failure
@@ -456,6 +483,16 @@ class NodeAgent:
                             env_hash: str = "") -> Optional[WorkerHandle]:
         from ray_tpu.runtime.runtime_env import apply_to_env, venv_python
         wid = WorkerID.generate()
+        orig_runtime_env = runtime_env   # pkg:// form — what children
+        if runtime_env:                  # must inherit (local paths are
+            try:                         # only valid on THIS node)
+                runtime_env = await self._resolve_env_packages(
+                    runtime_env)
+            except Exception as e:  # noqa: BLE001 — env broken
+                from ray_tpu.util import events
+                events.record("worker", "pkg_failed", error=str(e))
+                self._venv_errors[env_hash] = f"package fetch: {e}"[:500]
+                return None
         env = dict(os.environ)
         env.update(self.env_extra)
         env = apply_to_env(runtime_env, env)
@@ -477,9 +514,10 @@ class NodeAgent:
                 return None
         if runtime_env:
             # Nested tasks submitted FROM this worker inherit its env
-            # (reference: runtime_env inheritance parent -> child).
+            # (reference: runtime_env inheritance parent -> child) —
+            # in pkg:// form, portable to whatever node runs the child.
             import json as _json
-            env["RAY_TPU_RT_ENV"] = _json.dumps(runtime_env)
+            env["RAY_TPU_RT_ENV"] = _json.dumps(orig_runtime_env)
         env.update({
             "RAY_TPU_AGENT_HOST": self.addr[0],
             "RAY_TPU_AGENT_PORT": str(self.addr[1]),
@@ -505,6 +543,9 @@ class NodeAgent:
         finally:
             if stdout is not None:
                 stdout.close()
+        # env materialized fine: clear any stale setup-failure note so
+        # later saturation isn't misreported as a broken runtime_env
+        self._venv_errors.pop(env_hash, None)
         w = WorkerHandle(worker_id=wid, proc=proc, env_hash=env_hash)
         if self.config.worker_cgroup_memory_bytes > 0:
             from ray_tpu.runtime.cgroup import WorkerCgroup

@@ -435,11 +435,31 @@ class ControlService:
 
     # --- kv ----------------------------------------------------------------
 
+    # runtime_env package blobs (__rtpkg:*) are capped: without
+    # eviction, every distinct working_dir version ever submitted lives
+    # in head memory forever. LRU by insertion order (dict order, with
+    # re-put moving a hit to the back); agents cache extractions
+    # locally, and a driver's publish re-checks existence and
+    # re-uploads an evicted package before use.
+    PKG_KV_CAP_BYTES = 1024 * 1024 * 1024
+
     async def kv_put(self, key: str, value: bytes, overwrite: bool = True):
         if not overwrite and key in self.kv:
+            if key.startswith("__rtpkg:"):
+                self.kv[key] = self.kv.pop(key)    # LRU touch
             return {"ok": False, "exists": True}
         self.kv[key] = value
         self._persist("kv", key, value)
+        if key.startswith("__rtpkg:"):
+            pkgs = [(k, len(v)) for k, v in self.kv.items()
+                    if k.startswith("__rtpkg:")]
+            total = sum(n for _, n in pkgs)
+            for k, n in pkgs:
+                if total <= self.PKG_KV_CAP_BYTES or k == key:
+                    break
+                del self.kv[k]
+                self._persist_del("kv", k)
+                total -= n
         return {"ok": True}
 
     async def kv_get(self, key: str):

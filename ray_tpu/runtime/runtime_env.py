@@ -69,15 +69,17 @@ def validate(runtime_env: Optional[dict]) -> Optional[dict]:
         out["env_vars"] = dict(sorted(ev.items()))
     wd = runtime_env.get("working_dir")
     if wd:
-        wd = os.path.abspath(wd)
-        if not os.path.isdir(wd):
-            raise ValueError(f"working_dir {wd!r} is not a directory")
+        if not wd.startswith(PKG_PREFIX):
+            wd = os.path.abspath(wd)
+            if not os.path.isdir(wd):
+                raise ValueError(f"working_dir {wd!r} is not a directory")
         out["working_dir"] = wd
     mods = runtime_env.get("py_modules")
     if mods:
-        mods = [os.path.abspath(m) for m in mods]
+        mods = [m if m.startswith(PKG_PREFIX) else os.path.abspath(m)
+                for m in mods]
         for m in mods:
-            if not os.path.exists(m):
+            if not m.startswith(PKG_PREFIX) and not os.path.exists(m):
                 raise ValueError(f"py_modules path {m!r} does not exist")
         out["py_modules"] = sorted(mods)
     return out or None
@@ -128,6 +130,180 @@ def env_hash(runtime_env: Optional[dict]) -> str:
         return ""
     blob = json.dumps(runtime_env, sort_keys=True).encode()
     return hashlib.sha1(blob).hexdigest()[:16]
+
+
+# --- working_dir / py_modules packaging -------------------------------
+# On a real multi-host cluster, worker nodes don't share the driver's
+# filesystem: local paths are packed into content-addressed zips in the
+# control KV at submit time ("pkg://<hash>/<name>") and extracted into
+# a per-node cache by the agent before worker spawn (reference:
+# _private/runtime_env/working_dir.py + packaging.py, which upload to
+# the GCS package store the same way).
+
+PKG_PREFIX = "pkg://"
+PKG_KV_PREFIX = "__rtpkg:"
+PKG_MAX_BYTES = 64 * 1024 * 1024        # control kv value cap
+
+_PACK_CACHE: dict = {}    # abs path -> (signature, "pkg://..." uri)
+
+
+def _dir_signature(path: str) -> tuple:
+    sig = []
+    for root, dirs, files in sorted(os.walk(path)):
+        dirs.sort()
+        for fn in sorted(files):
+            p = os.path.join(root, fn)
+            try:
+                st = os.stat(p)
+                sig.append((os.path.relpath(p, path), st.st_mtime_ns,
+                            st.st_size))
+            except OSError:
+                pass
+    return tuple(sig)
+
+
+def _pack_path(path: str) -> bytes:
+    """Deterministic zip of a file or directory tree."""
+    import io
+    import zipfile
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        if os.path.isdir(path):
+            for root, dirs, files in sorted(os.walk(path)):
+                dirs.sort()
+                for fn in sorted(files):
+                    p = os.path.join(root, fn)
+                    z.write(p, os.path.relpath(p, path))
+        else:
+            z.write(path, os.path.basename(path))
+    return buf.getvalue()
+
+
+def publish_packages(runtime_env: Optional[dict], kv_put,
+                     kv_has=None) -> Optional[dict]:
+    """Driver-side: replace local working_dir/py_modules paths with
+    content-addressed pkg:// uris, uploading each zip to the control
+    KV once (overwrite=False — content-addressed, so a repeat upload
+    is a no-op). ``kv_put(key, value)`` is the ctx's kv call;
+    ``kv_has(key) -> bool`` (optional) lets a local cache hit cheaply
+    verify the blob wasn't LRU-evicted from the head before skipping
+    the upload. Paths already in pkg:// form pass through (job-level
+    inheritance)."""
+    if not runtime_env:
+        return runtime_env
+
+    def to_uri(path: str) -> str:
+        if path.startswith(PKG_PREFIX):
+            return path
+        is_dir = os.path.isdir(path)
+        sig = (_dir_signature(path) if is_dir
+               else ("f", os.stat(path).st_mtime_ns))
+        hit = _PACK_CACHE.get(path)
+        if hit is not None and hit[0] == sig:
+            uri = hit[1]
+            if kv_has is None or kv_has(PKG_KV_PREFIX + pkg_digest(uri)):
+                return uri
+            # head evicted the blob since we last published: re-upload
+        data = _pack_path(path)
+        if len(data) > PKG_MAX_BYTES:
+            raise ValueError(
+                f"runtime_env package {path!r} is "
+                f"{len(data)} B zipped (> {PKG_MAX_BYTES}); ship big "
+                f"assets via the object store or bake them into the "
+                f"image")
+        digest = hashlib.sha1(data).hexdigest()[:20]
+        kv_put(PKG_KV_PREFIX + digest, data)
+        # the uri records whether the source was a file or a directory
+        # — extraction shape alone cannot distinguish a dir holding one
+        # same-named file from a packed file
+        kind = "d" if is_dir else "f"
+        uri = (f"{PKG_PREFIX}{digest}/{kind}/"
+               f"{os.path.basename(path.rstrip('/'))}")
+        _PACK_CACHE[path] = (sig, uri)
+        return uri
+
+    out = dict(runtime_env)
+    if out.get("working_dir"):
+        out["working_dir"] = to_uri(out["working_dir"])
+    if out.get("py_modules"):
+        out["py_modules"] = sorted(to_uri(m) for m in out["py_modules"])
+    return out
+
+
+def _pkg_cache_root() -> str:
+    return os.environ.get(
+        "RAY_TPU_PKG_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "ray_tpu",
+                     "pkgs"))
+
+
+def pkg_digest(uri: str) -> str:
+    assert uri.startswith(PKG_PREFIX), uri
+    return uri[len(PKG_PREFIX):].partition("/")[0]
+
+
+def pkg_is_cached(uri: str) -> bool:
+    """True when this node already extracted the package (agents skip
+    the KV download entirely then)."""
+    return os.path.exists(os.path.join(_pkg_cache_root(),
+                                       pkg_digest(uri), ".ready"))
+
+
+def materialize_package(uri: str, kv_get) -> str:
+    """Agent-side: pkg://<hash>/<d|f>/<name> -> local extracted path
+    (per-hash cache, lock-guarded extract-then-rename so a crashed
+    extraction never leaves a half directory)."""
+    import fcntl
+    import io
+    import shutil
+    import zipfile
+    rest = uri[len(PKG_PREFIX):]
+    digest, _, tail = rest.partition("/")
+    kind, _, name = tail.partition("/")
+    root = _pkg_cache_root()
+    os.makedirs(root, exist_ok=True)
+    final = os.path.join(root, digest)
+    marker = os.path.join(final, ".ready")
+    if not os.path.exists(marker):
+        with open(os.path.join(root, f".{digest}.lock"), "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if not os.path.exists(marker):
+                data = kv_get(PKG_KV_PREFIX + digest)
+                if not data:
+                    raise FileNotFoundError(
+                        f"runtime_env package {digest} not in the "
+                        f"cluster KV (evicted or head restarted "
+                        f"without persistence?)")
+                tmp = f"{final}.tmp{os.getpid()}"
+                shutil.rmtree(tmp, ignore_errors=True)
+                with zipfile.ZipFile(io.BytesIO(bytes(data))) as z:
+                    z.extractall(tmp)
+                open(os.path.join(tmp, ".ready"), "w").close()
+                os.replace(tmp, final)
+    # a packed FILE resolves to its single member; a DIRECTORY to the
+    # extraction root (the uri's kind segment decides — extraction
+    # shape alone is ambiguous)
+    if kind == "f":
+        return os.path.join(final, name)
+    return final
+
+
+def resolve_packages(runtime_env: Optional[dict], kv_get) -> Optional[dict]:
+    """Agent-side: swap pkg:// uris for local extracted paths before
+    the env is applied to a worker."""
+    if not runtime_env:
+        return runtime_env
+    out = dict(runtime_env)
+    wd = out.get("working_dir")
+    if wd and wd.startswith(PKG_PREFIX):
+        out["working_dir"] = materialize_package(wd, kv_get)
+        out["_wd_from_pkg"] = True    # workers cwd into a private copy
+    mods = out.get("py_modules")
+    if mods and any(m.startswith(PKG_PREFIX) for m in mods):
+        out["py_modules"] = [
+            materialize_package(m, kv_get)
+            if m.startswith(PKG_PREFIX) else m for m in mods]
+    return out
 
 
 # --- pip/uv cached venvs ----------------------------------------------
@@ -240,6 +416,10 @@ def apply_to_env(runtime_env: Optional[dict], env: dict) -> dict:
     if wd:
         paths.append(wd)
         env["RAY_TPU_RT_WORKING_DIR"] = wd
+        if runtime_env.get("_wd_from_pkg"):
+            # shared immutable cache entry: the worker must cwd into a
+            # private copy (see worker._amain)
+            env["RAY_TPU_RT_WD_COPY"] = "1"
     if paths:
         prev = env.get("PYTHONPATH", "")
         env["PYTHONPATH"] = os.pathsep.join(

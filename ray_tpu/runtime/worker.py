@@ -396,10 +396,21 @@ class WorkerExecutor:
 async def _amain():
     wd = os.environ.get("RAY_TPU_RT_WORKING_DIR")
     if wd:
-        # working_dir is NOT synced across nodes (no shared-fs
-        # assumption): create it empty where absent rather than
-        # crash-looping the worker on a remote node.
-        os.makedirs(wd, exist_ok=True)
+        # The agent resolved this path (package-cache extraction for
+        # pkg:// envs, local path otherwise) BEFORE spawning us — a
+        # missing dir is a real bug and must fail loudly, not run the
+        # task in a silently-empty directory.
+        if os.environ.get("RAY_TPU_RT_WD_COPY") == "1":
+            # cache entries are immutable + shared across jobs: give
+            # this worker a private mutable copy so cwd writes can't
+            # poison the content-addressed cache
+            import atexit
+            import shutil
+            import tempfile
+            priv = tempfile.mkdtemp(prefix="rtwd-")
+            shutil.copytree(wd, priv, dirs_exist_ok=True)
+            atexit.register(shutil.rmtree, priv, ignore_errors=True)
+            wd = priv
         os.chdir(wd)
     head = (os.environ["RAY_TPU_HEAD_HOST"],
             int(os.environ["RAY_TPU_HEAD_PORT"]))

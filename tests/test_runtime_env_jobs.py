@@ -81,7 +81,39 @@ def test_working_dir_and_py_modules(cluster, tmp_path):
         "py_modules": [str(tmp_path)]}).remote(), timeout=120)
     assert v == "from-py-module"
     assert data == "wd-file"
-    assert cwd == str(wd)
+    # the worker runs in a PRIVATE copy of the cluster-distributed
+    # package (multi-host: nodes don't share the FS; cwd writes must
+    # not poison the shared content-addressed cache)
+    assert cwd != str(wd) and "/rtwd-" in cwd
+
+
+def test_working_dir_ships_through_cluster_kv(cluster, tmp_path,
+                                              monkeypatch):
+    """Packages travel content-addressed through the control KV: the
+    task still runs after the driver's source directory is DELETED —
+    proof that no worker touched the original path (reference:
+    _private/runtime_env/working_dir.py upload/download)."""
+    import shutil
+
+    wd = tmp_path / "shipme"
+    wd.mkdir()
+    (wd / "payload.txt").write_text("shipped-bytes")
+
+    @ray_tpu.remote
+    def probe():
+        with open("payload.txt") as f:
+            return f.read(), os.getcwd()
+
+    fn = probe.options(runtime_env={"working_dir": str(wd)})
+    fn._cached_runtime_env()       # publish to the KV
+    shutil.rmtree(wd)              # the local dir is GONE before exec
+    data, cwd = ray_tpu.get(fn.remote(), timeout=120)
+    assert data == "shipped-bytes"
+    assert "/rtwd-" in cwd          # private per-worker copy
+    assert not os.path.exists(str(wd))
+    # nested inheritance stays portable (pkg:// form, re-resolvable)
+    env = fn._cached_runtime_env()
+    assert env["working_dir"].startswith("pkg://")
 
 
 def test_actor_runtime_env(cluster):
