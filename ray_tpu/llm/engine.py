@@ -367,10 +367,16 @@ class LLMEngine:
         import jax.numpy as jnp
         self._step += block
         key = jax.random.fold_in(self._key, self._step)
+        # The top-p/top-k filters cost two O(V log V) vocab sorts per
+        # decode step: only pay them when some ACTIVE request enabled
+        # a filter (None compiles the plain sampler — one extra jit
+        # variant, bounded).
+        filters_on = bool((top_ps < 1.0).any() or (top_ks > 0).any())
         out, self._cache = lm.decode_steps(
             self.params, self._cache, jnp.asarray(tokens),
             jnp.asarray(temps), key, self.cfg, block,
-            jnp.asarray(top_ps), jnp.asarray(top_ks))
+            jnp.asarray(top_ps) if filters_on else None,
+            jnp.asarray(top_ks) if filters_on else None)
         return np.asarray(out)
 
     def _sample_one(self, logits: np.ndarray, r: _Request) -> int:
