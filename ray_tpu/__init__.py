@@ -13,7 +13,8 @@ from ray_tpu.version import __version__
 _API = (
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
-    "available_resources", "timeline", "ObjectRef", "ActorHandle",
+    "available_resources", "timeline", "ObjectRef", "ObjectRefGenerator",
+    "ActorHandle",
     "free", "get_async", "placement_group", "remove_placement_group",
     "PlacementGroup",
     # exceptions (the reference exports these at top level too)

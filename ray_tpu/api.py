@@ -30,7 +30,8 @@ from ray_tpu.runtime.ids import ActorID, JobID, NodeID, PlacementGroupID
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
-    "available_resources", "timeline", "ObjectRef", "ActorHandle",
+    "available_resources", "timeline", "ObjectRef", "ObjectRefGenerator",
+    "ActorHandle",
     "placement_group", "remove_placement_group", "PlacementGroup",
     "get_async", "free", "RayTpuError", "TaskError", "ActorError",
     "ActorDiedError", "WorkerCrashedError", "ObjectLostError",
@@ -341,6 +342,81 @@ def free(refs: Sequence[ObjectRef]) -> None:
     _run(ctx.free(list(refs)))
 
 
+class ObjectRefGenerator:
+    """Consumer side of a ``num_returns="streaming"`` call (reference:
+    python/ray/_private/object_ref_generator.py:32 ObjectRefGenerator).
+
+    Iterating (sync ``for`` or ``async for``) yields ObjectRefs in the
+    order the producer yielded values, as they are produced — each ref
+    is already resolved in this process, so ``ray_tpu.get(ref)`` on it
+    is a local memory-store hit. A producer error terminates the stream
+    by raising AFTER all previously-yielded items are delivered.
+
+    Consumption is owner-process-only: the generator is not picklable
+    (pass the deployment/actor handle and stream there instead)."""
+
+    def __init__(self, stream_id):
+        self._stream_id = stream_id
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        # Only genuine termination marks the generator done: a transient
+        # failure (timeout, wrong-thread RuntimeError) must leave close()
+        # able to release the stream. Producer errors delete the owner
+        # state themselves, so close() after them is already a no-op.
+        try:
+            return _run(_g.ctx.stream_next(self._stream_id))
+        except StopAsyncIteration:
+            self._done = True
+            raise StopIteration
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        try:
+            return await _g.ctx.stream_next(self._stream_id)
+        except StopAsyncIteration:
+            self._done = True
+            raise
+
+    def next_ready(self, timeout: float) -> ObjectRef:
+        """__next__ with a timeout (raises GetTimeoutError)."""
+        try:
+            return _run(_g.ctx.stream_next(self._stream_id, timeout))
+        except StopAsyncIteration:
+            self._done = True
+            raise StopIteration
+
+    def close(self):
+        """Abandon the stream: the producer observes the closure on its
+        next push and stops the generator."""
+        if self._done:
+            return
+        self._done = True
+        ctx, loop = _g.ctx, (_g.elt.loop if _g.elt else _g.ctx_loop)
+        if ctx is not None and loop is not None:
+            try:
+                loop.call_soon_threadsafe(ctx.close_stream,
+                                          self._stream_id)
+            except RuntimeError:
+                pass  # loop already gone (shutdown)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        raise TypeError(
+            "ObjectRefGenerator is not picklable: streams are consumed "
+            "in the owner process")
+
+
 # --- tasks ------------------------------------------------------------------
 
 def _resolve_runtime_env(opts: dict):
@@ -428,6 +504,8 @@ class RemoteFunction:
             pg=_pg_tuple(opts),
             policy=opts.get("scheduling_strategy", "default"),
             runtime_env=self._cached_runtime_env())
+        if num_returns == "streaming":
+            return ObjectRefGenerator(refs)  # refs IS the stream id
         return refs[0] if num_returns == 1 else refs
 
     def _cached_runtime_env(self):
@@ -466,6 +544,8 @@ class ActorMethod:
             num_returns=num_returns,
             max_task_retries=self._opts.get(
                 "max_task_retries", self._handle._max_task_retries))
+        if num_returns == "streaming":
+            return ObjectRefGenerator(refs)  # refs IS the stream id
         return refs[0] if num_returns == 1 else refs
 
     def bind(self, *args):

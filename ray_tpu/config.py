@@ -52,6 +52,8 @@ class Config:
     shm_fallback_dir: str = "/tmp"
     object_transfer_chunk_bytes: int = 4 * 1024 * 1024
     object_spill_dir: str = ""              # "" = <session>/spill
+    stream_backpressure_window: int = 64    # unconsumed items per stream
+    stream_producer_inflight: int = 8       # unacked pushes per producer
 
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0

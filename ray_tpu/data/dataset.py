@@ -236,39 +236,42 @@ class Dataset:
     def streaming_split(self, n: int, *, equal: bool = True
                         ) -> List["DataIterator"]:
         """n per-worker iterators (reference: dataset.py:2037
-        streaming_split feeding one trainer each via
-        stream_split_iterator.py). Blocks are materialized into the object
-        store and dealt round-robin; each shard iterator pulls its blocks
-        through the object plane on its own host."""
-        import ray_tpu
-        from ray_tpu.data.iterator import DataIterator
-        shard_refs: List[List] = [[] for _ in range(n)]
-        if equal:
-            # Exact row-balanced shards: merge then slice (blocks larger
-            # than a shard must be split by rows, not dealt whole).
-            blocks = [b for b in self.iter_blocks() if block_num_rows(b)]
-            merged = block_concat(blocks) if blocks else {}
-            total = block_num_rows(merged)
-            per, extra = divmod(total, n)
-            start = 0
-            for j in range(n):
-                end = start + per + (1 if j < extra else 0)
-                if end > start:
-                    shard_refs[j].append(
-                        ray_tpu.put(block_slice(merged, start, end)))
-                start = end
-        else:
-            for i, b in enumerate(self.iter_blocks()):
-                if block_num_rows(b):
-                    shard_refs[i % n].append(ray_tpu.put(b))
+        streaming_split + _internal/iterator/stream_split_iterator.py).
+        Each shard iterator opens a push-based streaming TASK
+        (num_returns="streaming") at iteration time: the producer runs
+        the plan on a worker and yields only that shard's row-slices,
+        so blocks flow producer -> consumer as they are produced with
+        stream-window-bounded memory — no upfront materialization.
+        Iterators are picklable (plan payload + shard index), open
+        their stream in the CONSUMING process (each train worker owns
+        its own stream), and are re-iterable: every epoch submits a
+        fresh producer task.
 
-        def make_iter(refs):
+        Unlike the reference's coordinator-actor design, shards execute
+        the plan independently (n plan runs instead of one) — the
+        tradeoff buys re-iterability and zero idle-actor footprint."""
+        from ray_tpu.data.iterator import DataIterator
+        import cloudpickle
+        payload = cloudpickle.dumps(self._ops, protocol=5)
+
+        def make_iter(idx):
             def gen():
                 import ray_tpu as rt
-                for r in refs:
-                    yield rt.get(r)
+                g = rt.remote(_produce_shard).options(
+                    num_returns="streaming").remote(payload, idx, n,
+                                                    equal)
+                try:
+                    for ref in g:
+                        b = rt.get(ref)
+                        # consumed: free now — multi-epoch re-iteration
+                        # mints fresh oids each pass, so unfreed blocks
+                        # would accumulate in this worker's store
+                        rt.free([ref])
+                        yield b
+                finally:
+                    g.close()  # early exit stops this shard's stream
             return DataIterator(gen)
-        return [make_iter(refs) for refs in shard_refs]
+        return [make_iter(i) for i in range(n)]
 
     def split(self, n: int) -> List["Dataset"]:
         blocks = list(self.iter_blocks())
@@ -304,6 +307,35 @@ class Dataset:
     def __repr__(self):
         names = "->".join(op.name for op in self._ops)
         return f"Dataset({names})"
+
+
+def _produce_shard(ops_payload: bytes, shard: int, n: int, equal: bool):
+    """Streaming-split producer task (sync generator; runs under
+    num_returns="streaming"): executes the plan and yields shard
+    `shard`'s blocks. equal=True row-slices every block across all
+    shards (rotating the remainder rows) so shards stay row-balanced
+    without knowing the total row count up front; equal=False deals
+    whole blocks round-robin."""
+    import cloudpickle
+    ops = cloudpickle.loads(ops_payload)
+    rr = 0
+    for b in Dataset(ops).iter_blocks():
+        rows = block_num_rows(b)
+        if not rows:
+            continue
+        if equal:
+            per, extra = divmod(rows, n)
+            start = 0
+            for j in range(n):
+                cnt = per + (1 if (j - rr) % n < extra else 0)
+                if cnt and j == shard:
+                    yield block_slice(b, start, start + cnt)
+                start += cnt
+            rr = (rr + extra) % n
+        else:
+            if rr % n == shard:
+                yield b
+            rr += 1
 
 
 class GroupedData:

@@ -200,6 +200,45 @@ class _TaskSpec:
     oids: List[ObjectID]
     retries: int
     attempt: int = 0
+    stream_id: Optional[ObjectID] = None
+
+
+class _StreamState:
+    """Owner-side state of one streaming-generator return (the
+    ObjectRefGenerator analog — reference:
+    python/ray/_private/object_ref_generator.py:32, with the C++ stream
+    bookkeeping of task_manager.cc HandleReportGeneratorItemReturns
+    collapsed into this owner-resident object).
+
+    Items arrive as `stream_item` RPCs from the producing worker and are
+    delivered to the consumer in index order. Backpressure: once
+    `window` items sit unconsumed, arriving handlers PARK (delaying
+    their RPC replies) until the consumer drains — the producer's
+    bounded-inflight push loop then stalls, so an unread stream never
+    grows past window + producer_inflight items."""
+
+    __slots__ = ("ready", "buffer", "next_index", "ended", "end_error",
+                 "event", "closed", "window", "gate", "peak_unconsumed",
+                 "done")
+
+    def __init__(self, window: int):
+        from collections import deque
+        self.ready: "deque" = deque()   # ObjectRefs, delivery order
+        self.buffer: Dict[int, ObjectRef] = {}  # out-of-order arrivals
+        self.next_index = 0
+        self.ended = False
+        self.end_error: Optional[bytes] = None
+        self.event = asyncio.Event()    # consumer wakeup
+        self.closed = False             # consumer abandoned the stream
+        self.window = window
+        self.gate = asyncio.Event()     # producer-side backpressure
+        self.gate.set()
+        self.peak_unconsumed = 0        # observability (tests assert it)
+        self.done = asyncio.Event()     # terminated (ended/closed/failed)
+
+    @property
+    def unconsumed(self) -> int:
+        return len(self.ready) + len(self.buffer)
 
 
 # --- lease pool -------------------------------------------------------------
@@ -491,8 +530,11 @@ class CoreContext:
         self.server = rpc.RpcServer({
             "fetch_object": self._handle_fetch_object,
             "reconstruct_object": self._handle_reconstruct_object,
+            "stream_item": self._handle_stream_item,
+            "stream_end": self._handle_stream_end,
             "ping": self._handle_ping,
         })
+        self._streams: Dict[ObjectID, _StreamState] = {}
         self.addr: Optional[Tuple[str, int]] = None
         self.leases = LeasePool(self)
         self.fn_cache = FunctionCache()
@@ -839,6 +881,132 @@ class CoreContext:
             return {"kind": "shm", "size": e.shm_size}
         return {"kind": "lost"}
 
+    # --- streaming generator returns ---------------------------------------
+
+    def create_stream(self, window: Optional[int] = None) -> ObjectID:
+        """Register owner-side state for a new streaming return and hand
+        back its stream id (an ObjectID so worker->owner RPCs reuse the
+        id plumbing)."""
+        sid = ObjectID.generate()
+        self._streams[sid] = _StreamState(
+            window or self.config.stream_backpressure_window)
+        return sid
+
+    async def _handle_stream_item(self, stream_id: ObjectID, index: int,
+                                  oid: ObjectID, frame=None,
+                                  shm_size=None):
+        """Producer pushed one yielded object. Parks (delaying the RPC
+        reply, which stalls the producer's bounded-inflight loop) while
+        the consumer is `window` items behind."""
+        st = self._streams.get(stream_id)
+        if st is None or st.closed:
+            return {"closed": True}
+        while st.unconsumed >= st.window and not st.closed:
+            st.gate.clear()
+            await st.gate.wait()
+        st = self._streams.get(stream_id)  # may have closed while parked
+        if st is None or st.closed:
+            return {"closed": True}
+        if frame is not None:
+            self.store.resolve(oid, frame=frame)
+        else:
+            self.store.resolve(oid, shm_size=shm_size)
+        st.buffer[index] = ObjectRef(oid, self.addr,
+                                     shm_size or len(frame or b""))
+        while st.next_index in st.buffer:
+            st.ready.append(st.buffer.pop(st.next_index))
+            st.next_index += 1
+        st.peak_unconsumed = max(st.peak_unconsumed, st.unconsumed)
+        st.event.set()
+        return {"ok": True}
+
+    async def _handle_stream_end(self, stream_id: ObjectID,
+                                 error_frame=None):
+        st = self._streams.get(stream_id)
+        if st is None:
+            return {"closed": True}
+        st.ended = True
+        st.end_error = error_frame
+        st.event.set()
+        st.done.set()
+        return {"ok": True}
+
+    def fail_stream(self, stream_id: ObjectID, err: Exception):
+        """Owner-side termination: the producer died before sending
+        stream_end (connection lost / lease failure / dep failure)."""
+        st = self._streams.get(stream_id)
+        if st is None or st.ended:
+            return
+        st.ended = True
+        st.end_error = dumps_oob(err)
+        st.event.set()
+        st.done.set()
+
+    async def stream_done(self, stream_id: ObjectID):
+        """Resolves when the stream terminates (ended, failed, or
+        closed) — the load-tracking signal for routers."""
+        st = self._streams.get(stream_id)
+        if st is None:
+            return
+        await st.done.wait()
+
+    def close_stream(self, stream_id: ObjectID):
+        """Consumer abandoned the stream: drop state and unblock any
+        parked producer handlers (their replies say closed -> the
+        producer stops the generator). Later stream_item RPCs find no
+        state and also get closed=True."""
+        st = self._streams.pop(stream_id, None)
+        if st is None:
+            return
+        st.closed = True
+        st.gate.set()
+        st.event.set()
+        st.done.set()
+        for ref in st.ready:
+            self.store.delete(ref.oid)
+        for ref in st.buffer.values():
+            self.store.delete(ref.oid)
+
+    async def stream_next(self, stream_id: ObjectID,
+                          timeout: Optional[float] = None) -> ObjectRef:
+        """Next ready ObjectRef in the stream, in yield order. Raises
+        StopAsyncIteration at a clean end, the producer's error at a
+        failed end (the partial prefix is still delivered first)."""
+        st = self._streams.get(stream_id)
+        if st is None:
+            raise StopAsyncIteration
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        while True:
+            if st.ready:
+                ref = st.ready.popleft()
+                if st.unconsumed < st.window:
+                    st.gate.set()
+                return ref
+            if st.ended:
+                del self._streams[stream_id]
+                # an error-terminated stream can hold undelivered
+                # out-of-order items (a gap index never arrived): their
+                # store entries would otherwise leak, unreachable
+                for ref in st.buffer.values():
+                    self.store.delete(ref.oid)
+                if st.end_error is not None:
+                    raise self._loads_error(st.end_error)
+                raise StopAsyncIteration
+            st.event.clear()
+            if deadline is None:
+                await st.event.wait()
+            else:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise GetTimeoutError(
+                        f"stream item not ready after {timeout}s")
+                try:
+                    await asyncio.wait_for(st.event.wait(), remaining)
+                except asyncio.TimeoutError:
+                    raise GetTimeoutError(
+                        f"stream item not ready after {timeout}s")
+
     async def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
                    timeout: Optional[float] = None,
                    in_task: bool = False):
@@ -941,13 +1109,22 @@ class CoreContext:
         _M_TASKS().inc()
         tracing.record_submit(task_id.hex(), "task",
                               getattr(fn, "__name__", "?"))
+        streaming = num_returns == "streaming"
+        if streaming:
+            # Re-executing a generator would replay already-delivered
+            # items; producer death error-terminates the stream instead
+            # (reference: streaming generators are retried only with
+            # replay suppression — out of scope here).
+            num_returns, retries = 0, 0
+            stream_id = self.create_stream()
         oids = [ObjectID.generate() for _ in range(num_returns)]
         for oid in oids:
             self.store.create_pending(oid)
         refs = [ObjectRef(oid, self.addr) for oid in oids]
         digest = self.fn_cache.digest_for(fn)
         args_frame = dumps_oob((args, kwargs))
-        spec = _TaskSpec(task_id, digest, args_frame, oids, retries)
+        spec = _TaskSpec(task_id, digest, args_frame, oids, retries,
+                         stream_id=stream_id if streaming else None)
         from ray_tpu.runtime.runtime_env import to_key
         key = LeasePool.shape_key(resources, pg, policy,
                                   to_key(runtime_env))
@@ -962,14 +1139,14 @@ class CoreContext:
                             self._enqueue_after_deps(key, spec, deps))
         else:
             self._stage_put(self._enqueue_task, key, spec)
-        return refs
+        return spec.stream_id if streaming else refs
 
     async def _enqueue_after_deps(self, key: tuple, spec: "_TaskSpec",
                                   deps: List[ObjectRef]):
         try:
             await asyncio.gather(*[self._await_ready(r) for r in deps])
         except Exception as e:  # noqa: BLE001 — dep fetch failed
-            self._fail_all(spec.oids, RayTpuError(
+            self._fail_spec(spec, RayTpuError(
                 f"task dependency resolution failed: {e}"))
             return
         self._enqueue_task(key, spec)
@@ -1063,7 +1240,7 @@ class CoreContext:
                             q.append(spec)
                             await asyncio.sleep(1.0)
                             continue
-                        self._fail_all(spec.oids, e if isinstance(
+                        self._fail_spec(spec, e if isinstance(
                             e, RayTpuError) else WorkerCrashedError(
                             f"lease failed: {e}"))
                         continue
@@ -1083,7 +1260,7 @@ class CoreContext:
                     err = (e if isinstance(e, RayTpuError)
                            else WorkerCrashedError(f"lease failed: {e}"))
                     while q:
-                        self._fail_all(q.popleft().oids, err)
+                        self._fail_spec(q.popleft(), err)
                     return
                 if not q:
                     await self.leases.release_slot(lw)
@@ -1092,10 +1269,19 @@ class CoreContext:
                 # workers before coalescing (no head-of-line blocking of a
                 # fast task behind a slow one when capacity is free);
                 # batch only once the backlog exceeds the pump count.
+                # Streaming tasks always go ALONE: their batch reply is
+                # held open for the stream's whole (consumer-paced)
+                # lifetime, and co-batched tasks would be head-of-line
+                # blocked behind it indefinitely.
                 width = min(TASK_BATCH_MAX,
                             -(-len(q) // max(st["pumps"], 1)))
-                batch = [q.popleft()
-                         for _ in range(min(len(q), width))]
+                batch = []
+                while q and len(batch) < width:
+                    if q[0].stream_id is not None:
+                        if not batch:
+                            batch.append(q.popleft())
+                        break
+                    batch.append(q.popleft())
                 st["sending"] += 1
                 try:
                     await self._send_task_batch(key, st, lw, batch)
@@ -1117,7 +1303,7 @@ class CoreContext:
             calls.append({
                 "task_id": s.task_id, "fn_digest": s.digest,
                 "fn_payload": payload, "args_frame": s.args_frame,
-                "return_oids": s.oids})
+                "return_oids": s.oids, "stream_id": s.stream_id})
         try:
             r = await self.pool.call(
                 lw.worker_addr, "exec_task_batch", calls=calls,
@@ -1128,14 +1314,14 @@ class CoreContext:
             # leave it stuck in LEASED forever, leaking slots).
             await self.leases.release_slot(lw)
             for s in batch:
-                self._fail_all(s.oids, TaskError(str(e)))
+                self._fail_spec(s, TaskError(str(e)))
             return
         except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
             await self.leases.release_slot(lw, dead=True)
             for s in batch:
                 s.attempt += 1
                 if s.attempt > s.retries:
-                    self._fail_all(s.oids, WorkerCrashedError(
+                    self._fail_spec(s, WorkerCrashedError(
                         f"task {s.task_id} failed after {s.attempt} "
                         f"attempts: {e}"))
                 else:
@@ -1180,6 +1366,14 @@ class CoreContext:
         frame = dumps_oob(err)
         for oid in oids:
             self.store.resolve(oid, error_frame=frame)
+
+    def _fail_spec(self, spec: "_TaskSpec", err: Exception):
+        """Fail a task at the spec level: regular returns get error
+        frames; a streaming task's stream is error-terminated (producer
+        death must surface to the consumer, not hang it)."""
+        self._fail_all(spec.oids, err)
+        if spec.stream_id is not None:
+            self.fail_stream(spec.stream_id, err)
 
     # --- actors -------------------------------------------------------------
 
@@ -1238,6 +1432,12 @@ class CoreContext:
                                num_returns: int = 1,
                                max_task_retries: int = 0) -> List[ObjectRef]:
         """Thread-safe actor-call submission (see submit_task_sync)."""
+        streaming = num_returns == "streaming"
+        stream_id = None
+        if streaming:
+            # no re-execution for streams (see submit_task_sync)
+            num_returns, max_task_retries = 0, 0
+            stream_id = self.create_stream()
         oids = [ObjectID.generate() for _ in range(num_returns)]
         if oids:
             tracing.record_submit(oids[0].hex(), "actor", method)
@@ -1246,8 +1446,9 @@ class CoreContext:
         refs = [ObjectRef(oid, self.addr) for oid in oids]
         args_frame = dumps_oob((args, kwargs))
         self._stage_put(self._enqueue_actor_call, actor_id,
-                        (method, args_frame, oids, max_task_retries, 0))
-        return refs
+                        (method, args_frame, oids, max_task_retries, 0,
+                         stream_id))
+        return stream_id if streaming else refs
 
     async def submit_actor_call(self, actor_id: ActorID, method: str,
                                 args: tuple, kwargs: dict,
@@ -1301,10 +1502,14 @@ class CoreContext:
                 # Batch ONLY when execution is serialized anyway
                 # (max_concurrency == 1): a batch gets one reply, so in a
                 # concurrent actor a fast call's result would wait on the
-                # slowest call in its batch.
-                if mc == 1:
-                    batch = [q.popleft()
-                             for _ in range(min(len(q), ACTOR_BATCH_MAX))]
+                # slowest call in its batch. Streaming calls always go
+                # alone — their reply is held for the stream's whole
+                # consumer-paced lifetime.
+                if mc == 1 and q[0][5] is None:
+                    batch = []
+                    while q and len(batch) < ACTOR_BATCH_MAX \
+                            and q[0][5] is None:
+                        batch.append(q.popleft())
                 else:
                     batch = [q.popleft()]
                 fut = asyncio.ensure_future(
@@ -1319,18 +1524,20 @@ class CoreContext:
 
     async def _drive_actor_batch(self, actor_id: ActorID, batch: list):
         if len(batch) == 1:
-            method, args_frame, oids, retries, _att = batch[0]
+            method, args_frame, oids, retries, _att, stream_id = batch[0]
             await self._drive_actor_call(
-                actor_id, method, args_frame, oids, retries)
+                actor_id, method, args_frame, oids, retries, stream_id)
             return
-        calls = [{"method": m, "args_frame": af, "return_oids": oids}
-                 for (m, af, oids, _r, _a) in batch]
+        calls = [{"method": m, "args_frame": af, "return_oids": oids,
+                  "stream_id": sid}
+                 for (m, af, oids, _r, _a, sid) in batch]
         try:
             addr = await self.resolve_actor_addr(actor_id)
             r = await self.pool.call(
                 addr, "actor_call_batch", actor_id=actor_id,
                 calls=calls, owner_addr=self.addr, timeout=None)
-            for res, (_m, _af, oids, _r2, _a) in zip(r["batch"], batch):
+            for res, (_m, _af, oids, _r2, _a, _s) in zip(
+                    r["batch"], batch):
                 self._apply_result(oids, res)
         except (rpc.ConnectionLost, OSError) as e:
             # Per-call retry budgets: a call with max_task_retries=0 must
@@ -1338,12 +1545,16 @@ class CoreContext:
             # back through the pump individually.
             self._actor_addr_cache.pop(actor_id, None)
             retryable = []
-            for (m, af, oids, retries, attempt) in batch:
+            for (m, af, oids, retries, attempt, sid) in batch:
                 if attempt + 1 > retries:
                     self._fail_all(oids, ActorDiedError(
                         f"actor {actor_id} connection lost: {e}"))
+                    if sid is not None:
+                        self.fail_stream(sid, ActorDiedError(
+                            f"actor {actor_id} connection lost: {e}"))
                 else:
-                    retryable.append((m, af, oids, retries, attempt + 1))
+                    retryable.append(
+                        (m, af, oids, retries, attempt + 1, sid))
             if retryable:
                 await asyncio.sleep(0.2)
                 for call in retryable:
@@ -1351,11 +1562,13 @@ class CoreContext:
         except (rpc.RemoteError, ActorError) as e:
             err = (TaskError(str(e))
                    if isinstance(e, rpc.RemoteError) else e)
-            for (_m, _af, oids, _r2, _a) in batch:
+            for (_m, _af, oids, _r2, _a, sid) in batch:
                 self._fail_all(oids, err)
+                if sid is not None:
+                    self.fail_stream(sid, err)
 
     async def _drive_actor_call(self, actor_id, method, args_frame, oids,
-                                retries):
+                                retries, stream_id=None):
         attempt = 0
         while True:
             try:
@@ -1363,22 +1576,30 @@ class CoreContext:
                 r = await self.pool.call(
                     addr, "actor_call", actor_id=actor_id, method=method,
                     args_frame=args_frame, return_oids=oids,
-                    owner_addr=self.addr, timeout=None)
+                    owner_addr=self.addr, stream_id=stream_id,
+                    timeout=None)
                 self._apply_result(oids, r)
                 return
             except (rpc.ConnectionLost, OSError) as e:
                 self._actor_addr_cache.pop(actor_id, None)
                 attempt += 1
                 if attempt > retries:
-                    self._fail_all(oids, ActorDiedError(
-                        f"actor {actor_id} connection lost: {e}"))
+                    err = ActorDiedError(
+                        f"actor {actor_id} connection lost: {e}")
+                    self._fail_all(oids, err)
+                    if stream_id is not None:
+                        self.fail_stream(stream_id, err)
                     return
                 await asyncio.sleep(0.2 * attempt)
             except rpc.RemoteError as e:
                 self._fail_all(oids, TaskError(str(e)))
+                if stream_id is not None:
+                    self.fail_stream(stream_id, TaskError(str(e)))
                 return
             except ActorError as e:
                 self._fail_all(oids, e)
+                if stream_id is not None:
+                    self.fail_stream(stream_id, e)
                 return
 
     async def kill_actor(self, actor_id: ActorID, no_restart: bool = True):

@@ -33,6 +33,17 @@ from ray_tpu.runtime.serialization import dumps_oob, loads_oob, serialize
 from ray_tpu.util import tracing
 
 
+def _task_error_frame(exc: BaseException) -> bytes:
+    """Serialized TaskError carrying the remote traceback (cause dropped
+    when it doesn't pickle)."""
+    import traceback
+    tb = "".join(traceback.format_exception(exc))
+    try:
+        return dumps_oob(TaskError(tb, cause=exc))
+    except Exception:
+        return dumps_oob(TaskError(tb))
+
+
 class _BatchError:
     """Marks a per-call failure inside a batch executed on the worker
     thread (exceptions can't be raised per-slot there)."""
@@ -89,13 +100,139 @@ class WorkerExecutor:
                 out.append({"kind": "shm", "size": size})
         return {"results": out}
 
-    def _package_error(self, exc: BaseException, oids) -> dict:
-        import traceback
-        tb = "".join(traceback.format_exception(exc))
+    # --- streaming generator returns -----------------------------------
+
+    async def _drive_stream(self, fn, args, kwargs, stream_id,
+                            owner_addr, pool=None) -> dict:
+        """Execute a generator task/method and push each yielded object
+        to the owner as it is produced (reference: the task_manager
+        HandleReportGeneratorItemReturns protocol, collapsed onto the
+        existing object plane: small items ride the stream_item RPC
+        inline, large ones go through the node's shm store first).
+
+        Pushes are pipelined up to `stream_producer_inflight` unacked
+        RPCs; the owner delays acks while its unconsumed window is full,
+        so that bound IS the producer-side backpressure. A {"closed"}
+        ack (consumer abandoned the stream) stops the generator."""
+        from ray_tpu.runtime.serialization import serialize as _ser
+        owner_addr = tuple(owner_addr)
+        max_inflight = self.ctx.config.stream_producer_inflight
+        inflight: set = set()
+        closed = False
+
+        async def push(index, item):
+            oid = ObjectID.generate()
+            ser = _ser(item)
+            if ser.total_bytes <= self.ctx.config.inline_object_max_bytes:
+                r = await self.ctx.pool.call(
+                    owner_addr, "stream_item", stream_id=stream_id,
+                    index=index, oid=oid, frame=ser.to_bytes(),
+                    timeout=None)
+            else:
+                size = await self.ctx.put_shm(oid, ser)
+                r = await self.ctx.pool.call(
+                    owner_addr, "stream_item", stream_id=stream_id,
+                    index=index, oid=oid, shm_size=size, timeout=None)
+            return bool(r.get("closed"))
+
+        push_err = None
+
+        async def admit():
+            """Cap unacked pushes; a closed-stream ack stops production
+            cleanly, a failed push (lost item) stops it and is re-raised
+            after the loop so the stream error-terminates instead of
+            silently truncating."""
+            nonlocal closed, push_err
+            while len(inflight) >= max_inflight:
+                done, _ = await asyncio.wait(
+                    inflight, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    inflight.discard(t)
+                    try:
+                        if t.result():
+                            closed = True
+                    except Exception as e:
+                        closed = True
+                        if push_err is None:
+                            push_err = e
+
+        gen = None
         try:
-            frame = dumps_oob(TaskError(tb, cause=exc))
+            if inspect.isasyncgenfunction(fn):
+                gen = fn(*args, **kwargs)
+            elif inspect.isgeneratorfunction(fn):
+                # user code runs off-loop: one executor hop per item
+                from ray_tpu.util.aio import drive_sync_gen
+                gen = drive_sync_gen(fn(*args, **kwargs),
+                                     pool or self.task_pool)
+            elif inspect.iscoroutinefunction(fn):
+                raise TaskError(
+                    "num_returns='streaming' requires a generator "
+                    "function (got a coroutine function; make it an "
+                    "async generator with `yield`)")
+            else:
+                raise TaskError(
+                    "num_returns='streaming' requires a (sync or "
+                    f"async) generator function, got "
+                    f"{getattr(fn, '__name__', fn)!r}")
+            index = 0
+            async for item in gen:
+                await admit()
+                if closed:
+                    break
+                inflight.add(asyncio.ensure_future(push(index, item)))
+                index += 1
+            if push_err is not None:
+                raise push_err
+            if inflight:
+                acks = await asyncio.gather(*inflight,
+                                            return_exceptions=True)
+                for a in acks:
+                    if isinstance(a, BaseException):
+                        # A lost push would silently truncate the stream
+                        # (the owner delivers in index order): surface it
+                        # so the stream terminates with an error instead.
+                        raise a
+                    if a:
+                        closed = True
+            if not closed:
+                await self.ctx.pool.call(
+                    owner_addr, "stream_end", stream_id=stream_id,
+                    timeout=None)
+        except BaseException as e:  # noqa: BLE001 — error-terminate
+            if inflight:
+                await asyncio.gather(*inflight, return_exceptions=True)
+            try:
+                await self.ctx.pool.call(
+                    owner_addr, "stream_end", stream_id=stream_id,
+                    error_frame=_task_error_frame(e), timeout=None)
+            except Exception:
+                pass  # owner gone: nobody left to tell
+        finally:
+            if closed and gen is not None:
+                # consumer walked away mid-stream: stop the generator so
+                # its finally blocks run now, not at GC time
+                try:
+                    if hasattr(gen, "aclose"):
+                        await gen.aclose()
+                    else:
+                        gen.close()
+                except Exception:
+                    pass
+        return {"results": []}
+
+    async def _fail_stream_remote(self, stream_id, owner_addr,
+                                  exc: BaseException):
+        """Error-terminate a stream whose drive never started."""
+        try:
+            await self.ctx.pool.call(
+                tuple(owner_addr), "stream_end", stream_id=stream_id,
+                error_frame=_task_error_frame(exc), timeout=None)
         except Exception:
-            frame = dumps_oob(TaskError(tb))
+            pass  # owner gone
+
+    def _package_error(self, exc: BaseException, oids) -> dict:
+        frame = _task_error_frame(exc)
         return {"results": [{"kind": "error", "frame": frame}
                             for _ in oids]}
 
@@ -124,20 +261,32 @@ class WorkerExecutor:
 
     async def exec_task(self, task_id: TaskID, fn_digest: bytes,
                         fn_payload: Optional[bytes], args_frame: bytes,
-                        return_oids: List[ObjectID], owner_addr):
+                        return_oids: List[ObjectID], owner_addr,
+                        stream_id=None):
         if task_id in self.cancelled:
             self.cancelled.discard(task_id)
-            return self._package_error(
-                TaskError("task cancelled"), return_oids)
+            e0 = TaskError("task cancelled")
+            if stream_id is not None:
+                await self._fail_stream_remote(stream_id, owner_addr, e0)
+                return {"results": []}
+            return self._package_error(e0, return_oids)
         fn = self.ctx.fn_cache.resolve(fn_digest, fn_payload)
         t0, err = time.time(), False
         tok = tracing.current_span.set(task_id.hex())
         try:
             args, kwargs = await self._resolve_args(args_frame)
+            if stream_id is not None:
+                return await self._drive_stream(
+                    fn, args, kwargs, stream_id, owner_addr)
             value = await self._run_callable(fn, args, kwargs)
             return await self._package(value, return_oids)
         except BaseException as e:  # noqa: BLE001
             err = True
+            if stream_id is not None:
+                # pre-drive failure (arg resolution): the consumer is
+                # parked on the stream, not on a return ref
+                await self._fail_stream_remote(stream_id, owner_addr, e)
+                return {"results": []}
             return self._package_error(e, return_oids)
         finally:
             tracing.current_span.reset(tok)
@@ -156,8 +305,13 @@ class WorkerExecutor:
         for i, c in enumerate(calls):
             if c["task_id"] in self.cancelled:
                 self.cancelled.discard(c["task_id"])
-                out[i] = self._package_error(
-                    TaskError("task cancelled"), c["return_oids"])
+                e0 = TaskError("task cancelled")
+                if c.get("stream_id") is not None:
+                    await self._fail_stream_remote(
+                        c["stream_id"], owner_addr, e0)
+                    out[i] = {"results": []}
+                else:
+                    out[i] = self._package_error(e0, c["return_oids"])
                 continue
             try:
                 fn = self.ctx.fn_cache.resolve(
@@ -168,7 +322,26 @@ class WorkerExecutor:
             try:
                 args, kwargs = await self._resolve_args(c["args_frame"])
             except BaseException as e:  # noqa: BLE001
-                out[i] = self._package_error(e, c["return_oids"])
+                if c.get("stream_id") is not None:
+                    # consumer waits on the stream, not a return ref
+                    await self._fail_stream_remote(
+                        c["stream_id"], owner_addr, e)
+                    out[i] = {"results": []}
+                else:
+                    out[i] = self._package_error(e, c["return_oids"])
+                continue
+            if c.get("stream_id") is not None:
+                span = c["task_id"].hex()
+                t0 = time.time()
+                tok = tracing.current_span.set(span)
+                try:
+                    out[i] = await self._drive_stream(
+                        fn, args, kwargs, c["stream_id"], owner_addr)
+                finally:
+                    tracing.current_span.reset(tok)
+                    tracing.record_exec(span, "task",
+                                        getattr(fn, "__name__", "?"),
+                                        t0, time.time())
                 continue
             if inspect.iscoroutinefunction(fn):
                 span = c["task_id"].hex()
@@ -268,6 +441,12 @@ class WorkerExecutor:
             args, kwargs = spec["args"], spec["kwargs"]
             instance = await self._run_callable(
                 cls, list(args), dict(kwargs))
+            try:
+                # actors can learn their own id (self-kill, logging) —
+                # the reference exposes this via get_runtime_context()
+                instance._ray_tpu_actor_id = actor_id
+            except (AttributeError, TypeError):
+                pass  # __slots__ etc.
             self.actors[actor_id] = _HostedActor(
                 instance, spec.get("max_concurrency", 1))
             return {"ok": True}
@@ -278,15 +457,35 @@ class WorkerExecutor:
 
     async def actor_call(self, actor_id: ActorID, method: str,
                          args_frame: bytes, return_oids: List[ObjectID],
-                         owner_addr):
+                         owner_addr, stream_id=None):
         hosted = self.actors.get(actor_id)
         if hosted is None:
-            return self._package_error(
-                TaskError(f"actor {actor_id} not hosted here"), return_oids)
+            err0 = TaskError(f"actor {actor_id} not hosted here")
+            if stream_id is not None:
+                await self._fail_stream_remote(stream_id, owner_addr,
+                                               err0)
+                return {"results": []}
+            return self._package_error(err0, return_oids)
         span = return_oids[0].hex() if return_oids else ""
         t0, err = time.time(), False
         tok = tracing.current_span.set(span)
         try:
+            if stream_id is not None:
+                args, kwargs = await self._resolve_args(args_frame)
+                fn = getattr(hosted.instance, method)
+                # Sync generators on a serialized (max_concurrency==1)
+                # actor hold the actor lock for the whole stream — the
+                # stream IS the call. Async generators interleave on the
+                # loop like other async methods.
+                if hosted.lock is not None and \
+                        inspect.isgeneratorfunction(fn):
+                    async with hosted.lock:
+                        return await self._drive_stream(
+                            fn, args, kwargs, stream_id, owner_addr,
+                            hosted.executor)
+                return await self._drive_stream(
+                    fn, args, kwargs, stream_id, owner_addr,
+                    hosted.executor)
             args, kwargs = await self._resolve_args(args_frame)
             if method == "__dag_exec_loop__":
                 # Compiled-dag pinned loop (see ray_tpu/dag/runtime.py):
@@ -309,6 +508,11 @@ class WorkerExecutor:
             return await self._package(value, return_oids)
         except BaseException as e:  # noqa: BLE001
             err = True
+            if stream_id is not None:
+                # pre-drive failure (bad method name, arg resolution):
+                # the consumer is parked on the stream, not the reply
+                await self._fail_stream_remote(stream_id, owner_addr, e)
+                return {"results": []}
             return self._package_error(e, return_oids)
         finally:
             tracing.current_span.reset(tok)
@@ -333,7 +537,9 @@ class WorkerExecutor:
                    for c in calls]
         all_sync = all(m is not None and callable(m)
                        and not inspect.iscoroutinefunction(m)
-                       for m in methods)
+                       and not inspect.isgeneratorfunction(m)
+                       for m in methods) and \
+            not any(c.get("stream_id") for c in calls)
         if all_sync and hosted.lock is not None:
             resolved = []
             for c in calls:
@@ -359,7 +565,8 @@ class WorkerExecutor:
         # @batch coalescing and max_concurrency semantics).
         out = await asyncio.gather(*[
             self.actor_call(actor_id, c["method"], c["args_frame"],
-                            c["return_oids"], owner_addr)
+                            c["return_oids"], owner_addr,
+                            c.get("stream_id"))
             for c in calls])
         return {"batch": list(out)}
 

@@ -116,6 +116,24 @@ class _Router:
         loop = _api_loop()
         asyncio.run_coroutine_threadsafe(_untrack(), loop)
 
+    def track_stream(self, rid: bytes, gen) -> None:
+        """Streaming requests count as in-flight until the stream
+        terminates — without this, p2c would route all (long-lived) LLM
+        generations as if every replica were idle."""
+        with self.lock:
+            self.inflight[rid] = self.inflight.get(rid, 0) + 1
+
+        async def _untrack():
+            try:
+                await api._g.ctx.stream_done(gen._stream_id)
+            except Exception:
+                pass
+            with self.lock:
+                self.inflight[rid] = max(0, self.inflight.get(rid, 1) - 1)
+
+        loop = _api_loop()
+        asyncio.run_coroutine_threadsafe(_untrack(), loop)
+
     def drop(self, rid: bytes) -> None:
         """Remove a replica the caller observed dead and force a refresh."""
         with self.lock:
@@ -151,14 +169,16 @@ class DeploymentHandle:
     across actors as a name reference."""
 
     def __init__(self, deployment_name: str, _pin: bytes = None,
-                 _model_id: str = None):
+                 _model_id: str = None, _stream: bool = False):
         self.deployment_name = deployment_name
         self._pin = _pin
         self._model_id = _model_id
+        self._stream = _stream
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.deployment_name, self._pin, self._model_id))
+                (self.deployment_name, self._pin, self._model_id,
+                 self._stream))
 
     def pinned(self) -> "DeploymentHandle":
         """A handle bound to ONE replica (picked now) — for stateful
@@ -168,7 +188,7 @@ class DeploymentHandle:
         router.refresh()
         return DeploymentHandle(self.deployment_name,
                                 router.pick(self._model_id),
-                                self._model_id)
+                                self._model_id, self._stream)
 
     def __getattr__(self, name):
         if name.startswith("_") or name in ("deployment_name",):
@@ -193,6 +213,17 @@ class DeploymentHandle:
         meta = {"multiplexed_model_id": self._model_id} \
             if self._model_id else None
         try:
+            if self._stream:
+                # Push-based response streaming (reference:
+                # serve/_private/router.py:689 streaming path): one
+                # streaming actor call on the replica's generator
+                # wrapper; tokens flow replica -> caller through the
+                # object plane with no polling RPCs.
+                gen = replica.handle_request_stream.options(
+                    num_returns="streaming").remote(
+                    method, args, kwargs, meta)
+                router.track_stream(rid, gen)
+                return gen
             if meta is None:
                 ref = replica.handle_request.remote(method, args, kwargs)
             else:
@@ -207,8 +238,11 @@ class DeploymentHandle:
         return ref
 
     def options(self, multiplexed_model_id: str = None,
+                stream: bool = None,
                 **_opts) -> "DeploymentHandle":
-        if multiplexed_model_id is not None:
-            return DeploymentHandle(self.deployment_name, self._pin,
-                                    str(multiplexed_model_id))
-        return self
+        mid = (str(multiplexed_model_id)
+               if multiplexed_model_id is not None else self._model_id)
+        st = self._stream if stream is None else bool(stream)
+        if mid == self._model_id and st == self._stream:
+            return self
+        return DeploymentHandle(self.deployment_name, self._pin, mid, st)

@@ -94,7 +94,6 @@ class _LLMServer:
             cache_dtype=cfg.cache_dtype,
             steps_per_sync=cfg.steps_per_sync, seed=cfg.seed,
             mesh=_serving_mesh(cfg.tensor_parallel))
-        self._streams: dict = {}
 
     async def generate(self, tokens, max_new_tokens: int = 64,
                        temperature: float = 0.0,
@@ -106,75 +105,19 @@ class _LLMServer:
             temperature=temperature, eos_id=eos_id,
             top_p=top_p, top_k=top_k, stop=stop)
 
-    # --- streaming (cursor-polling over plain handle calls) -----------
-    # The reference streams via HTTP SSE from the replica; here the
-    # client drains tokens with stream_poll as they are produced, so
-    # time-to-first-token is one decode block, not the full generation.
+    # --- streaming (push-based core streaming generator) --------------
+    # Tokens flow replica -> caller through num_returns="streaming"
+    # (api.ObjectRefGenerator) as the engine produces them — no polling
+    # RPCs; time-to-first-token is one decode block (reference: serve
+    # streams LLM responses the same push-based way, router.py:689).
 
-    async def stream_start(self, tokens, max_new_tokens: int = 64,
-                           temperature: float = 0.0,
-                           eos_id: Optional[int] = None) -> str:
-        import asyncio
-        import uuid
-        now = self._gc_streams()
-        sid = uuid.uuid4().hex[:12]
-        st = {"tokens": [], "done": False, "error": None,
-              "last_poll": now}
-        self._streams[sid] = st
-
-        async def pump():
-            try:
-                gen = self.engine.generate_stream(
-                    tokens, max_new_tokens=max_new_tokens,
-                    temperature=temperature, eos_id=eos_id)
-                async for tok in gen:
-                    st["tokens"].append(int(tok))
-            except BaseException as e:  # noqa: BLE001 — polled by client
-                st["error"] = f"{type(e).__name__}: {e}"
-            finally:
-                st["done"] = True
-
-        asyncio.ensure_future(pump())
-        return sid
-
-    def _gc_streams(self) -> float:
-        """Drop records of streams unpolled for 5 minutes (client crashed
-        or stopped draining). The generation itself still runs to
-        completion in the engine — only the buffered record is reclaimed.
-        Runs on every start AND poll so orphans are reclaimed even when no
-        new streams arrive. Returns the current monotonic time."""
-        import time as _time
-        now = _time.monotonic()
-        for k in [k for k, s in self._streams.items()
-                  if now - s["last_poll"] > 300.0]:
-            del self._streams[k]
-        return now
-
-    async def stream_poll(self, sid: str, cursor: int = 0,
-                          wait_s: float = 2.0) -> dict:
-        """Tokens produced since `cursor`; long-polls briefly so clients
-        don't busy-spin. {"tokens": [...], "done": bool, "error": ...}.
-        The stream record is dropped once polled past its end."""
-        import asyncio
-        import time as _time
-        self._gc_streams()
-        streams = self._streams
-        st = streams.get(sid)
-        if st is not None:
-            st["last_poll"] = _time.monotonic()
-        if st is None:
-            return {"tokens": [], "done": True,
-                    "error": f"unknown stream {sid!r}"}
-        deadline = _time.monotonic() + wait_s
-        while len(st["tokens"]) <= cursor and not st["done"] \
-                and _time.monotonic() < deadline:
-            await asyncio.sleep(0.01)
-        out = {"tokens": st["tokens"][cursor:], "done": st["done"],
-               "error": st["error"]}
-        if st["done"] and cursor + len(out["tokens"]) >= \
-                len(st["tokens"]):
-            streams.pop(sid, None)  # fully drained
-        return out
+    async def generate_stream(self, tokens, max_new_tokens: int = 64,
+                              temperature: float = 0.0,
+                              eos_id: Optional[int] = None):
+        async for tok in self.engine.generate_stream(
+                tokens, max_new_tokens=max_new_tokens,
+                temperature=temperature, eos_id=eos_id):
+            yield int(tok)
 
     async def stats(self) -> dict:
         return dict(self.engine.stats)
@@ -194,27 +137,21 @@ class _LLMServer:
 
 def stream_generate(handle, tokens, **kw):
     """Client-side generator: yields token ids as the replica produces
-    them. `handle` is the deployment handle from serve.run.
+    them, push-based over the core streaming-return path (one streaming
+    call; every ref is already resolved locally when it is yielded).
 
         for tok in stream_generate(h, prompt_ids, max_new_tokens=128):
             ...
     """
     import ray_tpu
-    handle = handle.pinned()  # stream state is replica-local
-    sid = ray_tpu.get(handle.stream_start.remote(tokens, **kw),
-                      timeout=300)
-    cursor = 0
-    while True:
-        r = ray_tpu.get(handle.stream_poll.remote(sid, cursor),
-                        timeout=300)
-        # tokens delivered alongside an error were produced before the
-        # failure — surface them to the client before raising
-        yield from r["tokens"]
-        cursor += len(r["tokens"])
-        if r["error"]:
-            raise RuntimeError(f"stream failed: {r['error']}")
-        if r["done"]:
-            return
+    gen = handle.options(stream=True).generate_stream.remote(tokens, **kw)
+    try:
+        for ref in gen:
+            tok = ray_tpu.get(ref)
+            ray_tpu.free([ref])  # consumed — don't accumulate per token
+            yield tok
+    finally:
+        gen.close()  # early caller exit must stop the replica's stream
 
 
 def build_llm_deployment(cfg: LLMConfig,

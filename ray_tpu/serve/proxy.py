@@ -270,8 +270,11 @@ class HTTPProxy:
         self._respond(writer, 200, result)
 
     async def _dispatch_stream(self, writer, dep: str, arg) -> str:
-        """Server-sent events: requires a deployment exposing the
-        stream_start/stream_poll protocol (serve/llm.py _LLMServer).
+        """Server-sent events over the core streaming-return path: one
+        streaming call on the deployment's generate_stream generator;
+        each produced token is pushed replica -> proxy through the
+        object plane and written as a `data:` event (no polling RPCs —
+        reference: serve streams LLM responses push-based the same way).
         Returns "close" — an SSE response ends with the connection."""
         from ray_tpu.serve.handle import DeploymentHandle
         loop = asyncio.get_running_loop()
@@ -290,10 +293,10 @@ class HTTPProxy:
             return "close"
         try:
             h = DeploymentHandle(dep)
-            ph = await loop.run_in_executor(None, h.pinned)
-            ref = await loop.run_in_executor(
-                None, lambda: ph.stream_start.remote(tokens, **kw))
-            sid = await api.get_async(ref, timeout=120.0)
+            # submission is the sync caller API — keep it off the loop
+            gen = await loop.run_in_executor(
+                None, lambda: h.options(
+                    stream=True).generate_stream.remote(tokens, **kw))
         except BaseException as e:  # noqa: BLE001
             self._errors += 1
             self._respond(writer, 500,
@@ -303,30 +306,17 @@ class HTTPProxy:
                      b"Content-Type: text/event-stream\r\n"
                      b"Cache-Control: no-cache\r\n"
                      b"Connection: close\r\n\r\n")
-        cursor = 0
         try:
-            while True:
-                ref = await loop.run_in_executor(
-                    None, lambda: ph.stream_poll.remote(sid, cursor))
-                r = await api.get_async(ref, timeout=120.0)
-                for t in r["tokens"]:
-                    writer.write(
-                        f"data: {json.dumps({'token': t})}\n\n".encode())
-                cursor += len(r["tokens"])
+            async for ref in gen:
+                t = await api.get_async(ref, timeout=120.0)
+                await api._g.ctx.free([ref])  # long-lived proxy process
+                writer.write(
+                    f"data: {json.dumps({'token': t})}\n\n".encode())
                 await writer.drain()
-                if r["error"]:
-                    self._errors += 1
-                    writer.write(
-                        b"event: error\ndata: "
-                        + json.dumps({"error": r["error"]}).encode()
-                        + b"\n\n")
-                    break
-                if r["done"]:
-                    writer.write(b"event: done\ndata: {}\n\n")
-                    break
+            writer.write(b"event: done\ndata: {}\n\n")
             await writer.drain()
         except (ConnectionResetError, BrokenPipeError):
-            pass  # client went away; the replica GC reclaims the stream
+            gen.close()  # client went away: stop the replica's stream
         except BaseException as e:  # noqa: BLE001 — replica died mid-stream
             # surface the failure as the protocol's error frame instead of
             # killing the connection handler with an unhandled exception

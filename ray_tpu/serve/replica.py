@@ -98,6 +98,52 @@ class Replica:
                     self._model_active[mid] = n
             self._ongoing -= 1
 
+    async def handle_request_stream(self, method: str, args: tuple,
+                                    kwargs: dict,
+                                    meta: Optional[dict] = None):
+        """Streaming twin of handle_request: the user method must be a
+        (sync or async) generator; its items are re-yielded, so a
+        caller invoking this with num_returns="streaming" receives them
+        push-based through the object plane (reference:
+        serve/_private/replica.py streaming call path)."""
+        from ray_tpu.serve.multiplex import _current_model_id
+        self._ongoing += 1
+        token = None
+        mid = (meta or {}).get("multiplexed_model_id")
+        if mid:
+            token = _current_model_id.set(mid)
+            self._model_active[mid] = self._model_active.get(mid, 0) + 1
+        try:
+            fn = getattr(self.instance, method)
+            if inspect.isasyncgenfunction(fn):
+                async for item in fn(*args, **kwargs):
+                    yield item
+            elif inspect.isgeneratorfunction(fn):
+                from ray_tpu.util.aio import drive_sync_gen
+                async for item in drive_sync_gen(fn(*args, **kwargs)):
+                    yield item
+            else:
+                raise TypeError(
+                    f"streaming call to {method!r}, which is not a "
+                    "generator method")
+            self._processed += 1
+        except GeneratorExit:
+            # client walked away mid-stream (gen.close()): a routine
+            # disconnect, not a replica failure — don't count it
+            raise
+        except BaseException:
+            self._errors += 1
+            raise
+        finally:
+            if token is not None:
+                _current_model_id.reset(token)
+                n = self._model_active.get(mid, 1) - 1
+                if n <= 0:
+                    self._model_active.pop(mid, None)
+                else:
+                    self._model_active[mid] = n
+            self._ongoing -= 1
+
     # -- control path ------------------------------------------------------
 
     def _notify_model_ids(self):

@@ -161,6 +161,17 @@ def test_streaming_split_with_runtime(runtime):
     assert max(counts) - min(counts) <= 40  # roughly equal by rows
 
 
+def test_streaming_split_reiterable(runtime):
+    """Multi-epoch training re-iterates its shard: every epoch must see
+    the full shard again (each pass opens a fresh producer stream)."""
+    ds = rd.range(60)
+    sh = ds.streaming_split(2)[0]
+    epochs = [sum(int(b["id"].sum()) for b in sh.iter_batches(
+        batch_size=16)) for _ in range(3)]
+    assert epochs[0] > 0
+    assert epochs == [epochs[0]] * 3, epochs
+
+
 def test_train_integration_dataset_shard(runtime):
     import ray_tpu
     from ray_tpu import train

@@ -1,0 +1,189 @@
+"""Streaming generator returns (num_returns="streaming").
+
+The ObjectRefGenerator analog (reference:
+python/ray/_private/object_ref_generator.py:32 + the streaming-generator
+protocol in core_worker/task_manager.cc): producer pushes yielded objects
+through the object plane as they are produced, the consumer iterates
+ObjectRefs with bounded unconsumed memory, producer death error-
+terminates the stream.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import api
+from ray_tpu.config import Config
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    cfg = Config.from_env(num_workers_prestart=1,
+                          default_max_task_retries=0)
+    ray_tpu.init(num_cpus=4, config=cfg)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_stream_order_and_values(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    out = [ray_tpu.get(ref) for ref in gen.remote(12)]
+    assert out == [i * i for i in range(12)]
+
+
+def test_stream_large_items_ride_shm(cluster):
+    import numpy as np
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        for i in range(4):
+            yield np.full(300_000, i, dtype=np.int64)  # > inline max
+
+    for i, ref in enumerate(gen.remote()):
+        arr = ray_tpu.get(ref)
+        assert arr.shape == (300_000,) and arr[0] == i
+
+
+def test_stream_1000_objects_bounded_memory(cluster):
+    """The VERDICT 'done' bar: 1,000 streamed objects, owner-side
+    unconsumed window never exceeds the configured bound."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        for i in range(1000):
+            yield i
+
+    g = gen.remote()
+    window = api._g.ctx.config.stream_backpressure_window
+    peak, n = 0, 0
+    for ref in g:
+        assert ray_tpu.get(ref) == n
+        n += 1
+        if n % 100 == 0:
+            st = api._g.ctx._streams.get(g._stream_id)
+            if st is not None:
+                peak = max(peak, st.peak_unconsumed)
+    assert n == 1000
+    assert 0 < peak <= window, peak
+
+
+def test_stream_error_after_prefix(cluster):
+    """Producer raising mid-stream: the already-yielded prefix is
+    delivered, then the error surfaces."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield from range(5)
+        raise ValueError("boom at 5")
+
+    g = gen.remote()
+    got = []
+    with pytest.raises(ray_tpu.TaskError, match="boom at 5"):
+        for ref in g:
+            got.append(ray_tpu.get(ref))
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_stream_non_generator_rejected(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def not_a_gen():
+        return [1, 2, 3]
+
+    with pytest.raises(ray_tpu.TaskError, match="generator"):
+        next(iter(not_a_gen.remote()))
+
+
+def test_async_actor_generator_stream(cluster):
+    @ray_tpu.remote(max_concurrency=4)
+    class Streamer:
+        async def tokens(self, n):
+            import asyncio
+            for i in range(n):
+                await asyncio.sleep(0)
+                yield f"tok{i}"
+
+        async def ping(self):
+            return "pong"
+
+    a = Streamer.remote()
+    gen = a.tokens.options(num_returns="streaming").remote(8)
+    # an async-generator stream must not block other calls on the actor
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    out = [ray_tpu.get(r) for r in gen]
+    assert out == [f"tok{i}" for i in range(8)]
+
+
+def test_sync_actor_generator_stream(cluster):
+    @ray_tpu.remote
+    class SyncStreamer:
+        def items(self, n):
+            for i in range(n):
+                yield {"i": i}
+
+    a = SyncStreamer.remote()
+    out = [ray_tpu.get(r)["i"]
+           for r in a.items.options(num_returns="streaming").remote(6)]
+    assert out == list(range(6))
+
+
+def test_stream_consumer_close_stops_producer(cluster, tmp_path):
+    """Abandoning the stream propagates: the producer's generator is
+    closed (GeneratorExit -> finally) instead of running to the end."""
+    marker = str(tmp_path / "closed.txt")
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        try:
+            for i in range(100_000):
+                yield i
+        finally:
+            with open(marker, "w") as f:
+                f.write("closed")
+
+    g = gen.remote()
+    it = iter(g)
+    assert ray_tpu.get(next(it)) == 0
+    assert ray_tpu.get(next(it)) == 1
+    g.close()
+    deadline = time.monotonic() + 30
+    while not os.path.exists(marker):
+        assert time.monotonic() < deadline, \
+            "producer never observed stream closure"
+        time.sleep(0.05)
+
+
+def test_stream_producer_death_terminates(cluster):
+    """Chaos bar from the VERDICT: kill the producer mid-stream; the
+    consumer gets the delivered prefix then an error, never a hang."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def doomed():
+        yield 1
+        yield 2
+        os._exit(1)
+
+    got = []
+    with pytest.raises((ray_tpu.WorkerCrashedError, ray_tpu.TaskError,
+                        ray_tpu.ActorDiedError)):
+        for ref in doomed.remote():
+            got.append(ray_tpu.get(ref))
+    assert got[: len(got)] == [1, 2][: len(got)]
+
+
+def test_stream_not_picklable(cluster):
+    import pickle
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield 1
+
+    g = gen.remote()
+    with pytest.raises(TypeError, match="not picklable"):
+        pickle.dumps(g)
+    list(g)  # drain so the producer isn't left parked
