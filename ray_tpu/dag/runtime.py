@@ -25,6 +25,11 @@ from ray_tpu.runtime.serialization import dumps_oob, loads_oob, serialize
 
 
 def _stage_to_host(value):
+    """Bare jax.Arrays are host-staged into the channel; a method that
+    returns TensorRefs (runtime/device_store.py put_device) opts into
+    the device transport instead — only the small handle rides the
+    channel and the tensor moves on first resolution (zero-copy within
+    a process)."""
     if "jax" in sys.modules:
         import jax
         if isinstance(value, jax.Array):

@@ -30,6 +30,12 @@ from ray_tpu.llm import model as lm
 from ray_tpu.models.llama import LlamaConfig
 
 
+class KVHandoffError(RuntimeError):
+    """A disaggregated request's shipped KV handle could not be
+    resolved (prefill replica died / handle freed). Fails only its own
+    request — never the shared scheduler loop."""
+
+
 @dataclass
 class _Request:
     tokens: List[int]                       # prompt (token ids)
@@ -234,8 +240,16 @@ class LLMEngine:
                             self._waiting.empty():
                         continue
                     r = self._waiting.get_nowait()
-                    tok = await loop.run_in_executor(
-                        None, self._admit_sync, slot, r)
+                    try:
+                        tok = await loop.run_in_executor(
+                            None, self._admit_sync, slot, r)
+                    except KVHandoffError as e:
+                        # a dead/freed remote KV handle fails ITS request
+                        # only — the shared loop and other slots live on
+                        # (resolution happens before any cache write, so
+                        # no partial state was left behind)
+                        self._fail(r, None, e)
+                        continue
                     self._emit_token(r, tok, slot)
                 active = [i for i, r in enumerate(self._slots)
                           if r is not None]
@@ -303,11 +317,31 @@ class LLMEngine:
         if r.prefilled is not None:
             p = r.prefilled
             r.prefilled = None          # free the host copy after write
-            kv = {"k": jnp.asarray(p["k"]), "v": jnp.asarray(p["v"])}
+            from ray_tpu.runtime.device_store import TensorRef
+
+            def take(x):
+                """Unwrap the device-path KV handoff (reference: RDT
+                tensor_transport_manager.py:37): same-process resolution
+                never leaves HBM; cross-process is one fetch +
+                device_put; the handle is single-use (freed here). A
+                dead handle becomes a per-request KVHandoffError. Plain
+                arrays pass through for the host-staged path."""
+                if not isinstance(x, TensorRef):
+                    return x
+                try:
+                    arr = x.resolve()
+                except Exception as e:
+                    raise KVHandoffError(
+                        f"prefilled KV handle unresolvable: {e}") from e
+                x.free()                # cache write below copies it
+                return arr
+
+            kv = {"k": jnp.asarray(take(p["k"])),
+                  "v": jnp.asarray(take(p["v"]))}
             self._cache = lm.write_prefill_to_cache(
                 self._cache, kv, slot, jnp.int32(n))
             self._slots[slot] = r
-            return self._sample_one(np.asarray(p["logits"]), r)
+            return self._sample_one(np.asarray(take(p["logits"])), r)
         if n <= self.buckets[-1]:
             b = self._bucket_for(n)
             padded = lm.pad_prompt(r.tokens, b)

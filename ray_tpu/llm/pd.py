@@ -44,14 +44,22 @@ class PrefillEngine:
                                     if b <= max_len)) or (max_len,)
         self.cache_dtype = cache_dtype
 
-    def prefill(self, tokens: Sequence[int]) -> dict:
-        """Runs the prompt forward pass; returns host numpy
+    def prefill(self, tokens: Sequence[int], *,
+                device: bool = False) -> dict:
+        """Runs the prompt forward pass; returns
         {"k","v": (layers, bucket, kvh, hd), "logits": (vocab,),
         "length": n} ready to ship to a decode engine. Prompts longer
         than the largest bucket stream through lm.prefill_chunk in
         bucket-sized pieces (chunked prefill — long prompts are the
         very case disaggregation targets), shipping KV padded to the
-        smallest bucket multiple that holds them."""
+        smallest bucket multiple that holds them.
+
+        ``device=True`` keeps k/v ON DEVICE and returns TensorRef
+        handles (runtime/device_store.py — the RDT analog): a decode
+        engine in the same process admits them without the KV ever
+        touching the host; a remote decode engine pays exactly one
+        host hop (fetch + device_put). ``device=False`` is the fully
+        host-staged numpy payload (rides the object plane as before)."""
         import jax.numpy as jnp
         tokens = list(map(int, tokens))
         n = len(tokens)
@@ -95,6 +103,12 @@ class PrefillEngine:
             # tail beyond it is pad garbage
             k = acc["k"][:, :self.max_len]
             v = acc["v"][:, :self.max_len]
+        if device:
+            from ray_tpu.runtime.device_store import put_device
+            return {"k": put_device(k.astype(dt)),
+                    "v": put_device(v.astype(dt)),
+                    "logits": np.asarray(logits),
+                    "length": n}
         return {"k": np.asarray(k.astype(dt)),
                 "v": np.asarray(v.astype(dt)),
                 "logits": np.asarray(logits),

@@ -532,6 +532,8 @@ class CoreContext:
             "reconstruct_object": self._handle_reconstruct_object,
             "stream_item": self._handle_stream_item,
             "stream_end": self._handle_stream_end,
+            "fetch_tensor": self._handle_fetch_tensor,
+            "free_tensor": self._handle_free_tensor,
             "ping": self._handle_ping,
         })
         self._streams: Dict[ObjectID, _StreamState] = {}
@@ -572,6 +574,18 @@ class CoreContext:
 
     async def _handle_ping(self):
         return "pong"
+
+    async def _handle_fetch_tensor(self, tid: str):
+        """Cross-process TensorRef resolution (runtime/device_store.py):
+        host-stage the parked device array off-loop and ship it."""
+        from ray_tpu.runtime.device_store import _store
+        return await asyncio.get_running_loop().run_in_executor(
+            None, _store().host_bytes, tid)
+
+    async def _handle_free_tensor(self, tid: str):
+        from ray_tpu.runtime.device_store import _store
+        _store().drop(tid)
+        return {"ok": True}
 
     # --- object plane: put/get/wait ---------------------------------------
 

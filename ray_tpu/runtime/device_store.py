@@ -1,0 +1,201 @@
+"""Device-resident tensor transport: the RDT analog.
+
+Reference: python/ray/experimental/rdt/tensor_transport_manager.py:37 —
+there, GPU objects move device-to-device over pluggable transports
+(NIXL / CUDA IPC) with a host-staged object-plane fallback. On TPU the
+fast intra-process path is simply *not leaving the device*: a
+``TensorRef`` is a picklable handle to a ``jax.Array`` parked in the
+producing process's ``DeviceStore``. Resolving it
+
+- in the SAME process returns the identical ``jax.Array`` (zero copy,
+  stays in HBM — within a multi-chip mesh the array is already laid out
+  across ICI by its sharding);
+- in a DIFFERENT process fetches the bytes from the owner over one RPC
+  and ``jax.device_put``s them straight onto the consumer's devices
+  (optionally re-sharded onto the consumer's mesh) — one host hop,
+  which is also what the cross-host (DCN) path costs.
+
+Handles are small, so they ride tasks/actor calls/DAG channels/the
+object plane for free; the tensor bytes move at most once, only when a
+process boundary is actually crossed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+# Identifies THIS process's store. A uuid, not os.getpid(): pids repeat
+# across nodes and containers, and a pid collision would misroute a
+# remote ref to the local-store branch.
+_PROC_ID = uuid.uuid4().hex
+
+# Backstop TTL for parked tensors whose consumer never resolves or
+# frees them (request rejected downstream, consumer crashed): without
+# it every abandoned handoff would pin HBM forever.
+DEFAULT_TTL_S = 600.0
+
+
+class TensorRef:
+    """Picklable handle to a device-resident array in some process's
+    DeviceStore. ``resolve()`` returns a jax.Array."""
+
+    __slots__ = ("tid", "shape", "dtype", "owner_proc", "owner_addr")
+
+    def __init__(self, tid: str, shape: tuple, dtype: str,
+                 owner_proc: str, owner_addr: Optional[Tuple[str, int]]):
+        self.tid = tid
+        self.shape = shape
+        self.dtype = dtype
+        self.owner_proc = owner_proc
+        self.owner_addr = tuple(owner_addr) if owner_addr else None
+
+    def __reduce__(self):
+        return (TensorRef, (self.tid, self.shape, self.dtype,
+                            self.owner_proc, self.owner_addr))
+
+    def __repr__(self):
+        return (f"TensorRef({self.tid[:8]}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    def resolve(self, sharding=None):
+        return _store().get(self, sharding=sharding)
+
+    def free(self) -> None:
+        """Release the parked array. Cross-process: best-effort oneway
+        RPC to the owner."""
+        if self.owner_proc == _PROC_ID:
+            _store().drop(self.tid)
+            return
+        if self.owner_addr is None:
+            return
+        try:
+            from ray_tpu import api
+            api._run(api._g.ctx.pool.call(
+                self.owner_addr, "free_tensor", tid=self.tid,
+                timeout=10.0))
+        except Exception:
+            pass
+
+
+class DeviceStore:
+    """Per-process registry of device arrays addressable by TensorRef."""
+
+    def __init__(self, ttl_s: float = DEFAULT_TTL_S):
+        self._arrays: Dict[str, Tuple[Any, float]] = {}  # tid -> (arr, deadline)
+        self._lock = threading.Lock()
+        self._ttl_s = ttl_s
+
+    def _purge_expired_locked(self):
+        now = time.monotonic()
+        dead = [t for t, (_a, dl) in self._arrays.items() if dl < now]
+        for t in dead:
+            del self._arrays[t]
+
+    def _lookup(self, tid: str):
+        with self._lock:
+            self._purge_expired_locked()
+            ent = self._arrays.get(tid)
+        return None if ent is None else ent[0]
+
+    # -- producer side ---------------------------------------------------
+
+    def put(self, arr, ttl_s: Optional[float] = None) -> TensorRef:
+        """Park a jax.Array (any sharding) and hand back its handle."""
+        tid = uuid.uuid4().hex
+        deadline = time.monotonic() + (ttl_s or self._ttl_s)
+        with self._lock:
+            self._purge_expired_locked()
+            self._arrays[tid] = (arr, deadline)
+        addr = None
+        try:
+            from ray_tpu import api
+            if api._g.ctx is not None:
+                addr = api._g.ctx.addr
+        except Exception:
+            pass
+        return TensorRef(tid, tuple(arr.shape), str(arr.dtype),
+                         _PROC_ID, addr)
+
+    def drop(self, tid: str) -> None:
+        with self._lock:
+            self._arrays.pop(tid, None)
+
+    # -- consumer side ---------------------------------------------------
+
+    def get(self, ref: TensorRef, sharding=None):
+        """Resolve to a jax.Array. Same process: the parked array itself
+        (re-laid-out only if a different sharding is requested). Cross
+        process: one fetch RPC + device_put onto `sharding` (or the
+        default device)."""
+        import jax
+        if ref.owner_proc == _PROC_ID:
+            arr = self._lookup(ref.tid)
+            if arr is None:
+                raise KeyError(f"tensor {ref.tid[:8]} freed or unknown")
+            if sharding is not None and not arr.sharding.is_equivalent_to(
+                    sharding, arr.ndim):
+                return jax.device_put(arr, sharding)
+            return arr
+        if ref.owner_addr is None:
+            raise KeyError(
+                f"tensor {ref.tid[:8]} lives in process "
+                f"{ref.owner_proc[:8]} with no reachable owner address")
+        from ray_tpu import api
+        host = api._run(api._g.ctx.pool.call(
+            ref.owner_addr, "fetch_tensor", tid=ref.tid, timeout=300.0))
+        if host is None:
+            raise KeyError(f"tensor {ref.tid[:8]} freed at its owner")
+        if sharding is not None:
+            return jax.device_put(host, sharding)
+        import jax.numpy as jnp
+        return jnp.asarray(host)
+
+    async def get_async(self, ref: TensorRef, sharding=None):
+        import jax
+        if ref.owner_proc == _PROC_ID:
+            return self.get(ref, sharding=sharding)
+        from ray_tpu import api
+        host = await api._g.ctx.pool.call(
+            ref.owner_addr, "fetch_tensor", tid=ref.tid, timeout=300.0)
+        if host is None:
+            raise KeyError(f"tensor {ref.tid[:8]} freed at its owner")
+        if sharding is not None:
+            return jax.device_put(host, sharding)
+        import jax.numpy as jnp
+        return jnp.asarray(host)
+
+    # -- owner-side RPC handlers -----------------------------------------
+
+    def host_bytes(self, tid: str):
+        """Stage a parked array to host for a cross-process fetch (the
+        numpy array rides the RPC's pickle-5 zero-copy frames)."""
+        arr = self._lookup(tid)
+        if arr is None:
+            return None
+        import numpy as np
+        return np.asarray(arr)
+
+
+_STORE: Optional[DeviceStore] = None
+_STORE_LOCK = threading.Lock()
+
+
+def _store() -> DeviceStore:
+    global _STORE
+    if _STORE is None:
+        with _STORE_LOCK:
+            if _STORE is None:
+                _STORE = DeviceStore()
+    return _STORE
+
+
+def put_device(arr) -> TensorRef:
+    """Public entry: park a device array, get a shippable handle."""
+    return _store().put(arr)
+
+
+def get_device(ref: TensorRef, sharding=None):
+    return _store().get(ref, sharding=sharding)

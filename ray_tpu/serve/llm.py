@@ -181,8 +181,11 @@ class _PrefillServer:
     async def prefill(self, tokens) -> dict:
         import asyncio
         loop = asyncio.get_running_loop()
+        # device=True: KV stays in this replica's HBM behind TensorRef
+        # handles; the decode replica fetches it in ONE hop (or zero,
+        # same-process) instead of host->shm->host staging
         return await loop.run_in_executor(
-            None, self.engine.prefill, tokens)
+            None, lambda: self.engine.prefill(tokens, device=True))
 
 
 class _DecodeServer(_LLMServer):

@@ -1,0 +1,108 @@
+"""Device-path tensor transport (the RDT analog).
+
+Reference: python/ray/experimental/rdt/tensor_transport_manager.py:37 —
+device objects move by handle (TensorRef); same-process resolution never
+leaves the device, cross-process pays exactly one host hop with a direct
+device_put onto the consumer's sharding.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime.device_store import TensorRef, get_device, put_device
+
+
+def test_same_process_zero_copy():
+    import jax.numpy as jnp
+    arr = jnp.arange(1024, dtype=jnp.float32).reshape(32, 32)
+    ref = put_device(arr)
+    assert isinstance(ref, TensorRef)
+    assert ref.shape == (32, 32)
+    out = get_device(ref)
+    assert out is arr          # the SAME device buffer — no copy at all
+    ref.free()
+    with pytest.raises(KeyError):
+        get_device(ref)
+
+
+def test_same_process_reshard_onto_mesh(mesh8):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    arr = jnp.arange(64 * 8, dtype=jnp.float32).reshape(64, 8)
+    ref = put_device(arr)
+    sh = NamedSharding(mesh8, P("fsdp", None))
+    out = get_device(ref, sharding=sh)
+    assert isinstance(out, jax.Array)
+    assert out.sharding.is_equivalent_to(sh, out.ndim)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+
+
+def test_cross_process_fetch_and_free():
+    """An actor parks a device array; the driver resolves the handle
+    (one fetch RPC + device_put) and frees it at the owner."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        class Holder:
+            def park(self):
+                import jax.numpy as jnp
+                from ray_tpu.runtime.device_store import put_device
+                self.arr = jnp.arange(5000, dtype=jnp.float32) * 2.0
+                return put_device(self.arr)
+
+        h = Holder.remote()
+        ref = ray_tpu.get(h.park.remote(), timeout=120)
+        assert isinstance(ref, TensorRef)
+        from ray_tpu.runtime.device_store import _PROC_ID
+        assert ref.owner_proc != _PROC_ID
+        out = ref.resolve()
+        import jax
+        assert isinstance(out, jax.Array)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.arange(5000, dtype=np.float32) * 2.0)
+        ref.free()
+        with pytest.raises(KeyError):
+            ref.resolve()
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_pd_kv_handoff_stays_on_device():
+    """The VERDICT 'done' bar: a KV block moves prefill -> decode with
+    no numpy materialization (same process / same virtual mesh), and
+    the decoded tokens equal the single-engine path."""
+    import jax
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.pd import PrefillEngine
+    from ray_tpu.models import llama
+
+    cfg = llama.tiny(vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, ffn_dim=128, dtype="float32",
+                     logits_dtype="float32", attn_impl="reference")
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    pre = PrefillEngine(cfg, params, prefill_buckets=(16,), max_len=64,
+                        cache_dtype="float32")
+    eng = LLMEngine(cfg, params, max_slots=2, max_len=64,
+                    prefill_buckets=(16,), cache_dtype="float32",
+                    steps_per_sync=1)
+    prompt = [5, 9, 13]
+
+    p = pre.prefill(prompt, device=True)
+    assert isinstance(p["k"], TensorRef)
+    assert isinstance(p["v"], TensorRef)
+    # the parked payload is a device array, not a host copy
+    parked = get_device(p["k"])
+    assert isinstance(parked, jax.Array)
+    assert not isinstance(parked, np.ndarray)
+
+    import asyncio
+    out = asyncio.run(eng.generate_prefilled(
+        prompt, p, max_new_tokens=12, temperature=0.0))
+    want = asyncio.run(eng.generate(
+        prompt, max_new_tokens=12, temperature=0.0))
+    assert out["tokens"] == want["tokens"]
+    # admit freed the parked KV (single-use handoff)
+    with pytest.raises(KeyError):
+        get_device(p["k"])
