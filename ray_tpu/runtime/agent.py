@@ -102,7 +102,8 @@ class NodeAgent:
             session_id,
             capacity_bytes=self.config.shm_store_bytes,
             spill_dir=self.config.object_spill_dir or None,
-            node_uid=self.node_id.hex())
+            node_uid=self.node_id.hex(),
+            head_addr=self.head_addr)
         self.pool = rpc.ConnectionPool()
         self.server = rpc.RpcServer(
             self._handlers(),

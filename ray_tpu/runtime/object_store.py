@@ -212,13 +212,25 @@ class SharedObjectStore:
     read-only by (segment name, offset)."""
 
     def __init__(self, session_id: str, capacity_bytes: int,
-                 spill_dir: Optional[str] = None, node_uid: str = ""):
+                 spill_dir: Optional[str] = None, node_uid: str = "",
+                 head_addr=None):
         self.session_id = session_id
         # node_uid disambiguates stores when several "nodes" share one
         # machine (the cluster_utils simulation): /dev/shm is host-global.
         self.node_uid = node_uid
         self.capacity = capacity_bytes
         self.spill_dir = spill_dir
+        # Remote spill (reference: _private/external_storage.py:399 —
+        # spill-to-S3): a URI spill_dir routes evicted objects through a
+        # storage backend (util/storage.py) instead of the local disk.
+        self._spill_storage = None
+        self._spill_root = None
+        if spill_dir:
+            from ray_tpu.util.storage import get_storage, is_remote
+            if is_remote(spill_dir):
+                self._spill_storage, root = get_storage(
+                    spill_dir, head_addr=head_addr)
+                self._spill_root = f"{root}/{node_uid or session_id}"
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
         self._arenas: List[_Arena] = []
         self._arena_seq = 0
@@ -368,10 +380,16 @@ class SharedObjectStore:
             return
         self._release_memory(e, immediate=True)
         if e.spilled_path:
-            try:
-                os.unlink(e.spilled_path)
-            except OSError:
-                pass
+            if self._spill_storage is not None:
+                try:
+                    self._spill_storage.delete(e.spilled_path)
+                except Exception:
+                    pass
+            else:
+                try:
+                    os.unlink(e.spilled_path)
+                except OSError:
+                    pass
 
     def _release_memory(self, e: _Entry, immediate: bool = False) -> None:
         if e.arena is not None:
@@ -421,7 +439,14 @@ class SharedObjectStore:
 
     def _evict(self, oid: ObjectID) -> None:
         e = self._entries[oid]
-        if self.spill_dir:
+        if self._spill_storage is not None:
+            mv = (e.arena.shm.buf[e.offset:e.offset + e.size]
+                  if e.arena is not None else e.shm.buf[:e.size])
+            path = f"{self._spill_root}/{oid.hex()}"
+            self._spill_storage.put_bytes(path, bytes(mv))
+            del mv
+            e.spilled_path = path
+        elif self.spill_dir:
             os.makedirs(self.spill_dir, exist_ok=True)
             path = os.path.join(self.spill_dir, oid.hex())
             mv = (e.arena.shm.buf[e.offset:e.offset + e.size]
@@ -442,8 +467,14 @@ class SharedObjectStore:
         self._used += e.size
         mv = (e.arena.shm.buf[e.offset:e.offset + e.size]
               if e.arena is not None else e.shm.buf[:e.size])
-        with open(e.spilled_path, "rb") as f:
-            f.readinto(mv)
+        if self._spill_storage is not None:
+            data = self._spill_storage.get_bytes(e.spilled_path)
+            if data is None:
+                raise KeyError(f"{oid} spill copy lost from storage")
+            mv[:] = data
+        else:
+            with open(e.spilled_path, "rb") as f:
+                f.readinto(mv)
         del mv
 
 

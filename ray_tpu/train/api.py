@@ -11,7 +11,8 @@ import os
 import queue
 import threading
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+from typing import (Any, Callable, ClassVar, Dict, List, Optional, Tuple,
+                    Union)
 
 
 @dataclass
@@ -83,13 +84,37 @@ class RunConfig:
 
 @dataclass
 class Checkpoint:
-    """A directory handle on shared storage (reference:
-    train/_checkpoint.py; storage at train/_internal/storage.py)."""
+    """A directory handle on shared OR remote storage (reference:
+    train/_checkpoint.py; storage at train/_internal/storage.py — the
+    reference accepts any pyarrow-filesystem URI the same way).
+
+    ``path`` is either a local directory or a storage URI
+    (memory://..., gs://... — util/storage.py). ``as_directory()``
+    always returns a local directory, downloading once per process for
+    remote checkpoints."""
     path: str
     metrics: Dict[str, Any] = field(default_factory=dict)
 
+    # per-PROCESS download memo: a machine-global cache would serve
+    # stale content when a reused URI's data changes across runs
+    _downloads: ClassVar[Dict[str, str]] = {}
+
     def as_directory(self) -> str:
-        return self.path
+        from ray_tpu.util import storage as _st
+        if not _st.is_remote(self.path):
+            return self.path
+        cached = Checkpoint._downloads.get(self.path)
+        if cached is not None and os.path.isdir(cached):
+            return cached
+        import tempfile
+        st, root = _st.get_storage(self.path)
+        tmp = tempfile.mkdtemp(prefix="rt_ckpt_")
+        n = st.download_dir(root, tmp)
+        if n == 0:
+            raise FileNotFoundError(
+                f"checkpoint {self.path} is empty or missing in storage")
+        Checkpoint._downloads[self.path] = tmp
+        return tmp
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
@@ -158,18 +183,40 @@ class TrainContext:
             # must not lose the checkpoint (reference: report() persists to
             # storage synchronously — train/_internal/storage.py).
             import json
-            os.makedirs(self._storage_path, exist_ok=True)
-            # Per-rank/pid tmp name: ranks share the storage path, and a
-            # shared tmp file would let one rank truncate another's
-            # in-flight write before the atomic rename.
-            tmp = os.path.join(
-                self._storage_path,
-                f".latest.tmp.{self.rank}.{os.getpid()}")
-            with open(tmp, "w") as f:
-                json.dump({"path": checkpoint.path,
-                           "metrics": dict(metrics)}, f)
-            os.replace(tmp, os.path.join(self._storage_path,
-                                         "_latest_checkpoint.json"))
+            from ray_tpu.util import storage as _st
+            if _st.is_remote(self._storage_path):
+                # Remote storage (memory:// kv:// gs://): upload the
+                # checkpoint dir, then report the remote URI — the
+                # local dir on this (ephemeral) machine is not the
+                # durable copy (reference: storage.py persist_...).
+                # Rank 0 uploads; other ranks report the same URI
+                # without re-shipping identical bytes (N uploads of one
+                # checkpoint, racing per-file, would both waste the
+                # head's bandwidth and risk torn mixes).
+                name = os.path.basename(checkpoint.path.rstrip("/"))
+                uri = f"{self._storage_path.rstrip('/')}/{name}"
+                if self.rank == 0:
+                    st, root = _st.get_storage(self._storage_path)
+                    st.upload_dir(checkpoint.path, f"{root}/{name}")
+                    st.put_bytes(
+                        f"{root}/_latest_checkpoint.json",
+                        json.dumps({"path": uri,
+                                    "metrics": dict(metrics)}).encode())
+                checkpoint = Checkpoint(path=uri,
+                                        metrics=dict(checkpoint.metrics))
+            else:
+                os.makedirs(self._storage_path, exist_ok=True)
+                # Per-rank/pid tmp name: ranks share the storage path,
+                # and a shared tmp file would let one rank truncate
+                # another's in-flight write before the atomic rename.
+                tmp = os.path.join(
+                    self._storage_path,
+                    f".latest.tmp.{self.rank}.{os.getpid()}")
+                with open(tmp, "w") as f:
+                    json.dump({"path": checkpoint.path,
+                               "metrics": dict(metrics)}, f)
+                os.replace(tmp, os.path.join(self._storage_path,
+                                             "_latest_checkpoint.json"))
         self._reports.put({"seq": self._seq, "metrics": dict(metrics),
                            "checkpoint": checkpoint})
 

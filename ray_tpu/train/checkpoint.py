@@ -24,13 +24,20 @@ class CheckpointManager:
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Dict[str, Any]) -> None:
+        from ray_tpu.util import storage as _st
+
         # Dedup by path: in SPMD training every rank may report the same
         # checkpoint; tracking duplicates would let retention rmtree a
-        # still-live directory.
-        path = os.path.abspath(checkpoint.path) if checkpoint.path else None
+        # still-live directory. Remote URIs compare verbatim, local
+        # paths normalized.
+        def norm(p):
+            if not p:
+                return None
+            return p if _st.is_remote(p) else os.path.abspath(p)
+
+        path = norm(checkpoint.path)
         for existing in self._tracked:
-            if path and existing.path and \
-                    os.path.abspath(existing.path) == path:
+            if path and norm(existing.path) == path:
                 existing.metrics = dict(metrics)
                 self.latest = existing
                 return
@@ -64,10 +71,20 @@ class CheckpointManager:
         else:
             ordered = sorted(self._tracked, key=self._score, reverse=reverse)
             victims = ordered[keep:]
+        from ray_tpu.util import storage as _st
         for v in victims:
             if v is self.latest:
                 continue
             self._tracked.remove(v)
-            if v.path and os.path.isdir(v.path) and self.storage_path and \
+            if not v.path or not self.storage_path:
+                continue
+            if _st.is_remote(v.path):
+                if v.path.startswith(self.storage_path.rstrip("/")):
+                    try:
+                        st, p = _st.get_storage(v.path)
+                        st.delete_prefix(p + "/")
+                    except Exception:
+                        pass  # retention is best-effort
+            elif os.path.isdir(v.path) and \
                     v.path.startswith(os.path.abspath(self.storage_path)):
                 shutil.rmtree(v.path, ignore_errors=True)

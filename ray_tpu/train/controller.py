@@ -156,18 +156,41 @@ class TrainController:
         sp = self.run_config.storage_path
         if not sp:
             return
-        p = os.path.join(sp, "_latest_checkpoint.json")
-        if not os.path.exists(p):
-            return
-        try:
-            with open(p) as f:
-                data = json.load(f)
-            known = {c.path for c in self.ckpt_manager._tracked}
-            if data["path"] not in known:
-                self.ckpt_manager.register(
-                    Checkpoint(path=data["path"]), data.get("metrics", {}))
-        except Exception:
-            pass
+        from ray_tpu.util import storage as _st
+        if _st.is_remote(sp):
+            # A transient storage error here must NOT silently restart
+            # training from step 0 — retry, then surface loudly.
+            last = None
+            for attempt in range(3):
+                try:
+                    st, root = _st.get_storage(sp)
+                    raw = st.get_bytes(f"{root}/_latest_checkpoint.json")
+                    last = None
+                    break
+                except Exception as e:  # noqa: BLE001 — retried
+                    last = e
+                    import time
+                    time.sleep(0.5 * (attempt + 1))
+            if last is not None:
+                raise RuntimeError(
+                    f"cannot read checkpoint pointer from {sp}: "
+                    f"{last}") from last
+            if raw is None:
+                return
+            data = json.loads(raw)
+        else:
+            try:
+                p = os.path.join(sp, "_latest_checkpoint.json")
+                if not os.path.exists(p):
+                    return
+                with open(p) as f:
+                    data = json.load(f)
+            except Exception:
+                return  # corrupt local pointer: best-effort
+        known = {c.path for c in self.ckpt_manager._tracked}
+        if data["path"] not in known:
+            self.ckpt_manager.register(
+                Checkpoint(path=data["path"]), data.get("metrics", {}))
 
     def _start_train(self):
         self._recover_latest_checkpoint()

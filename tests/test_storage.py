@@ -1,0 +1,138 @@
+"""Pluggable checkpoint/spill storage (util/storage.py).
+
+Reference: train/_internal/storage.py (checkpoint to any filesystem
+URI) + _private/external_storage.py:399 (spill to cloud storage).
+memory:// maps to the cluster control KV — reachable from every node,
+durable as the head — so the remote-storage plumbing is exercised for
+real across processes without any cloud dependency.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.config import Config
+from ray_tpu.util.storage import get_storage, is_remote, parse_uri
+
+
+def test_uri_parsing():
+    assert parse_uri("/tmp/x") == (None, "/tmp/x")
+    assert parse_uri("memory://ck/run1") == ("memory", "ck/run1")
+    assert parse_uri("gs://bucket/p") == ("gs", "bucket/p")
+    assert not is_remote("/tmp/x")
+    assert not is_remote("file:///tmp/x")
+    assert is_remote("memory://x")
+    assert is_remote("gs://b/x")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    cfg = Config.from_env(num_workers_prestart=1,
+                          default_max_task_retries=0)
+    ray_tpu.init(num_cpus=4, config=cfg)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_kv_storage_primitives(cluster, tmp_path):
+    st, root = get_storage("memory://prim")
+    st.put_bytes(f"{root}/a/x.bin", b"hello")
+    st.put_bytes(f"{root}/a/y.bin", b"world")
+    assert st.get_bytes(f"{root}/a/x.bin") == b"hello"
+    assert st.get_bytes(f"{root}/missing") is None
+    assert st.exists(f"{root}/a/y.bin")
+    assert sorted(st.list(f"{root}/a/")) == [
+        f"{root}/a/x.bin", f"{root}/a/y.bin"]
+    # directory round trip
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "top.txt").write_text("t")
+    (src / "sub" / "deep.txt").write_text("d")
+    st.upload_dir(str(src), f"{root}/dir")
+    dst = tmp_path / "dst"
+    n = st.download_dir(f"{root}/dir", str(dst))
+    assert n == 2
+    assert (dst / "top.txt").read_text() == "t"
+    assert (dst / "sub" / "deep.txt").read_text() == "d"
+    st.delete_prefix(f"{root}/")
+    assert st.list(f"{root}/") == []
+
+
+def test_train_checkpoint_resume_from_memory_storage(cluster, tmp_path):
+    """The VERDICT 'done' bar: train with a memory:// storage path; a
+    NEW run (fresh controller — the restart case) resumes from the
+    checkpoint recovered out of remote storage, not the local disk."""
+    from ray_tpu import train
+    from ray_tpu.train.api import Checkpoint, RunConfig, ScalingConfig
+
+    storage = "memory://ckpts/run_resume"
+    local = str(tmp_path)
+
+    def train_fn():
+        ctx = train.get_context()
+        resume = ctx.get_checkpoint()
+        start = 0
+        if resume is not None:
+            assert is_remote(resume.path), resume.path
+            d = resume.as_directory()     # downloads from storage
+            with open(os.path.join(d, "step.txt")) as f:
+                start = int(f.read()) + 1
+        for step in range(start, start + 3):
+            d = os.path.join(local, f"ck_{step}")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "step.txt"), "w") as f:
+                f.write(str(step))
+            train.report({"step": step, "resumed_from": start},
+                         checkpoint=Checkpoint.from_directory(d))
+
+    run_a = train.JaxTrainer(
+        train_fn, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=storage)).fit()
+    assert run_a.error is None, run_a.error
+    assert run_a.metrics["step"] == 2
+    # the reported checkpoint was REWRITTEN to its storage URI
+    assert is_remote(run_a.checkpoint.path)
+
+    run_b = train.JaxTrainer(
+        train_fn, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=storage)).fit()
+    assert run_b.error is None, run_b.error
+    assert run_b.metrics["resumed_from"] == 3   # resumed after step 2
+    assert run_b.metrics["step"] == 5
+
+
+def test_spill_round_trips_through_storage(cluster):
+    """Evicted objects spill to (and restore from) the storage backend
+    when the spill dir is a URI."""
+    from ray_tpu.runtime.ids import ObjectID
+    from ray_tpu.runtime.object_store import SharedObjectStore
+
+    store = SharedObjectStore(
+        "storints", capacity_bytes=1 << 20,
+        spill_dir="memory://spill", node_uid="t1")
+    try:
+        payloads = {}
+        oids = []
+        for i in range(4):                  # 4 x 400KB > 1MB capacity
+            oid = ObjectID.generate()
+            data = np.full(400_000, i, np.uint8).tobytes()
+            store.put_bytes(oid, data)
+            payloads[oid] = data
+            oids.append(oid)
+        stats = store.stats()
+        assert stats["used_bytes"] <= 1 << 20
+        # early objects were evicted to storage; reading restores them
+        for oid in oids:
+            mv = store.get(oid)
+            assert mv is not None
+            assert bytes(mv) == payloads[oid]
+            del mv
+        # delete cleans the spilled copies out of storage
+        for oid in oids:
+            store.delete(oid)
+        st, root = get_storage("memory://spill")
+        assert st.list(f"{root}/t1/") == []
+    finally:
+        store.shutdown()
