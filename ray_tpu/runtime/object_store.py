@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import bisect
 import os
+import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -195,6 +196,7 @@ class _Entry:
     sealed: bool = False
     pins: int = 0
     spilled_path: Optional[str] = None
+    spilled_remote: bool = False    # spilled_path is a storage path
     created_at: float = field(default_factory=time.monotonic)
 
     @property
@@ -222,15 +224,31 @@ class SharedObjectStore:
         self.spill_dir = spill_dir
         # Remote spill (reference: _private/external_storage.py:399 —
         # spill-to-S3): a URI spill_dir routes evicted objects through a
-        # storage backend (util/storage.py) instead of the local disk.
+        # storage backend (util/storage.py). The store runs on the
+        # agent's event loop, and the KV backend is a BLOCKING client —
+        # so eviction stages to local disk synchronously (fast) and a
+        # background uploader ships staged files to storage off-loop
+        # (blocking the loop on a network round trip per eviction would
+        # stall heartbeats; with an in-process head it would deadlock).
         self._spill_storage = None
         self._spill_root = None
+        self._spill_q = None
+        self._spill_lock = threading.Lock()
         if spill_dir:
             from ray_tpu.util.storage import get_storage, is_remote
             if is_remote(spill_dir):
                 self._spill_storage, root = get_storage(
                     spill_dir, head_addr=head_addr)
                 self._spill_root = f"{root}/{node_uid or session_id}"
+                import queue as _queue
+                import tempfile
+                self._spill_stage_dir = tempfile.mkdtemp(
+                    prefix=f"rtspill_{(node_uid or session_id)[:8]}_")
+                self._spill_q = _queue.Queue()
+                self._spill_thread = threading.Thread(
+                    target=self._spill_upload_loop, daemon=True,
+                    name="rt-spill-upload")
+                self._spill_thread.start()
         self._entries: "OrderedDict[ObjectID, _Entry]" = OrderedDict()
         self._arenas: List[_Arena] = []
         self._arena_seq = 0
@@ -374,20 +392,74 @@ class SharedObjectStore:
         if e and e.pins > 0:
             e.pins -= 1
 
-    def delete(self, oid: ObjectID) -> None:
-        e = self._entries.pop(oid, None)
-        if e is None:
+    def _spill_upload_loop(self):
+        """Background: ship staged spill files to the storage backend
+        and promote their entries; process deferred deletions."""
+        while True:
+            item = self._spill_q.get()
+            if item is None:
+                return
+            kind = item[0]
+            try:
+                if kind == "barrier":
+                    item[1].set()
+                elif kind == "upload":
+                    _k, oid, local, remote = item
+                    with open(local, "rb") as f:
+                        data = f.read()
+                    self._spill_storage.put_bytes(remote, data)
+                    with self._spill_lock:
+                        e = self._entries.get(oid)
+                        if e is not None and e.spilled_path == local:
+                            e.spilled_path = remote
+                            e.spilled_remote = True
+                            try:
+                                os.unlink(local)
+                            except OSError:
+                                pass
+                        else:
+                            # entry deleted (or re-evicted) meanwhile:
+                            # the remote copy is garbage — remove both
+                            self._spill_storage.delete(remote)
+                            try:
+                                os.unlink(local)
+                            except OSError:
+                                pass
+                else:  # ("delete", storage_path)
+                    self._spill_storage.delete(item[1])
+            except Exception:
+                pass  # spill durability is best-effort per object
+
+    def flush_spill(self, timeout_s: float = 30.0) -> None:
+        """Block until queued uploads/deletes have been processed
+        (tests + orderly shutdown)."""
+        if self._spill_q is None:
             return
+        import queue as _queue
+        deadline = time.monotonic() + timeout_s
+        while not self._spill_q.empty():
+            if time.monotonic() > deadline:
+                return
+            time.sleep(0.01)
+        # the queue can be empty while the last item is mid-flight:
+        # round-trip a sentinel barrier
+        done = threading.Event()
+        self._spill_q.put(("barrier", done))
+        done.wait(timeout=max(0.0, deadline - time.monotonic()))
+
+    def delete(self, oid: ObjectID) -> None:
+        with self._spill_lock:
+            e = self._entries.pop(oid, None)
+            if e is None:
+                return
+            spilled, remote = e.spilled_path, e.spilled_remote
         self._release_memory(e, immediate=True)
-        if e.spilled_path:
-            if self._spill_storage is not None:
-                try:
-                    self._spill_storage.delete(e.spilled_path)
-                except Exception:
-                    pass
+        if spilled:
+            if remote:
+                self._spill_q.put(("delete", spilled))  # off-loop
             else:
                 try:
-                    os.unlink(e.spilled_path)
+                    os.unlink(spilled)
                 except OSError:
                     pass
 
@@ -408,6 +480,11 @@ class SharedObjectStore:
     def shutdown(self) -> None:
         for oid in list(self._entries):
             self.delete(oid)
+        if self._spill_q is not None:
+            self.flush_spill(timeout_s=10.0)  # drain queued deletions
+            self._spill_q.put(None)
+            import shutil
+            shutil.rmtree(self._spill_stage_dir, ignore_errors=True)
         for arena in self._arenas:
             arena.destroy()
         self._arenas.clear()
@@ -440,12 +517,19 @@ class SharedObjectStore:
     def _evict(self, oid: ObjectID) -> None:
         e = self._entries[oid]
         if self._spill_storage is not None:
+            # stage locally NOW (no network on the caller's thread);
+            # the uploader promotes the entry to its storage path
             mv = (e.arena.shm.buf[e.offset:e.offset + e.size]
                   if e.arena is not None else e.shm.buf[:e.size])
-            path = f"{self._spill_root}/{oid.hex()}"
-            self._spill_storage.put_bytes(path, bytes(mv))
+            local = os.path.join(self._spill_stage_dir, oid.hex())
+            with open(local, "wb") as f:
+                f.write(mv)
             del mv
-            e.spilled_path = path
+            with self._spill_lock:
+                e.spilled_path = local
+                e.spilled_remote = False
+            self._spill_q.put(("upload", oid, local,
+                               f"{self._spill_root}/{oid.hex()}"))
         elif self.spill_dir:
             os.makedirs(self.spill_dir, exist_ok=True)
             path = os.path.join(self.spill_dir, oid.hex())
@@ -467,14 +551,24 @@ class SharedObjectStore:
         self._used += e.size
         mv = (e.arena.shm.buf[e.offset:e.offset + e.size]
               if e.arena is not None else e.shm.buf[:e.size])
-        if self._spill_storage is not None:
-            data = self._spill_storage.get_bytes(e.spilled_path)
-            if data is None:
-                raise KeyError(f"{oid} spill copy lost from storage")
-            mv[:] = data
-        else:
-            with open(e.spilled_path, "rb") as f:
-                f.readinto(mv)
+        for _attempt in (0, 1):
+            with self._spill_lock:
+                path, remote = e.spilled_path, e.spilled_remote
+            if remote:
+                data = self._spill_storage.get_bytes(path)
+                if data is None:
+                    raise KeyError(f"{oid} spill copy lost from storage")
+                mv[:] = data
+                break
+            try:
+                with open(path, "rb") as f:
+                    f.readinto(mv)
+                break
+            except FileNotFoundError:
+                # the uploader promoted this entry to storage (and
+                # removed the staging file) between snapshot and open —
+                # re-snapshot and fetch the remote copy
+                continue
         del mv
 
 

@@ -100,15 +100,29 @@ class Checkpoint:
     _downloads: ClassVar[Dict[str, str]] = {}
 
     def as_directory(self) -> str:
+        """Local directory with the checkpoint contents. Remote URIs
+        download once per process (URIs are assumed write-once — reuse
+        a name with different bytes and the first download wins)."""
         from ray_tpu.util import storage as _st
         if not _st.is_remote(self.path):
             return self.path
         cached = Checkpoint._downloads.get(self.path)
         if cached is not None and os.path.isdir(cached):
             return cached
+        import atexit
+        import shutil
         import tempfile
+        import time as _time
         st, root = _st.get_storage(self.path)
+        # brief grace for an in-flight rank-0 upload (the .complete
+        # marker is written last); proceed after it for compatibility
+        # with checkpoints persisted before markers existed
+        for _ in range(20):
+            if st.exists(f"{root}/.complete"):
+                break
+            _time.sleep(0.1)
         tmp = tempfile.mkdtemp(prefix="rt_ckpt_")
+        atexit.register(shutil.rmtree, tmp, ignore_errors=True)
         n = st.download_dir(root, tmp)
         if n == 0:
             raise FileNotFoundError(
@@ -193,11 +207,18 @@ class TrainContext:
                 # without re-shipping identical bytes (N uploads of one
                 # checkpoint, racing per-file, would both waste the
                 # head's bandwidth and risk torn mixes).
+                # NOTE: multi-HOST sharded checkpoints should report
+                # per-rank distinct names (or checkpoint via a library
+                # like orbax that writes shared storage directly) —
+                # rank 0's directory is what becomes durable here.
                 name = os.path.basename(checkpoint.path.rstrip("/"))
                 uri = f"{self._storage_path.rstrip('/')}/{name}"
                 if self.rank == 0:
                     st, root = _st.get_storage(self._storage_path)
                     st.upload_dir(checkpoint.path, f"{root}/{name}")
+                    # marker LAST: readers treat its absence as
+                    # "upload in flight", not a torn checkpoint
+                    st.put_bytes(f"{root}/{name}/.complete", b"1")
                     st.put_bytes(
                         f"{root}/_latest_checkpoint.json",
                         json.dumps({"path": uri,

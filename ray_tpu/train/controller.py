@@ -187,10 +187,13 @@ class TrainController:
                     data = json.load(f)
             except Exception:
                 return  # corrupt local pointer: best-effort
+        path = data.get("path") if isinstance(data, dict) else None
+        if not isinstance(path, str) or not path:
+            return  # well-formed JSON, wrong shape: skip best-effort
         known = {c.path for c in self.ckpt_manager._tracked}
-        if data["path"] not in known:
+        if path not in known:
             self.ckpt_manager.register(
-                Checkpoint(path=data["path"]), data.get("metrics", {}))
+                Checkpoint(path=path), data.get("metrics", {}))
 
     def _start_train(self):
         self._recover_latest_checkpoint()

@@ -87,6 +87,13 @@ class Searcher:
                           metrics: Dict[str, Any]) -> None:
         pass
 
+    def observe(self, config: Dict[str, Any],
+                metrics: Dict[str, Any]) -> None:
+        """Feed a completed (config, metrics) observation WITHOUT a
+        live trial id — how Tuner.restore replays finished trials into
+        a model-based searcher so post-restore suggestions condition on
+        the pre-interrupt results."""
+
 
 class BasicVariantSearcher(Searcher):
     """generate_variants as a Searcher (grid x random, pre-expanded)."""
@@ -137,12 +144,17 @@ class TPESearcher(Searcher):
 
     def on_trial_complete(self, trial_id, metrics):
         cfg = self._trials.pop(trial_id, None)
-        if cfg is None or self.metric not in (metrics or {}):
+        if cfg is None:
+            return
+        self.observe(cfg, metrics)
+
+    def observe(self, config, metrics):
+        if self.metric not in (metrics or {}):
             return
         score = float(metrics[self.metric])
         if self.mode == "max":
             score = -score
-        self._obs.append((cfg, score))
+        self._obs.append((dict(config), score))
 
     # -- suggestion -----------------------------------------------------
 

@@ -306,11 +306,22 @@ def _trainer_trainable(trainer) -> Callable[[dict], Any]:
 
 class Tuner:
     """Reference: tune/tuner.py:43. ``Tuner(fn, param_space=...,
-    tune_config=TuneConfig(...)).fit()`` -> ResultGrid."""
+    tune_config=TuneConfig(...)).fit()`` -> ResultGrid.
+
+    With ``storage_path`` set, sweep state (sampled configs + per-trial
+    outcomes) persists after every trial completion, and
+    ``Tuner.restore(storage_path, trainable, name=...)`` resumes an
+    interrupted sweep: finished trials keep their results, unfinished
+    ones re-run, and a model-based searcher is re-fed the finished
+    observations (reference: tune/tuner.py Tuner.restore +
+    result_grid restoration)."""
 
     def __init__(self, trainable, *,
                  param_space: Optional[Dict[str, Any]] = None,
-                 tune_config: Optional[TuneConfig] = None):
+                 tune_config: Optional[TuneConfig] = None,
+                 storage_path: Optional[str] = None,
+                 name: str = "tune",
+                 _restored: Optional[dict] = None):
         from ray_tpu.train.trainer import BaseTrainer
         if isinstance(trainable, BaseTrainer):
             # Tuner(trainer) parity (reference: tuner.py accepts a
@@ -325,6 +336,64 @@ class Tuner:
         self._fn = trainable
         self._space = dict(param_space or {})
         self._cfg = tune_config or TuneConfig()
+        self._storage_path = storage_path
+        self._name = name
+        self._restored = _restored
+
+    # -- persistence / restore ------------------------------------------
+
+    def _state_key(self) -> str:
+        return f"{self._name}/tuner_state.pkl"
+
+    def _persist(self, trials: List["_Trial"],
+                 results: Dict[str, "Result"]) -> None:
+        if not self._storage_path:
+            return
+        import cloudpickle
+
+        from ray_tpu.util import storage as _st
+        recs = []
+        for t in trials:
+            r = results.get(t.trial_id)
+            recs.append({
+                "id": t.trial_id, "config": t.config,
+                "status": r.status if r else "PENDING",
+                "metrics": r.metrics if r else None,
+                "error": r.error if r else None,
+                "reports": r.all_reports if r else [],
+                "checkpoint": r.checkpoint if r else None,
+            })
+        try:
+            blob = cloudpickle.dumps(
+                {"space": self._space, "cfg": self._cfg, "trials": recs},
+                protocol=5)
+        except Exception:
+            return  # unpicklable user objects: persistence is optional
+        st, root = _st.get_storage(self._storage_path)
+        st.put_bytes(f"{root}/{self._state_key()}", blob)
+
+    @classmethod
+    def restore(cls, storage_path: str, trainable, *,
+                name: str = "tune",
+                restart_errored: bool = True) -> "Tuner":
+        """Resume an interrupted sweep persisted under
+        ``storage_path``/``name``. Completed trials are restored as
+        results; pending (and, with ``restart_errored``, errored)
+        trials re-run with their original sampled configs."""
+        import pickle
+
+        from ray_tpu.util import storage as _st
+        st, root = _st.get_storage(storage_path)
+        blob = st.get_bytes(f"{root}/{name}/tuner_state.pkl")
+        if blob is None:
+            raise FileNotFoundError(
+                f"no tuner state at {storage_path}/{name}")
+        state = pickle.loads(blob)
+        return cls(trainable, param_space=state["space"],
+                   tune_config=state["cfg"], storage_path=storage_path,
+                   name=name,
+                   _restored={"trials": state["trials"],
+                              "restart_errored": restart_errored})
 
     def fit(self) -> ResultGrid:
         import ray_tpu
@@ -334,10 +403,15 @@ class Tuner:
             scheduler.metric = cfg.metric
             scheduler.mode = cfg.mode
         searcher = cfg.search_alg
+        restored_recs = (self._restored or {}).get("trials") or []
+        restart_errored = (self._restored or {}).get(
+            "restart_errored", True)
         if searcher is not None:
             searcher.set_search_properties(cfg.metric, cfg.mode,
                                            self._space)
             trials = []          # suggested lazily as slots free up
+        elif restored_recs:
+            trials = []          # rebuilt from the persisted sweep below
         else:
             configs = generate_variants(self._space, cfg.num_samples,
                                         cfg.seed)
@@ -371,6 +445,31 @@ class Tuner:
         running: Dict[str, _Trial] = {}
         results: Dict[str, Result] = {}
 
+        # Restore: finished trials become Results; unfinished ones
+        # re-run their original sampled configs. A restored searcher
+        # was pickled WITH its observations (persist runs after
+        # on_trial_complete), so replay only into a searcher that has
+        # none — re-observing would double-weight pre-crash points in
+        # the TPE good/bad split.
+        replay = searcher is not None and restored_recs and \
+            not getattr(searcher, "_obs", None)
+        for rec in restored_recs:
+            t = _Trial(rec["id"], rec["config"])
+            trials.append(t)
+            done = rec["status"] in ("TERMINATED", "STOPPED") or (
+                rec["status"] == "ERROR" and not restart_errored)
+            if done:
+                results[t.trial_id] = Result(
+                    config=rec["config"], metrics=rec["metrics"] or {},
+                    error=rec["error"],
+                    checkpoint=rec.get("checkpoint"),
+                    all_reports=list(rec.get("reports") or []),
+                    status=rec["status"])
+                if replay and rec["status"] == "TERMINATED":
+                    searcher.observe(rec["config"], rec["metrics"] or {})
+            else:
+                pending.append(t)
+
         def finalize(t: _Trial, status: str, error: Optional[str] = None):
             checkpoint = None
             final_metrics = t.reports[-1] if t.reports else {}
@@ -393,6 +492,7 @@ class Tuner:
                 ray_tpu.kill(t.actor)
             except Exception:
                 pass
+            self._persist(trials, results)
 
         def donor_checkpoint(donor_id: str):
             d = running.get(donor_id)
@@ -406,7 +506,7 @@ class Tuner:
             r = results.get(donor_id)
             return r.checkpoint if r is not None else None
 
-        suggested = 0
+        suggested = len(restored_recs)
 
         def _refill_from_searcher():
             """Ask the searcher for new trials as slots free (sequential
@@ -431,6 +531,7 @@ class Tuner:
             if not pending and not running and (
                     searcher is None or suggested >= cfg.num_samples):
                 break
+            started = False
             while pending and len(running) < limit:
                 t = pending.pop(0)
                 t.actor = actor_cls.remote()
@@ -438,6 +539,11 @@ class Tuner:
                     scheduler.on_trial_start(t.trial_id, t.config)
                 t.run_ref = t.actor.run.remote(self._fn, t.config)
                 running[t.trial_id] = t
+                started = True
+            if started:
+                # in-flight configs reach storage BEFORE their outcomes
+                # exist, so a crash mid-trial leaves them restorable
+                self._persist(trials, results)
             for t in list(running.values()):
                 try:
                     r = ray_tpu.get(t.actor.poll.remote(t.cursor),

@@ -123,7 +123,11 @@ def test_spill_round_trips_through_storage(cluster):
             oids.append(oid)
         stats = store.stats()
         assert stats["used_bytes"] <= 1 << 20
-        # early objects were evicted to storage; reading restores them
+        # uploads drain to storage; staged local copies are promoted
+        store.flush_spill()
+        st, root = get_storage("memory://spill")
+        assert st.list(f"{root}/t1/"), "nothing reached storage"
+        # early objects were evicted; reading restores them FROM storage
         for oid in oids:
             mv = store.get(oid)
             assert mv is not None
@@ -132,7 +136,7 @@ def test_spill_round_trips_through_storage(cluster):
         # delete cleans the spilled copies out of storage
         for oid in oids:
             store.delete(oid)
-        st, root = get_storage("memory://spill")
+        store.flush_spill()
         assert st.list(f"{root}/t1/") == []
     finally:
         store.shutdown()

@@ -313,3 +313,118 @@ def test_tuner_with_tpe_search_alg(runtime):
     assert len(res._results) == 12
     best = res.get_best_result()
     assert abs(best.config["x"] - 2.0) < 2.5, best.config
+
+
+def test_tpe_sweep_runs_wide(runtime, tmp_path):
+    """A 16-trial TPE sweep with max_concurrent_trials=4 overlaps
+    trials (the searcher refills every free slot, it does not
+    serialize the sweep on one suggestion at a time)."""
+    import time as _time
+
+    from ray_tpu import tune as rt_tune
+    log = str(tmp_path / "spans.log")
+
+    def objective(config):
+        t0 = _time.monotonic()
+        _time.sleep(0.5)
+        with open(log, "a") as f:
+            f.write(f"{t0} {_time.monotonic()}\n")
+        rt_tune.report({"loss": (config["x"] - 1.0) ** 2})
+
+    res = rt_tune.Tuner(
+        objective,
+        param_space={"x": rt_tune.uniform(-4.0, 4.0)},
+        tune_config=rt_tune.TuneConfig(
+            metric="loss", mode="min", num_samples=16,
+            search_alg=rt_tune.TPESearcher(n_initial=4, seed=0),
+            max_concurrent_trials=4),
+    ).fit()
+    assert len(res._results) == 16
+    spans = [tuple(map(float, ln.split()))
+             for ln in open(log).read().splitlines()]
+    assert len(spans) == 16
+    peak = max(sum(1 for s, e in spans if s <= t < e)
+               for t, _ in spans)
+    assert peak >= 2, f"sweep ran sequentially (peak overlap {peak})"
+
+
+def test_tuner_restore_reruns_unfinished(runtime, tmp_path):
+    """Kill-and-restore accounting: trials that crashed in run 1 are
+    re-run by Tuner.restore; finished trials keep their results and do
+    NOT re-execute."""
+    from ray_tpu import tune as rt_tune
+    marker = str(tmp_path / "healed")
+    runs = str(tmp_path / "runs.log")
+    storage = str(tmp_path / "sweep")
+
+    def objective(config):
+        import os as _os
+        with open(runs, "a") as f:
+            f.write(f"{config['x']}\n")
+        if config["x"] >= 4 and not _os.path.exists(marker):
+            _os._exit(1)        # hard crash, like a kill -9 of the trial
+        rt_tune.report({"loss": float(config["x"])})
+
+    space = {"x": rt_tune.grid_search([1, 2, 3, 4, 5])}
+    cfg = rt_tune.TuneConfig(metric="loss", mode="min", num_samples=1,
+                             max_concurrent_trials=2)
+    run1 = rt_tune.Tuner(objective, param_space=space, tune_config=cfg,
+                         storage_path=storage, name="sweep1").fit()
+    assert len(run1.errors) == 2          # x=4, x=5 crashed
+    assert len(run1._results) == 5
+
+    open(marker, "w").close()             # "fix the bug", then restore
+    run2 = rt_tune.Tuner.restore(storage, objective,
+                                 name="sweep1").fit()
+    assert len(run2._results) == 5
+    assert not run2.errors, [r.error for r in run2.errors]
+    assert {r.config["x"] for r in run2._results} == {1, 2, 3, 4, 5}
+    # finished trials did not re-execute: 5 first-run + 2 re-runs
+    executed = [int(x) for x in open(runs).read().split()]
+    assert len(executed) == 7, executed
+    assert sorted(executed[5:]) == [4, 5]
+
+
+def test_tuner_restore_with_tpe_refeeds_observations(runtime, tmp_path):
+    """Restoring a TPE sweep replays finished observations into the
+    searcher (suggestions after restore condition on them) and runs
+    only the remaining budget."""
+    from ray_tpu import tune as rt_tune
+    marker = str(tmp_path / "healed")
+    storage = str(tmp_path / "tpe_sweep")
+
+    def objective(config):
+        import os as _os
+        if config.get("boom") and not _os.path.exists(marker):
+            raise RuntimeError("injected")
+        rt_tune.report({"loss": (config["x"] - 2.0) ** 2})
+
+    class FlakyTPE(rt_tune.TPESearcher):
+        n_suggested = 0
+
+        def suggest(self, trial_id):
+            cfg = super().suggest(trial_id)
+            if cfg is not None:
+                FlakyTPE.n_suggested += 1
+                cfg["boom"] = FlakyTPE.n_suggested == 3  # 3rd trial fails
+            return cfg
+
+    cfg = rt_tune.TuneConfig(
+        metric="loss", mode="min", num_samples=8,
+        search_alg=FlakyTPE(n_initial=3, seed=1),
+        max_concurrent_trials=2)
+    run1 = rt_tune.Tuner(objective,
+                         param_space={"x": rt_tune.uniform(-4.0, 4.0)},
+                         tune_config=cfg, storage_path=storage,
+                         name="tpe1").fit()
+    assert len(run1._results) == 8
+    assert len(run1.errors) >= 1
+
+    open(marker, "w").close()
+    restored = rt_tune.Tuner.restore(storage, objective, name="tpe1")
+    searcher = restored._cfg.search_alg
+    run2 = restored.fit()
+    assert len(run2._results) == 8
+    assert not run2.errors
+    # the searcher saw the pre-restore observations again
+    assert len(searcher._obs) >= 8 - len(run1.errors)
