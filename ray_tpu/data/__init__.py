@@ -5,16 +5,19 @@ iter_jax_batches stages batches to TPU with prefetch."""
 
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.datasource import (from_blocks, from_items, from_numpy,
-                                     from_pandas, range, read_csv,
-                                     read_json, read_numpy, read_parquet,
-                                     read_text, read_tfrecord,
-                                     write_csv, write_json,
-                                     write_parquet, write_tfrecord)
+                                     from_pandas, range,
+                                     read_binary_files, read_csv,
+                                     read_images, read_json, read_numpy,
+                                     read_parquet, read_text,
+                                     read_tfrecord, write_csv,
+                                     write_json, write_parquet,
+                                     write_tfrecord)
 from ray_tpu.data.iterator import DataIterator
 
 __all__ = [
     "Dataset", "DataIterator", "from_blocks", "from_items", "from_numpy",
-    "from_pandas", "range", "read_csv", "read_json", "read_numpy",
+    "from_pandas", "range", "read_binary_files", "read_csv",
+    "read_images", "read_json", "read_numpy",
     "read_parquet", "read_text", "read_tfrecord", "write_csv",
     "write_json", "write_parquet", "write_tfrecord",
 ]

@@ -101,9 +101,10 @@ class Dataset:
         return self.map_batches(drop, batch_size=None)
 
     def select_columns(self, cols: List[str]) -> "Dataset":
-        def select(batch):
-            return {k: batch[k] for k in cols}
-        return self.map_batches(select, batch_size=None)
+        # A declarative op (not a map_batches closure) so the optimizer
+        # can push the projection into a parquet scan.
+        return self._with(_Op("select", "select", None,
+                              {"cols": list(cols)}))
 
     def limit(self, n: int) -> "Dataset":
         return self._with(_Op("limit", "limit", None, {"n": n}))
@@ -147,6 +148,10 @@ class Dataset:
     # ---- execution ----
     def iter_blocks(self) -> Iterator[Block]:
         yield from _execute(self._ops)
+
+    def optimized_plan(self) -> List[_Op]:
+        """The plan after the rewrite rules run (introspection/tests)."""
+        return _optimize(self._ops)
 
     def materialize(self) -> "Dataset":
         blocks = [b for b in self.iter_blocks() if block_num_rows(b)]
@@ -387,6 +392,52 @@ class GroupedData:
         return self._agg({f"std({on})": (on, lambda v: float(np.std(v)))})
 
 
+# --- plan optimizer ----------------------------------------------------------
+
+def _optimize(ops: List[_Op]) -> List[_Op]:
+    """Rule-based logical rewrite (reference:
+    data/_internal/logical/optimizers.py — there a visitor framework;
+    here two high-value rules over the op list):
+
+    1. projection pushdown — select_columns directly after read_parquet
+       narrows the scan itself, so parquet reads only those columns
+       off disk;
+    2. stage fusion — consecutive row-wise ops (map/filter/flat_map)
+       collapse into ONE operator that makes a single pass over each
+       block instead of materializing an intermediate block per stage.
+    """
+    ops = list(ops)
+    # rule 1: fold consecutive selects into a parquet scan — only when
+    # the select NARROWS the current projection (folding a widening
+    # select would silently resurrect dropped columns; left unfolded it
+    # raises KeyError at execution, the pre-optimizer behavior)
+    if ops and ops[0].name == "read_parquet":
+        while len(ops) > 1 and ops[1].kind == "select":
+            cols = ops[1].args["cols"]
+            cur = ops[0].args.get("columns")
+            if cur is not None and not set(cols) <= set(cur):
+                break
+            src_args = dict(ops[0].args)
+            src_args["columns"] = list(cols)
+            ops[0] = _Op("read_parquet", "source", None, src_args)
+            del ops[1]
+    # rule 2: fuse adjacent row-wise stages
+    fused: List[_Op] = []
+    for op in ops:
+        if op.kind in ("map_rows", "filter", "flat_map"):
+            if fused and fused[-1].kind == "fused_rows":
+                prev = fused[-1]
+                fused[-1] = _Op(f"{prev.name}+{op.name}", "fused_rows",
+                                None, {"stages": prev.args["stages"]
+                                       + [(op.kind, op.fn)]})
+            else:
+                fused.append(_Op(op.name, "fused_rows", None,
+                                 {"stages": [(op.kind, op.fn)]}))
+        else:
+            fused.append(op)
+    return fused
+
+
 # --- execution engine --------------------------------------------------------
 
 def _execute(ops: List[_Op]) -> Iterator[Block]:
@@ -394,7 +445,7 @@ def _execute(ops: List[_Op]) -> Iterator[Block]:
     previous — streaming with inherent backpressure (the reference gets the
     same property from StreamingExecutor's bounded buffers)."""
     stream: Iterator[Block] = iter(())
-    for op in ops:
+    for op in _optimize(ops):
         stream = _apply(stream, op)
     return stream
 
@@ -404,6 +455,12 @@ def _apply(stream: Iterator[Block], op: _Op) -> Iterator[Block]:
         return _source(op)
     if op.kind == "map_rows":
         return (_map_rows(b, op.fn) for b in stream)
+    if op.kind == "select":
+        cols = op.args["cols"]
+        return ({k: b[k] for k in cols} for b in stream)
+    if op.kind == "fused_rows":
+        stages = op.args["stages"]
+        return (_fused_rows_block(b, stages) for b in stream)
     if op.kind == "flat_map":
         return (_flat_map_rows(b, op.fn) for b in stream)
     if op.kind == "filter":
@@ -427,8 +484,59 @@ def _apply(stream: Iterator[Block], op: _Op) -> Iterator[Block]:
     raise ValueError(f"unknown op kind {op.kind}")
 
 
+def _fused_rows_block(b: Block, stages) -> Block:
+    """One pass over a block through a fused chain of row-wise stages
+    (map/filter/flat_map) — no intermediate block per stage."""
+    out: List[dict] = []
+    samples: Dict[int, dict] = {}   # stage idx -> one observed output row
+    for r in block_rows(b):
+        items = [r]
+        for si, (kind, fn) in enumerate(stages):
+            if kind == "map_rows":
+                items = [fn(x) for x in items]
+            elif kind == "filter":
+                items = [x for x in items if fn(x)]
+            else:  # flat_map
+                items = [y for x in items for y in fn(x)]
+            if items and si not in samples:
+                samples[si] = items[0]
+            if not items:
+                break
+        out.extend(items)
+    if not out:
+        # No surviving rows: reconstruct the (empty) output SCHEMA from
+        # rows the fused pass already observed — downstream ops (left
+        # joins) rely on it, and re-running the UDFs would double work
+        # and side effects. Semantics match per-stage execution: a
+        # map/flat_map stage that never saw a row yields a schemaless
+        # block (block_from_rows([]) == {}); filters pass schema
+        # through.
+        sample: Optional[dict] = "input"  # sentinel: input schema
+        for si, (kind, _fn) in enumerate(stages):
+            if kind == "filter":
+                continue
+            sample = samples.get(si)
+            if sample is None:
+                return {}
+        if sample == "input":
+            return {c: np.asarray(v)[:0] for c, v in b.items()}
+        one = block_from_rows([sample])
+        return {c: np.asarray(v)[:0] for c, v in one.items()}
+    return block_from_rows(out)
+
+
 def _source(op: _Op) -> Iterator[Block]:
     args = op.args
+    if "parquet_paths" in args:
+        # declarative parquet scan (kept lazy so the optimizer can
+        # narrow `columns` before any file is opened)
+        import pyarrow.parquet as pq
+
+        from ray_tpu.data.block import block_from_arrow
+        for path in args["parquet_paths"]:
+            yield block_from_arrow(
+                pq.read_table(path, columns=args.get("columns")))
+        return
     if "blocks" in args:
         yield from args["blocks"]
         return

@@ -74,16 +74,13 @@ def _expand(paths) -> List[str]:
 
 
 def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
-    """(reference: read_api.py:943 read_parquet)."""
-    import pyarrow.parquet as pq
-    files = _expand(paths)
-
-    def make(path):
-        def fn():
-            table = pq.read_table(path, columns=columns)
-            return block_from_arrow(table)
-        return fn
-    return _source_ds("read_parquet", block_fns=[make(p) for p in files])
+    """(reference: read_api.py:943 read_parquet). The source op is
+    DECLARATIVE (paths + columns, files opened at execution) so the
+    plan optimizer can push a later select_columns into the scan —
+    parquet then reads only the projected columns off disk."""
+    return _source_ds("read_parquet", parquet_paths=_expand(paths),
+                      columns=list(columns) if columns is not None
+                      else None)
 
 
 def read_csv(paths, **read_kwargs) -> Dataset:
@@ -141,6 +138,74 @@ def read_tfrecord(paths, *, verify_crc: bool = True) -> Dataset:
         return fn
     return _source_ds("read_tfrecord",
                       block_fns=[make(p) for p in files])
+
+
+def _expand_files(paths) -> List[str]:
+    """Like _expand but RECURSES into directories (class-subfolder
+    image layouts: data/cat/x.png) and never returns a directory."""
+    out: List[str] = []
+    for p in _expand(paths):
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if not f.startswith("."))
+        else:
+            out.append(p)
+    return sorted(out)
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    """One row per file: {"bytes": ...} (+ "path") — the multimodal
+    ingest workhorse (reference: read_api.py:2375 read_binary_files).
+    Directories are walked recursively."""
+    files = _expand_files(paths)
+
+    def make(path):
+        def fn():
+            with open(path, "rb") as f:
+                data = f.read()
+            b: Block = {"bytes": np.asarray([data], dtype=object)}
+            if include_paths:
+                b["path"] = np.asarray([path], dtype=object)
+            return b
+        return fn
+    return _source_ds("read_binary_files",
+                      block_fns=[make(p) for p in files])
+
+
+def read_images(paths, *, size: Optional[tuple] = None,
+                mode: Optional[str] = None,
+                include_paths: bool = False) -> Dataset:
+    """Image files -> {"image": (1, H, W, C) uint8} rows (reference:
+    read_api.py:1134 read_images; PIL decodes — optional dependency,
+    gated with a clear error). Pass ``size=(H, W)`` to resize on read
+    (required if downstream batching concatenates across images of
+    different shapes), ``mode`` (e.g. "RGB"/"L") to convert.
+    Directories are walked recursively (class-subfolder layouts)."""
+    files = _expand_files(paths)
+
+    def make(path):
+        def fn():
+            try:
+                from PIL import Image
+            except ImportError as e:  # pragma: no cover - env-specific
+                raise RuntimeError(
+                    "read_images needs pillow (PIL); install it or use "
+                    "read_binary_files + your own decoder") from e
+            img = Image.open(path)
+            if mode is not None:
+                img = img.convert(mode)
+            if size is not None:
+                img = img.resize((size[1], size[0]))  # PIL takes (W, H)
+            arr = np.asarray(img)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+            b: Block = {"image": arr[None, ...]}
+            if include_paths:
+                b["path"] = np.asarray([path], dtype=object)
+            return b
+        return fn
+    return _source_ds("read_images", block_fns=[make(p) for p in files])
 
 
 def read_numpy(paths) -> Dataset:

@@ -313,3 +313,104 @@ def test_tfrecord_variable_length_and_missing_features(tmp_path):
     assert list(rows[2]["v"]) == []
     assert rows[0]["x"] == 1 and rows[2]["x"] == 3
     assert list(rows[1]["x"]) == []                    # missing -> empty
+
+
+def test_read_binary_files(tmp_path):
+    (tmp_path / "a.bin").write_bytes(b"\x00\x01payload")
+    (tmp_path / "b.bin").write_bytes(b"other")
+    ds = rd.read_binary_files(str(tmp_path), include_paths=True)
+    rows = sorted(ds.take_all(), key=lambda r: r["path"])
+    assert [r["bytes"] for r in rows] == [b"\x00\x01payload", b"other"]
+    assert rows[0]["path"].endswith("a.bin")
+
+
+def test_read_images_folder_to_map_batches(tmp_path):
+    """Image-folder -> map_batches pipeline (the multimodal ingest
+    pattern; reference: read_api.py:1134 read_images)."""
+    from PIL import Image
+    for i, color in enumerate([(255, 0, 0), (0, 255, 0), (0, 0, 255)]):
+        Image.new("RGB", (12, 10), color).save(tmp_path / f"im{i}.png")
+    ds = rd.read_images(str(tmp_path), size=(8, 8), mode="RGB")
+
+    def mean_pixel(batch):
+        img = batch["image"].astype(np.float32)
+        return {"mean": img.reshape(img.shape[0], -1).mean(axis=1)}
+
+    out = ds.map_batches(mean_pixel, batch_size=None).take_all()
+    assert len(out) == 3
+    assert all(0 < r["mean"] < 255 for r in out)
+    b = ds.take_batch(3)
+    assert b["image"].shape == (3, 8, 8, 3)
+    assert b["image"].dtype == np.uint8
+
+
+def test_plan_fuses_row_stages():
+    ds = (rd.range(100)
+          .map(lambda r: {"id": r["id"] * 2})
+          .filter(lambda r: r["id"] % 4 == 0)
+          .map(lambda r: {"id": r["id"] + 1}))
+    plan = ds.optimized_plan()
+    # source + ONE fused operator instead of three row stages
+    assert len(plan) == 2, [op.name for op in plan]
+    assert plan[1].kind == "fused_rows"
+    assert len(plan[1].args["stages"]) == 3
+    out = ds.take_all()
+    assert [r["id"] for r in out[:3]] == [1, 5, 9]
+    assert len(out) == 50
+
+
+def test_plan_pushes_select_into_parquet(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    table = pa.table({"a": list(range(10)), "b": [x * 2 for x in range(10)],
+                      "c": ["s"] * 10})
+    pq.write_table(table, str(tmp_path / "t.parquet"))
+    ds = rd.read_parquet(str(tmp_path / "t.parquet")).select_columns(
+        ["a", "b"]).select_columns(["a"])
+    plan = ds.optimized_plan()
+    assert len(plan) == 1, [op.name for op in plan]   # selects folded in
+    assert plan[0].args["columns"] == ["a"]            # narrowed scan
+    assert ds.schema() == {"a": "int64"}
+    assert ds.count() == 10
+
+
+def test_pushdown_never_widens(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    pq.write_table(pa.table({"a": [1, 2], "b": [3, 4]}),
+                   str(tmp_path / "t.parquet"))
+    # a widening select must NOT resurrect dropped columns
+    ds = rd.read_parquet(str(tmp_path / "t.parquet")).select_columns(
+        ["a"]).select_columns(["a", "b"])
+    with pytest.raises(KeyError):
+        ds.take_all()
+    # explicit empty projection is preserved
+    empty = rd.read_parquet(str(tmp_path / "t.parquet"), columns=[])
+    assert empty.schema() == {}
+
+
+def test_fused_empty_block_schema_without_reexec(tmp_path):
+    calls = []
+
+    def trace(r):
+        calls.append(r["id"])
+        return {"id": r["id"], "y": float(r["id"])}
+
+    ds = rd.range(10, block_size=10).map(trace).filter(lambda r: False)
+    blocks = list(ds.iter_blocks())
+    # schema survives an all-filtered block...
+    assert set(blocks[0].keys()) == {"id", "y"}
+    # ...and the map UDF ran exactly once per row (no schema replay)
+    assert len(calls) == 10, len(calls)
+
+
+def test_read_images_recurses_subfolders(tmp_path):
+    from PIL import Image
+    (tmp_path / "cat").mkdir()
+    (tmp_path / "dog").mkdir()
+    Image.new("RGB", (4, 4), (255, 0, 0)).save(tmp_path / "cat" / "a.png")
+    Image.new("RGB", (4, 4), (0, 255, 0)).save(tmp_path / "dog" / "b.png")
+    ds = rd.read_images(str(tmp_path), size=(4, 4), include_paths=True)
+    rows = ds.take_all()
+    assert len(rows) == 2
+    assert {r["path"].split("/")[-2] for r in rows} == {"cat", "dog"}
