@@ -146,7 +146,10 @@ def _expand_files(paths) -> List[str]:
     out: List[str] = []
     for p in _expand(paths):
         if os.path.isdir(p):
-            for root, _dirs, files in os.walk(p):
+            for root, dirs, files in os.walk(p):
+                # prune hidden dirs (.git, .ipynb_checkpoints) like the
+                # top-level dot filter
+                dirs[:] = [d for d in dirs if not d.startswith(".")]
                 out.extend(os.path.join(root, f) for f in sorted(files)
                            if not f.startswith("."))
         else:

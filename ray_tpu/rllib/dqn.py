@@ -49,11 +49,15 @@ class ReplayBuffer:
     transition arrays; sampling returns a dict of stacked minibatches so
     the learner can scan over them in one jitted call."""
 
-    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0,
+                 act_shape: tuple = (), act_dtype: str = "int32"):
+        # act_shape/act_dtype generalize the buffer to continuous
+        # control (SAC stores float torque vectors; DQN int indices)
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros(capacity, np.int32)
+        self.actions = np.zeros((capacity, *act_shape),
+                                np.dtype(act_dtype))
         self.rewards = np.zeros(capacity, np.float32)
         self.dones = np.zeros(capacity, np.float32)
         self.idx = 0
@@ -113,31 +117,18 @@ class DQNRunner:
         self._q = jax.jit(q_forward)
 
     def sample(self, params, epsilon: float) -> Dict[str, np.ndarray]:
-        out = {k: [] for k in
-               ("obs", "next_obs", "actions", "rewards", "dones")}
-        for _ in range(self.steps_per_call):
-            q = np.asarray(self._q(params, self.obs))
+        from ray_tpu.rllib.rollout import collect
+
+        def act(obs):
+            q = np.asarray(self._q(params, obs))
             greedy = q.argmax(axis=1)
             rand = self.rng.integers(0, q.shape[1], size=len(greedy))
             explore = self.rng.random(len(greedy)) < epsilon
-            a = np.where(explore, rand, greedy).astype(np.int32)
-            obs2, r, done = self.env.step(a)
-            out["obs"].append(self.obs)
-            # env auto-resets on done: obs2 rows where done are the NEXT
-            # episode's start, but the (1-done) mask in the TD target
-            # zeroes the bootstrap there so the value never leaks across
-            out["next_obs"].append(obs2)
-            out["actions"].append(a)
-            out["rewards"].append(r)
-            out["dones"].append(done.astype(np.float32))
-            self.ep_ret += r
-            if done.any():
-                for i in np.where(done)[0]:
-                    self.done_returns.append(float(self.ep_ret[i]))
-                    self.ep_ret[i] = 0.0
-            self.obs = obs2
-        batch = {k: np.concatenate(v) for k, v in out.items()}
-        batch["episode_returns"] = np.array(self.done_returns, np.float32)
+            return np.where(explore, rand, greedy).astype(np.int32)
+
+        batch, self.obs = collect(self.env, self.obs,
+                                  self.steps_per_call, act,
+                                  self.ep_ret, self.done_returns)
         return batch
 
 

@@ -57,8 +57,13 @@ class CartPoleVec:
             .astype(np.float32)
         self.steps += 1
 
+        # truncated vs terminated matters for TD bootstrapping: a
+        # time-limit cut is NOT a real terminal (the value of the next
+        # state is not 0) — learners mask bootstrap with
+        # done & ~truncated
+        self.truncated = self.steps >= self.MAX_STEPS
         done = (np.abs(x) > 2.4) | (np.abs(th) > 12 * np.pi / 180) \
-            | (self.steps >= self.MAX_STEPS)
+            | self.truncated
         reward = np.ones(self.num_envs, np.float32)
         if done.any():
             idx = np.where(done)[0]
@@ -68,7 +73,64 @@ class CartPoleVec:
         return self.state.copy(), reward, done
 
 
-ENVS = {"CartPole-v1": CartPoleVec}
+class PendulumVec:
+    """Classic torque-controlled pendulum swing-up, vectorized —
+    the continuous-action counterpart of CartPoleVec (dynamics per the
+    public Pendulum-v1 spec: obs [cos th, sin th, thdot], action torque
+    in [-2, 2], reward -(th^2 + 0.1 thdot^2 + 0.001 a^2), 200-step
+    episodes, auto-reset)."""
+
+    OBS_DIM = 3
+    ACTION_DIM = 1
+    ACTION_HIGH = 2.0
+    CONTINUOUS = True
+    MAX_STEPS = 200
+
+    def __init__(self, num_envs: int, seed: int = 0):
+        self.num_envs = num_envs
+        self.rng = np.random.default_rng(seed)
+        self.th = np.zeros(num_envs, np.float32)
+        self.thdot = np.zeros(num_envs, np.float32)
+        self.steps = np.zeros(num_envs, np.int32)
+        self.reset_all()
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self.th), np.sin(self.th), self.thdot],
+                        axis=1).astype(np.float32)
+
+    def _reset_idx(self, idx) -> None:
+        self.th[idx] = self.rng.uniform(-np.pi, np.pi, size=len(idx))
+        self.thdot[idx] = self.rng.uniform(-1.0, 1.0, size=len(idx))
+        self.steps[idx] = 0
+
+    def reset_all(self) -> np.ndarray:
+        self._reset_idx(np.arange(self.num_envs))
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        """actions: (n, 1) float torque. Returns (obs, reward, done);
+        auto-resets at the 200-step horizon (time-limit done)."""
+        g, m, length, dt = 10.0, 1.0, 1.0, 0.05
+        u = np.clip(np.asarray(actions, np.float32).reshape(-1),
+                    -self.ACTION_HIGH, self.ACTION_HIGH)
+        th_norm = ((self.th + np.pi) % (2 * np.pi)) - np.pi
+        cost = th_norm ** 2 + 0.1 * self.thdot ** 2 + 0.001 * u ** 2
+        self.thdot = np.clip(
+            self.thdot + (3 * g / (2 * length) * np.sin(self.th)
+                          + 3.0 / (m * length ** 2) * u) * dt,
+            -8.0, 8.0).astype(np.float32)
+        self.th = (self.th + self.thdot * dt).astype(np.float32)
+        self.steps += 1
+        done = self.steps >= self.MAX_STEPS
+        # every pendulum "done" is a time-limit truncation, never a
+        # true terminal — learners must keep bootstrapping through it
+        self.truncated = done.copy()
+        if done.any():
+            self._reset_idx(np.where(done)[0])
+        return self._obs(), (-cost).astype(np.float32), done
+
+
+ENVS = {"CartPole-v1": CartPoleVec, "Pendulum-v1": PendulumVec}
 
 
 def make_env(name: str, num_envs: int, seed: int = 0):

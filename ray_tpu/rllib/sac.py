@@ -1,0 +1,309 @@
+"""SAC: continuous-action off-policy learning (squashed-Gaussian actor,
+twin critics, learned temperature).
+
+The continuous-control column of the reference's algorithm matrix
+(reference: python/ray/rllib/algorithms/sac/sac.py +
+sac_learner/torch/sac_torch_learner.py — env runners feed a replay
+buffer; the learner does twin-Q TD against polyak target critics, a
+reparameterized squashed-Gaussian policy update through min(Q1,Q2), and
+dual-descent temperature toward a target entropy), built
+TPU-idiomatically like dqn.py: the whole K-minibatch update loop —
+critic, actor, alpha, AND soft target sync — runs as ONE jitted
+``lax.scan`` so the learner does a single dispatch per train iteration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict
+
+import jax
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.dqn import ReplayBuffer
+from ray_tpu.rllib.env import make_env
+
+LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
+
+
+# --- networks -----------------------------------------------------------
+
+def init_actor(rng, obs_dim: int, act_dim: int, hidden=(64, 64)):
+    from ray_tpu.rllib.nets import head, init_trunk
+    sizes = (obs_dim, *hidden)
+    keys = jax.random.split(rng, len(sizes) + 1)
+    params = init_trunk(keys[:-1], sizes)
+    params["w_mu"], params["b_mu"] = head(keys[-2], sizes[-1], act_dim,
+                                          0.01)
+    params["w_ls"], params["b_ls"] = head(keys[-1], sizes[-1], act_dim,
+                                          0.01)
+    return params
+
+
+def init_critic(rng, obs_dim: int, act_dim: int, hidden=(64, 64)):
+    """Twin Q networks under one param tree (q1/q2 prefixes)."""
+    from ray_tpu.rllib.nets import head, init_trunk
+    sizes = (obs_dim + act_dim, *hidden)
+    params = {}
+    for name, key in zip(("q1", "q2"), jax.random.split(rng, 2)):
+        keys = jax.random.split(key, len(sizes))
+        sub = init_trunk(keys, sizes)
+        sub["w_out"], sub["b_out"] = head(keys[-1], sizes[-1], 1, 1.0)
+        params[name] = sub
+    return params
+
+
+def actor_dist(params, obs):
+    """obs (B, O) -> (mu, log_std) of the pre-squash Gaussian."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.nets import trunk_forward
+    h = trunk_forward(params, obs)
+    mu = h @ params["w_mu"] + params["b_mu"]
+    log_std = jnp.clip(h @ params["w_ls"] + params["b_ls"],
+                       LOG_STD_MIN, LOG_STD_MAX)
+    return mu, log_std
+
+
+def sample_action(params, obs, key, action_high: float):
+    """Reparameterized squashed-Gaussian sample -> (action, log_prob)."""
+    import jax.numpy as jnp
+    mu, log_std = actor_dist(params, obs)
+    std = jnp.exp(log_std)
+    u = mu + std * jax.random.normal(key, mu.shape)
+    a = jnp.tanh(u)
+    # log prob with tanh change-of-variables (numerically-stable form)
+    logp = (-0.5 * (((u - mu) / std) ** 2 + 2 * log_std
+                    + jnp.log(2 * jnp.pi))).sum(-1)
+    logp -= (2 * (jnp.log(2.0) - u - jax.nn.softplus(-2 * u))).sum(-1)
+    return a * action_high, logp
+
+
+def q_values(params, obs, act):
+    """-> (q1, q2), each (B,)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.nets import trunk_forward
+    x = jnp.concatenate([obs, act], axis=-1)
+    out = []
+    for name in ("q1", "q2"):
+        sub = params[name]
+        h = trunk_forward(sub, x)
+        out.append((h @ sub["w_out"] + sub["b_out"])[:, 0])
+    return out[0], out[1]
+
+
+# --- exploration actor --------------------------------------------------
+
+@ray_tpu.remote
+class SACRunner:
+    """Stochastic-policy transition collector (exploration comes from
+    the squashed-Gaussian itself; before learning starts, uniform
+    random torque seeds the buffer — reference: sac.py
+    num_steps_sampled_before_learning_starts)."""
+
+    def __init__(self, env_name: str, num_envs: int, steps_per_call: int,
+                 seed: int):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        self.env = make_env(env_name, num_envs, seed)
+        self.steps_per_call = steps_per_call
+        self.obs = self.env.reset_all()
+        self.key = jax.random.PRNGKey(seed)
+        self.rng = np.random.default_rng(seed)
+        self.ep_ret = np.zeros(num_envs, np.float32)
+        from collections import deque
+        self.done_returns = deque(maxlen=100)
+        self._sample = jax.jit(partial(sample_action,
+                                       action_high=self.env.ACTION_HIGH))
+
+    def sample(self, params, random_actions: bool = False
+               ) -> Dict[str, np.ndarray]:
+        from ray_tpu.rllib.rollout import collect
+        hi = self.env.ACTION_HIGH
+
+        def act(obs):
+            if random_actions:
+                return self.rng.uniform(
+                    -hi, hi, size=(self.env.num_envs,
+                                   self.env.ACTION_DIM)
+                ).astype(np.float32)
+            self.key, sub = jax.random.split(self.key)
+            a, _ = self._sample(params, obs, sub)
+            return np.asarray(a)
+
+        batch, self.obs = collect(self.env, self.obs,
+                                  self.steps_per_call, act,
+                                  self.ep_ret, self.done_returns)
+        return batch
+
+
+# --- learner ------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=(
+    "gamma", "tau", "lr", "action_high", "target_entropy"))
+def sac_update(actor, critic, target_critic, log_alpha, opt_states,
+               batches, keys, *, gamma=0.99, tau=0.005, lr=3e-4,
+               action_high=1.0, target_entropy=-1.0):
+    """One lax.scan over minibatches; each step = critic TD update,
+    reparameterized actor update through min(Q1,Q2), temperature
+    dual-descent, polyak target sync."""
+    import jax.numpy as jnp
+    import optax
+
+    opt = optax.adam(lr)
+
+    def critic_loss(c, a, tc, la, mb, key):
+        next_a, next_logp = sample_action(a, mb["next_obs"], key,
+                                          action_high)
+        tq1, tq2 = q_values(tc, mb["next_obs"], next_a)
+        alpha = jnp.exp(la)
+        backup = mb["rewards"] + gamma * (1.0 - mb["dones"]) * (
+            jnp.minimum(tq1, tq2) - alpha * next_logp)
+        backup = jax.lax.stop_gradient(backup)
+        q1, q2 = q_values(c, mb["obs"], mb["actions"])
+        return jnp.mean((q1 - backup) ** 2 + (q2 - backup) ** 2)
+
+    def actor_loss(a, c, la, mb, key):
+        act, logp = sample_action(a, mb["obs"], key, action_high)
+        q1, q2 = q_values(c, mb["obs"], act)
+        return jnp.mean(jnp.exp(la) * logp - jnp.minimum(q1, q2)), logp
+
+    def alpha_loss(la, logp):
+        # dual descent: alpha rises while entropy < target
+        return -jnp.mean(la * jax.lax.stop_gradient(
+            logp + target_entropy))
+
+    def step(carry, inp):
+        a, c, tc, la, (os_a, os_c, os_al) = carry
+        mb, key = inp
+        k1, k2 = jax.random.split(key)
+        cl, gc = jax.value_and_grad(critic_loss)(c, a, tc, la, mb, k1)
+        up, os_c = opt.update(gc, os_c, c)
+        c = optax.apply_updates(c, up)
+        (al, logp), ga = jax.value_and_grad(
+            actor_loss, has_aux=True)(a, c, la, mb, k2)
+        up, os_a = opt.update(ga, os_a, a)
+        a = optax.apply_updates(a, up)
+        all_, gal = jax.value_and_grad(alpha_loss)(la, logp)
+        up, os_al = opt.update(gal, os_al, la)
+        la = optax.apply_updates(la, up)
+        tc = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o, tc, c)
+        return (a, c, tc, la, (os_a, os_c, os_al)), \
+            jnp.stack([cl, al, all_])
+
+    (actor, critic, target_critic, log_alpha, opt_states), losses = \
+        jax.lax.scan(step,
+                     (actor, critic, target_critic, log_alpha,
+                      opt_states), (batches, keys))
+    return actor, critic, target_critic, log_alpha, opt_states, \
+        losses.mean(axis=0)
+
+
+@dataclass
+class SACConfig:
+    env: str = "Pendulum-v1"
+    num_env_runners: int = 1
+    num_envs_per_runner: int = 8
+    steps_per_call: int = 64          # env steps per runner per iteration
+    buffer_capacity: int = 100_000
+    learning_starts: int = 512        # min transitions before updates
+    batch_size: int = 128
+    updates_per_iter: int = 32
+    gamma: float = 0.99
+    tau: float = 0.005
+    lr: float = 3e-4
+    init_alpha: float = 0.1
+    target_entropy: float = None      # default: -action_dim
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    runner_options: dict = field(default_factory=dict)
+
+
+class SAC:
+    def __init__(self, config: SACConfig):
+        import jax.numpy as jnp
+        import optax
+        self.cfg = config
+        env = make_env(config.env, 1, 0)
+        if not getattr(env, "CONTINUOUS", False):
+            raise ValueError(
+                f"SAC needs a continuous-action env; {config.env!r} "
+                "is discrete (use DQN/PPO/IMPALA)")
+        self.obs_dim, self.act_dim = env.OBS_DIM, env.ACTION_DIM
+        self.action_high = float(env.ACTION_HIGH)
+        self.target_entropy = (config.target_entropy
+                               if config.target_entropy is not None
+                               else -float(self.act_dim))
+        k = jax.random.PRNGKey(config.seed)
+        ka, kc = jax.random.split(k)
+        self.actor = init_actor(ka, self.obs_dim, self.act_dim,
+                                config.hidden)
+        self.critic = init_critic(kc, self.obs_dim, self.act_dim,
+                                  config.hidden)
+        self.target_critic = jax.tree.map(lambda x: x, self.critic)
+        self.log_alpha = jnp.asarray(np.log(config.init_alpha),
+                                     jnp.float32)
+        opt = optax.adam(config.lr)
+        self.opt_states = (opt.init(self.actor), opt.init(self.critic),
+                           opt.init(self.log_alpha))
+        self.buffer = ReplayBuffer.remote(
+            config.buffer_capacity, self.obs_dim, config.seed,
+            act_shape=(self.act_dim,), act_dtype="float32")
+        self.runners = [
+            SACRunner.options(**config.runner_options).remote(
+                config.env, config.num_envs_per_runner,
+                config.steps_per_call, config.seed + 100 + i)
+            for i in range(config.num_env_runners)]
+        self._iter = 0
+        self._key = jax.random.PRNGKey(config.seed + 1)
+
+    def train(self) -> dict:
+        """One iteration: parallel exploration -> buffer add -> K jitted
+        SAC minibatch updates (critic+actor+alpha+polyak in one scan)."""
+        import jax.numpy as jnp
+        self._iter += 1
+        c = self.cfg
+        host_actor = jax.device_get(self.actor)
+        warmup = (self._iter * c.num_env_runners
+                  * c.num_envs_per_runner * c.steps_per_call
+                  <= c.learning_starts)
+        batches = ray_tpu.get(
+            [r.sample.remote(host_actor, warmup) for r in self.runners],
+            timeout=300)
+        ep_rets = [b.pop("episode_returns") for b in batches]
+        sizes = ray_tpu.get(
+            [self.buffer.add.remote(b) for b in batches], timeout=300)
+        losses = (float("nan"),) * 3
+        alpha = float(np.exp(jax.device_get(self.log_alpha)))
+        if sizes[-1] >= max(c.learning_starts, c.batch_size):
+            mbs = ray_tpu.get(self.buffer.sample.remote(
+                c.batch_size, c.updates_per_iter), timeout=300)
+            if mbs is not None:
+                mbs = {k: jnp.asarray(v) for k, v in mbs.items()}
+                self._key, sub = jax.random.split(self._key)
+                keys = jax.random.split(sub, c.updates_per_iter)
+                (self.actor, self.critic, self.target_critic,
+                 self.log_alpha, self.opt_states, ls) = sac_update(
+                    self.actor, self.critic, self.target_critic,
+                    self.log_alpha, self.opt_states, mbs, keys,
+                    gamma=c.gamma, tau=c.tau, lr=c.lr,
+                    action_high=self.action_high,
+                    target_entropy=self.target_entropy)
+                losses = tuple(float(x) for x in ls)
+        ep = np.concatenate([e for e in ep_rets if len(e)]) \
+            if any(len(e) for e in ep_rets) else np.array([0.0])
+        return {"training_iteration": self._iter,
+                "episode_reward_mean": float(ep.mean()),
+                "critic_loss": losses[0], "actor_loss": losses[1],
+                "alpha": alpha, "buffer_size": int(sizes[-1]),
+                "timesteps_this_iter": int(
+                    c.num_env_runners * c.num_envs_per_runner
+                    * c.steps_per_call)}
+
+    def get_policy_params(self):
+        return jax.device_get(self.actor)

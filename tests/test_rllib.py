@@ -105,3 +105,57 @@ def test_impala_learns_cartpole():
         algo.stop()
     finally:
         ray_tpu.shutdown()
+
+
+def test_pendulum_vec_dynamics():
+    from ray_tpu.rllib import PendulumVec
+    env = PendulumVec(4, seed=0)
+    obs = env.reset_all()
+    assert obs.shape == (4, 3)
+    total_done = 0
+    for _ in range(250):
+        obs, r, done = env.step(
+            np.random.default_rng(1).uniform(-2, 2, size=(4, 1)))
+        assert r.shape == (4,) and (r <= 0).all()
+        total_done += int(done.sum())
+    assert total_done == 4  # 200-step time-limit episodes
+    assert np.isfinite(obs).all()
+    # cos^2 + sin^2 == 1: the angle encoding stays on the circle
+    assert np.allclose(obs[:, 0] ** 2 + obs[:, 1] ** 2, 1.0, atol=1e-5)
+
+
+def test_sac_learns_pendulum():
+    """SAC (squashed-Gaussian actor, twin critics, learned temperature)
+    improves pendulum return >= 3x over the random-policy baseline —
+    the continuous-action proof the discrete algos can't give
+    (reference: rllib/algorithms/sac)."""
+    import time as _time
+
+    from ray_tpu.rllib import SAC, SACConfig
+    ray_tpu.init(num_cpus=4)
+    try:
+        algo = SAC(SACConfig(
+            num_env_runners=1, num_envs_per_runner=8,
+            steps_per_call=50,            # 400 steps/iter
+            learning_starts=400, batch_size=128,
+            updates_per_iter=400,         # ~1:1 update:env-step ratio
+            lr=1e-3, seed=0))
+        t0 = _time.monotonic()
+        baseline = None
+        final = None
+        for _ in range(48):
+            m = algo.train()
+            if baseline is None and m["episode_reward_mean"] != 0.0:
+                baseline = m["episode_reward_mean"]   # untrained policy
+            final = m["episode_reward_mean"]
+            if final != 0.0 and baseline is not None and \
+                    final > baseline / 3.0 and _time.monotonic() - t0 > 20:
+                break                     # already past the bar
+            if _time.monotonic() - t0 > 55:
+                break
+        assert baseline is not None and baseline < -500, baseline
+        # pendulum returns are negative costs: >=3x improvement means
+        # final cost below a third of the random baseline's
+        assert final > baseline / 3.0, (baseline, final)
+    finally:
+        ray_tpu.shutdown()
