@@ -37,7 +37,8 @@ th{background:#20242a} .num{text-align:right}
 _NAV = ("<nav><a href='/'>overview</a><a href='/nodes'>nodes</a>"
         "<a href='/actors'>actors</a><a href='/jobs'>jobs</a>"
         "<a href='/pgs'>placement groups</a><a href='/serve'>serve</a>"
-        "<a href='/tasks'>tasks</a><a href='/metrics'>metrics</a></nav>")
+        "<a href='/tasks'>tasks</a><a href='/history'>history</a>"
+        "<a href='/metrics'>metrics</a></nav>")
 
 
 def _esc(v) -> str:
@@ -84,14 +85,7 @@ async def _overview(fetch: Fetch) -> bytes:
     actors = await fetch("list_actors")
     jobs = await fetch("list_jobs")
     pgs = await fetch("list_pgs")
-    alive = [n for n in nodes if n["alive"]]
-    total: dict = {}
-    avail: dict = {}
-    for n in alive:
-        for k, v in (n.get("resources_total") or {}).items():
-            total[k] = total.get(k, 0.0) + v
-        for k, v in (n.get("resources_available") or {}).items():
-            avail[k] = avail.get(k, 0.0) + v
+    alive, total, avail = _aggregate_resources(nodes)
     by_state: dict = {}
     for a in actors:
         if a:
@@ -249,9 +243,118 @@ async def _tasks(fetch: Fetch) -> bytes:
     return _page("tasks", body)
 
 
+# --- time-series history ----------------------------------------------
+# The reference provisions Prometheus + Grafana for dashboard history
+# (dashboard/modules/metrics/); here a bounded in-process ring sampled
+# by MetricsServer._history_loop renders SVG sparklines directly — no
+# external TSDB, history depth = maxlen * export interval (~1h at 5s).
+
+from collections import deque as _deque
+
+_HISTORY: "_deque" = _deque(maxlen=720)
+
+
+def clear_history() -> None:
+    """Drop the ring (server stop / metrics.reset): a later cluster in
+    this process must not inherit a dead cluster's series."""
+    _HISTORY.clear()
+
+
+def _aggregate_resources(nodes):
+    """(alive_nodes, total, available) summed over alive nodes —
+    shared by /overview and the history sampler."""
+    alive = [n for n in nodes if n["alive"]]
+    total: dict = {}
+    avail: dict = {}
+    for n in alive:
+        for k, v in (n.get("resources_total") or {}).items():
+            total[k] = total.get(k, 0.0) + v
+        for k, v in (n.get("resources_available") or {}).items():
+            avail[k] = avail.get(k, 0.0) + v
+    return alive, total, avail
+
+
+async def record_sample(fetchers) -> None:
+    """Append one sample of cluster state + local metric counters."""
+    from ray_tpu.util import metrics as _m
+    sample = {"ts": time.time(), "metrics": _m.snapshot()}
+    if callable(fetchers):
+        fetchers = [fetchers]
+    for fetch in fetchers or []:
+        try:
+            nodes = await fetch("get_nodes")
+            actors = await fetch("list_actors")
+        except Exception:
+            continue
+        alive, total, avail = _aggregate_resources(nodes)
+        sample.update(
+            nodes_alive=len(alive),
+            actors_alive=sum(1 for a in actors
+                             if a and a["state"] == "ALIVE"),
+            cpu_avail=avail.get("CPU", 0.0),
+            cpu_total=total.get("CPU", 0.0))
+        break
+    _HISTORY.append(sample)
+
+
+def _spark(points: List[float], w: int = 640, h: int = 90) -> str:
+    pts = [p for p in points if p is not None]
+    if len(pts) < 2:
+        return "<p class=dim>(collecting&hellip;)</p>"
+    lo, hi = min(pts), max(pts)
+    span = (hi - lo) or 1.0
+    n = len(pts)
+    poly = " ".join(
+        f"{i * (w - 2) / (n - 1) + 1:.1f},"
+        f"{h - 8 - (p - lo) * (h - 16) / span:.1f}"
+        for i, p in enumerate(pts))
+    return (f"<svg width={w} height={h} viewBox='0 0 {w} {h}'>"
+            f"<polyline points='{poly}' fill='none' stroke='#7ab7ff' "
+            f"stroke-width='1.5'/>"
+            f"<text x='2' y='12' fill='#8a8f98' font-size='11'>"
+            f"max {hi:g}</text>"
+            f"<text x='2' y='{h - 1}' fill='#8a8f98' font-size='11'>"
+            f"min {lo:g}</text></svg>")
+
+
+def _rate(samples: List[dict], name: str) -> List[Optional[float]]:
+    """Per-second rate of a cumulative counter between samples."""
+    out: List[Optional[float]] = []
+    prev = None
+    for s in samples:
+        cur = (s.get("metrics") or {}).get(name)
+        if prev is None or cur is None or prev[1] is None \
+                or cur < prev[1] or s["ts"] <= prev[0]:
+            out.append(None)
+        else:
+            out.append((cur - prev[1]) / (s["ts"] - prev[0]))
+        prev = (s["ts"], cur)
+    return out[1:]
+
+
+async def _history(fetch: Fetch) -> bytes:
+    samples = list(_HISTORY)
+    if len(samples) >= 2:
+        mins = (samples[-1]["ts"] - samples[0]["ts"]) / 60.0
+        head = (f"<p class=dim>{len(samples)} samples spanning "
+                f"{mins:.1f} min (newest right)</p>")
+    else:
+        head = "<p class=dim>collecting&hellip;</p>"
+    series = [
+        ("nodes alive", [s.get("nodes_alive") for s in samples]),
+        ("actors alive", [s.get("actors_alive") for s in samples]),
+        ("CPU available", [s.get("cpu_avail") for s in samples]),
+        ("tasks submitted /s",
+         _rate(samples, "ray_tpu_tasks_submitted_total")),
+    ]
+    body = head + "".join(
+        f"<h2>{_esc(name)}</h2>{_spark(vals)}" for name, vals in series)
+    return _page("history", body)
+
+
 _PAGES = {"/": _overview, "/overview": _overview, "/nodes": _nodes,
           "/actors": _actors, "/jobs": _jobs, "/pgs": _pgs,
-          "/serve": _serve, "/tasks": _tasks}
+          "/serve": _serve, "/tasks": _tasks, "/history": _history}
 
 
 async def render(path: str, fetchers) -> Optional[bytes]:

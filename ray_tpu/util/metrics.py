@@ -196,11 +196,28 @@ def render_all() -> str:
     return "\n".join(p for p in parts if p) + "\n"
 
 
+def snapshot() -> Dict[str, float]:
+    """Current scalar value per metric name (values summed over label
+    sets) — the dashboard's history sampler reads this."""
+    out: Dict[str, float] = {}
+    with _LOCK:
+        for m in _REGISTRY.values():
+            if getattr(m, "kind", "") == "histogram":
+                continue  # no single scalar value
+            try:
+                out[m.name] = float(sum(m._values.values()))
+            except (AttributeError, TypeError):
+                continue
+    return out
+
+
 def reset() -> None:
-    """Test hook: drop all metrics and collectors."""
+    """Test hook: drop all metrics, collectors, and dashboard history."""
     with _LOCK:
         _REGISTRY.clear()
         _COLLECTORS.clear()
+    from ray_tpu.util import dashboard
+    dashboard.clear_history()
 
 
 _DASH_HTML = b"""<!doctype html><html><head><title>ray-tpu</title>
@@ -238,13 +255,42 @@ class MetricsServer:
     def __init__(self):
         self._server: Optional[asyncio.AbstractServer] = None
         self.addr: Optional[Tuple[str, int]] = None
+        self._sampler: Optional[asyncio.Task] = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self._server = await asyncio.start_server(self._on_conn, host, port)
         self.addr = self._server.sockets[0].getsockname()[:2]
+        if self._sampler is None:
+            self._sampler = asyncio.ensure_future(self._history_loop())
         return self.addr
 
+    async def _history_loop(self):
+        """Feed the dashboard's time-series ring: one cluster-state +
+        metric-snapshot sample per export interval (the reference
+        provisions Prometheus/Grafana for history; here a bounded
+        in-process ring serves /history directly)."""
+        from ray_tpu.config import get_config
+        from ray_tpu.util import dashboard
+        interval = max(0.25, get_config().metrics_export_interval_s)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await dashboard.record_sample(_state_fetchers())
+            except Exception:
+                pass  # sampling must never kill the server
+
     async def stop(self):
+        if self._sampler is not None:
+            self._sampler.cancel()
+            try:
+                await self._sampler
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._sampler = None
+            # this server's cluster is going away: a later cluster in
+            # the same process must not inherit its history
+            from ray_tpu.util import dashboard
+            dashboard.clear_history()
         if self._server is not None:
             self._server.close()
             try:

@@ -21,7 +21,7 @@ def _get(addr, path):
 
 def test_dashboard_pages_show_live_state(tmp_path):
     from ray_tpu.cluster_utils import Cluster
-    cfg = Config.from_env(metrics_port=0)
+    cfg = Config.from_env(metrics_port=0, metrics_export_interval_s=0.4)
     c = Cluster(config=cfg)
     agent = c.add_node(num_cpus=8, resources={"widget": 3.0})
     try:
@@ -87,6 +87,20 @@ def test_dashboard_pages_show_live_state(tmp_path):
 
         jobs = _get(addr, "/jobs")
         assert "driver jobs" in jobs
+
+        # time-series history: the sampler ring fills and the page
+        # renders SVG sparklines of live cluster series
+        deadline = time.monotonic() + 20
+        hist = ""
+        while time.monotonic() < deadline:
+            hist = _get(addr, "/history")
+            if "<svg" in hist:
+                break
+            time.sleep(0.5)
+        assert "<svg" in hist, "history sparklines never rendered"
+        assert "nodes alive" in hist and "CPU available" in hist
+        assert "tasks submitted /s" in hist
+        assert "samples spanning" in hist
 
         # legacy raw metric table still there; unknown paths 404
         assert "metric" in _get(addr, "/raw")
