@@ -73,6 +73,15 @@ class LLMEngine:
         llm/_internal/serve/configs/llm_config.py:181-186
         tensor_parallel_size + placement bundles per replica)."""
         import jax.numpy as jnp
+        if mesh is not None and getattr(cfg, "attn_impl", "auto") in (
+                "auto", "flash", "flash_interpret"):
+            # Tensor-parallel serving shards the head dim via GSPMD,
+            # and the pallas flash kernel cannot be auto-partitioned
+            # (training wraps it in shard_map; the serving jits don't)
+            # — force the XLA reference attention, which GSPMD
+            # partitions fine.
+            import dataclasses
+            cfg = dataclasses.replace(cfg, attn_impl="reference")
         self.cfg = cfg
         self.mesh = mesh
         self.tensor_axis = tensor_axis
@@ -86,7 +95,14 @@ class LLMEngine:
                                     if b <= max_len)) or (max_len,)
         self.detokenize = detokenize
         import jax
-        self._cache = lm.init_cache(cfg, max_slots, max_len,
+        # Bucketed KV growth (the dense-cache answer to paged KV —
+        # reference capability: vLLM's paged cache bounds HBM by live
+        # tokens): the cache starts at a small length and DOUBLES, up
+        # to max_len, only when an admitted request actually needs the
+        # room — max_len=8k costs 8k-sized HBM only once an 8k request
+        # arrives, and each growth step is one bounded recompile.
+        self._cache_len = min(max_len, max(1024, self.buckets[-1]))
+        self._cache = lm.init_cache(cfg, max_slots, self._cache_len,
                                     dtype=jnp.dtype(cache_dtype),
                                     mesh=mesh, axis=tensor_axis)
         self._slots: List[Optional[_Request]] = [None] * max_slots
@@ -101,7 +117,33 @@ class LLMEngine:
         self._loop_task: Optional[asyncio.Task] = None
         self._stopped = False
         self.stats = {"requests": 0, "tokens_generated": 0,
-                      "ttft_sum": 0.0, "ttft_count": 0}
+                      "ttft_sum": 0.0, "ttft_count": 0,
+                      "cache_len": self._cache_len}
+
+    def _grow_cache(self, need: int) -> None:
+        """Double the per-slot KV length (bucketed) until >= need,
+        capped at max_len; active slots' KV is preserved (zero-pad on
+        the length axis, resharded onto the mesh when tensor-parallel)."""
+        new_len = self._cache_len
+        while new_len < need:
+            new_len *= 2
+        new_len = min(new_len, self.max_len)
+        pad = new_len - self._cache_len
+        if pad <= 0:
+            return
+        import jax
+        import jax.numpy as jnp
+        c = self._cache
+        widths = ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
+        k, v = jnp.pad(c["k"], widths), jnp.pad(c["v"], widths)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            s = NamedSharding(self.mesh,
+                              P(None, None, None, self.tensor_axis, None))
+            k, v = jax.device_put(k, s), jax.device_put(v, s)
+        self._cache = {"k": k, "v": v, "length": c["length"]}
+        self._cache_len = new_len
+        self.stats["cache_len"] = new_len
 
     # --- public API -----------------------------------------------------
 
@@ -240,6 +282,11 @@ class LLMEngine:
                             self._waiting.empty():
                         continue
                     r = self._waiting.get_nowait()
+                    need = len(r.tokens) + r.max_new_tokens
+                    if r.prefilled is not None:
+                        need = max(need, int(r.prefilled["k"].shape[1]))
+                    if need > self._cache_len:
+                        self._grow_cache(need)
                     try:
                         tok = await loop.run_in_executor(
                             None, self._admit_sync, slot, r)
@@ -273,7 +320,7 @@ class LLMEngine:
                     r = self._slots[i]
                     block = min(block,
                                 r.max_new_tokens - len(r.out),
-                                self.max_len - len(r.tokens)
+                                self._cache_len - len(r.tokens)
                                 - len(r.out))
                 block = 1 << (max(1, block).bit_length() - 1)  # pow2 dn
                 tokens = np.zeros((self.max_slots,), np.int32)
@@ -346,7 +393,8 @@ class LLMEngine:
             b = self._bucket_for(n)
             padded = lm.pad_prompt(r.tokens, b)
             logits, kv = lm.prefill(self.params, jnp.asarray(padded),
-                                    jnp.int32(n), self.cfg, self.max_len)
+                                    jnp.int32(n), self.cfg,
+                                    self._cache_len)
         else:
             logits, kv = self._chunked_prefill(r.tokens)
         self._cache = lm.write_prefill_to_cache(
@@ -364,13 +412,13 @@ class LLMEngine:
         import jax.numpy as jnp
         cdt = self._cache["k"].dtype
         chunk = self.buckets[-1]
-        # accumulator length is a BUCKET MULTIPLE >= max_len: a padded
-        # final chunk written at a chunk-multiple offset then never
-        # overruns it (dynamic_update_slice CLAMPS the start index on
-        # overrun, which would silently shift the chunk and corrupt
-        # earlier positions); sliced back to max_len before the cache
-        # write
-        acc_len = ((self.max_len + chunk - 1) // chunk) * chunk
+        # accumulator length is a BUCKET MULTIPLE >= the current cache
+        # length: a padded final chunk written at a chunk-multiple
+        # offset then never overruns it (dynamic_update_slice CLAMPS
+        # the start index on overrun, which would silently shift the
+        # chunk and corrupt earlier positions); sliced back to
+        # _cache_len before the cache write
+        acc_len = ((self._cache_len + chunk - 1) // chunk) * chunk
         shape = (self.cfg.n_layers, acc_len, self.cfg.n_kv_heads,
                  self.cfg.head_dim)
         acc = {"k": jnp.zeros(shape, cdt), "v": jnp.zeros(shape, cdt)}
@@ -389,8 +437,8 @@ class LLMEngine:
                 self.params, jnp.asarray(padded), jnp.int32(len(part)),
                 jnp.int32(off), acc, self.cfg)
             off += len(part)
-        if acc_len > self.max_len:
-            acc = {k: v[:, :self.max_len] for k, v in acc.items()}
+        if acc_len > self._cache_len:
+            acc = {k: v[:, :self._cache_len] for k, v in acc.items()}
         return logits, acc
 
     def _decode_sync(self, tokens: np.ndarray, temps: np.ndarray,

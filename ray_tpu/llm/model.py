@@ -137,12 +137,29 @@ def _gqa_attend_cached(q, cache_k, cache_v, lengths, cfg: LlamaConfig):
     return out.reshape(b, h * hd)
 
 
+def _serve_attn_impl(cfg: LlamaConfig) -> str:
+    """Map the model's attn_impl onto the serving prefill dispatch:
+    'ring' is a training-only (context-parallel) layout — serving falls
+    back to 'auto' (flash on TPU for long prompts, reference
+    elsewhere)."""
+    impl = getattr(cfg, "attn_impl", "auto")
+    return "auto" if impl == "ring" else impl
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_len"))
 def prefill(params: dict, tokens: jax.Array, length: jax.Array,
             cfg: LlamaConfig, max_len: int) -> Tuple[jax.Array, dict]:
     """One padded prompt. tokens: (s,) int32 (padded to a bucket);
     length: () actual prompt length. Returns (last-token logits (vocab,),
-    per-layer kv padded to max_len: k/v (layers, max_len, kvh, hd))."""
+    per-layer kv padded to max_len: k/v (layers, max_len, kvh, hd)).
+
+    Attention dispatches through ops.attention (cfg.attn_impl): the
+    pallas flash kernel tiles long prompts on TPU instead of
+    materializing the O(s^2) score tensor. Causal alone is exact here:
+    pad keys sit at positions >= length, and every USED query row is
+    < length, so causality already excludes them (pad rows' outputs are
+    garbage but only row length-1 is read)."""
+    from ray_tpu.ops.attention import attention as _attention
     s = tokens.shape[0]
     x = jnp.take(params["embed"], tokens[None], axis=0)  # (1, s, emb)
     positions = jnp.arange(s, dtype=jnp.int32)[None]
@@ -152,20 +169,9 @@ def prefill(params: dict, tokens: jax.Array, length: jax.Array,
         y = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
         q, k, v = _qkv(y, lp, cfg)
         q, k = _rope(q, rc, rs), _rope(k, rc, rs)
-        # causal reference attention (prompt lengths are modest; the
-        # pallas flash path stays on the training side)
-        h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-        g = h // kvh
-        qg = q[0].reshape(s, kvh, g, hd).astype(jnp.float32)
-        kf = k[0].astype(jnp.float32)  # (s, kvh, hd)
-        scores = jnp.einsum("skgd,lkd->kgsl", qg, kf) / jnp.sqrt(hd)
-        causal = jnp.tril(jnp.ones((s, s), bool))
-        valid = jnp.arange(s)[None, :] < length  # keys within prompt
-        m = causal & valid
-        scores = jnp.where(m[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("kgsl,lkd->skgd", probs,
-                       v[0].astype(jnp.float32))
+        h, hd = cfg.n_heads, cfg.head_dim
+        o = _attention(q, k, v, causal=True, sm_scale=hd ** -0.5,
+                       impl=_serve_attn_impl(cfg))
         o = o.reshape(1, s, h * hd).astype(x.dtype)
         x = x + o @ lp["wo"]
         y = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -182,9 +188,8 @@ def prefill(params: dict, tokens: jax.Array, length: jax.Array,
     return logits, {"k": jnp.pad(ks, pad), "v": jnp.pad(vs, pad)}
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(4,))
 def prefill_chunk(params: dict, tokens: jax.Array, length: jax.Array,
-                  offset: jax.Array, acc: dict,
+                  offset, acc: dict,
                   cfg: LlamaConfig) -> Tuple[jax.Array, dict]:
     """One CHUNK of a long prompt: process `tokens` (one padded bucket)
     starting at absolute position `offset`, attending to all earlier
@@ -200,7 +205,76 @@ def prefill_chunk(params: dict, tokens: jax.Array, length: jax.Array,
     in place with this chunk's. Returns (logits of the chunk's last
     valid token (vocab,), updated acc). Positions in acc beyond
     offset+length may hold pad garbage; every consumer masks by total
-    length, so it is never attended to."""
+    length, so it is never attended to.
+
+    Dispatch: flash-capable impls route to the pallas kernel with the
+    chunk's absolute offset placing the causal diagonal (one compile
+    per distinct offset — offsets are chunk-size multiples, so at most
+    ceil(max_len / chunk) variants); otherwise the dynamic-offset XLA
+    path below compiles once."""
+    from ray_tpu.ops.attention import _on_tpu
+    impl = _serve_attn_impl(cfg)
+    if impl == "flash" or impl == "flash_interpret" or (
+            impl == "auto" and _on_tpu() and tokens.shape[0] >= 128):
+        if impl == "auto":
+            impl = "flash"
+        return _prefill_chunk_flash(params, tokens, length, int(offset),
+                                    acc, cfg, impl)
+    return _prefill_chunk_dyn(params, tokens, length,
+                              jnp.asarray(offset, jnp.int32), acc, cfg)
+
+
+@partial(jax.jit, static_argnames=("cfg", "offset", "impl"),
+         donate_argnums=(4,))
+def _prefill_chunk_flash(params: dict, tokens: jax.Array,
+                         length: jax.Array, offset: int, acc: dict,
+                         cfg: LlamaConfig, impl: str):
+    """Flash chunked prefill: the kernel's q_offset places the causal
+    diagonal at the chunk's absolute position, so no O(s x L) mask or
+    score tensor is materialized. Causal alone is exact for every USED
+    query row (see prefill)."""
+    from ray_tpu.ops.attention import attention as _attention
+    s = tokens.shape[0]
+    h, hd = cfg.n_heads, cfg.head_dim
+    x = jnp.take(params["embed"], tokens[None], axis=0)     # (1, s, emb)
+    positions = (offset + jnp.arange(s, dtype=jnp.int32))[None]
+    rc, rs = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    def layer(carry, xs):
+        x = carry
+        lp, ak, av = xs     # ak/av: (L, kvh, hd) this layer's acc
+        y = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(y, lp, cfg)
+        q, k = _rope(q, rc, rs), _rope(k, rc, rs)
+        ak = lax.dynamic_update_slice(
+            ak, k[0].astype(ak.dtype),
+            (jnp.int32(offset), jnp.int32(0), jnp.int32(0)))
+        av = lax.dynamic_update_slice(
+            av, v[0].astype(av.dtype),
+            (jnp.int32(offset), jnp.int32(0), jnp.int32(0)))
+        o = _attention(q, ak[None].astype(q.dtype),
+                       av[None].astype(q.dtype), causal=True,
+                       sm_scale=hd ** -0.5, impl=impl, q_offset=offset)
+        o = o.reshape(1, s, h * hd).astype(x.dtype)
+        x = x + o @ lp["wo"]
+        y = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + ((jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"]))
+                 @ lp["w_down"])
+        return x, (ak, av)
+
+    x, (nk, nv) = lax.scan(layer, x, (params["layers"],
+                                      acc["k"], acc["v"]))
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    last = jnp.take(x[0], length - 1, axis=0)
+    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": nk, "v": nv}
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(4,))
+def _prefill_chunk_dyn(params: dict, tokens: jax.Array,
+                       length: jax.Array, offset: jax.Array, acc: dict,
+                       cfg: LlamaConfig) -> Tuple[jax.Array, dict]:
+    """Dynamic-offset XLA path (single compile; O(s x L) scores)."""
     s = tokens.shape[0]
     L = acc["k"].shape[1]
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim

@@ -30,9 +30,12 @@ def _repeat_kv(k: jax.Array, num_heads: int) -> jax.Array:
 
 def mha_reference(q, k, v, *, causal: bool = True,
                   sm_scale: Optional[float] = None,
-                  segment_ids: Optional[jax.Array] = None) -> jax.Array:
+                  segment_ids: Optional[jax.Array] = None,
+                  q_offset: Optional[int] = None) -> jax.Array:
     """Plain XLA attention. (b, s, h, d) layout. O(S^2) memory — the
-    correctness oracle and the CPU-test path."""
+    correctness oracle and the CPU-test path. ``q_offset`` places the
+    causal diagonal (query i attends keys <= i + q_offset; default
+    sk - sq: queries are the last rows)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     k = _repeat_kv(k, h)
@@ -42,7 +45,8 @@ def mha_reference(q, k, v, *, causal: bool = True,
                         k.astype(jnp.float32)) * scale
     keep = jnp.ones((b, 1, sq, sk), dtype=bool)
     if causal:
-        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        diag = (sk - sq) if q_offset is None else q_offset
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=diag)
         keep = keep & mask[None, None]
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
@@ -57,16 +61,24 @@ def mha_reference(q, k, v, *, causal: bool = True,
 
 # --- flash attention with custom vjp (pallas fwd + pallas bwd) -------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+           q_offset=None):
     # Primal (inference) path: skip the lse output entirely.
     o, _ = _fa.flash_attention_fwd(q, k, v, sm_scale=sm_scale, causal=causal,
                                    block_q=block_q, block_k=block_k,
-                                   interpret=interpret, with_lse=False)
+                                   interpret=interpret, with_lse=False,
+                                   q_offset=q_offset)
     return o
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+               q_offset=None):
+    if q_offset is not None:
+        raise NotImplementedError(
+            "q_offset (chunked-prefill causal placement) is an "
+            "inference-only path; the backward kernels assume the "
+            "queries are the last rows")
     o, lse = _fa.flash_attention_fwd(q, k, v, sm_scale=sm_scale, causal=causal,
                                      block_q=block_q, block_k=block_k,
                                      interpret=interpret)
@@ -81,7 +93,8 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     return o, (q, k, v, o, lse_small)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, q_offset,
+               res, do):
     q, k, v, o, lse_small = res
     lse = jnp.broadcast_to(lse_small, lse_small.shape[:2] + (_fa.LANES,))
     dq, dk, dv = _fa.flash_attention_bwd(
@@ -96,8 +109,11 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: bool = False) -> jax.Array:
-    """Pallas flash attention, (b, s, h, d) layout, differentiable."""
+                    interpret: bool = False,
+                    q_offset: Optional[int] = None) -> jax.Array:
+    """Pallas flash attention, (b, s, h, d) layout, differentiable
+    (except with q_offset, which is the inference-only chunked-prefill
+    causal placement)."""
     b, sq, h, d = q.shape
     k = _repeat_kv(k, h)
     v = _repeat_kv(v, h)
@@ -107,7 +123,8 @@ def flash_attention(q, k, v, *, causal: bool = True,
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    of = _flash(qf, kf, vf, scale, causal, block_q, block_k, interpret)
+    of = _flash(qf, kf, vf, scale, causal, block_q, block_k, interpret,
+                q_offset)
     return of.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
@@ -121,19 +138,22 @@ def _on_tpu() -> bool:
 def attention(q, k, v, *, causal: bool = True,
               sm_scale: Optional[float] = None,
               impl: str = "auto",
-              block_q: int = 128, block_k: int = 128) -> jax.Array:
+              block_q: int = 128, block_k: int = 128,
+              q_offset: Optional[int] = None) -> jax.Array:
     """Dispatch: 'auto' uses the Pallas kernel on TPU for seq >= 128 and the
     XLA reference otherwise. 'flash' / 'reference' force a path;
     'flash_interpret' runs the kernel in interpret mode (CPU tests)."""
     if impl == "auto":
         impl = "flash" if (_on_tpu() and q.shape[1] >= 128) else "reference"
     if impl == "reference":
-        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                             q_offset=q_offset)
     if impl == "flash":
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               q_offset=q_offset)
     if impl == "flash_interpret":
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                block_q=block_q, block_k=block_k,
-                               interpret=True)
+                               interpret=True, q_offset=q_offset)
     raise ValueError(f"unknown attention impl: {impl}")

@@ -134,17 +134,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 
 
 def flash_attention_fwd(q, k, v, *, sm_scale, causal, block_q=128, block_k=128,
-                        interpret=False, with_lse=True):
+                        interpret=False, with_lse=True, q_offset=None):
     """q,k,v: (BH, S, D) -> (o: (BH, S, D), lse: (BH, S, LANES) f32 | None).
 
     lse is the row logsumexp saved as a backward residual (lane-broadcast
     layout; logically (BH, S)). Inference callers pass with_lse=False to
-    skip the extra HBM write (pallas outputs are never DCE'd)."""
+    skip the extra HBM write (pallas outputs are never DCE'd).
+
+    ``q_offset`` places the causal diagonal: query row i attends keys
+    <= i + q_offset. Default (None) = sk - sq, i.e. queries are the
+    LAST sq rows of the kv sequence. Chunked prefill passes the chunk's
+    absolute start position instead (queries sit mid-sequence, not at
+    the end); must be static — one compile per distinct offset."""
     bh, sq, d = q.shape
     _, sk, _ = k.shape
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    offset = sk - sq
+    offset = (sk - sq) if q_offset is None else int(q_offset)
     q = (q.astype(jnp.float32) * sm_scale).astype(q.dtype)  # fold scale in
     qp, kp, vp = _pad_seq(q, block_q), _pad_seq(k, block_k), _pad_seq(v, block_k)
     nq = qp.shape[1] // block_q

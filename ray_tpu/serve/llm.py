@@ -32,8 +32,12 @@ class LLMConfig:
     model_overrides: dict = field(default_factory=dict)
     checkpoint: Optional[str] = None
     max_slots: int = 8
-    max_len: int = 1024
-    prefill_buckets: tuple = (64, 128, 256, 512)
+    # long-context by default: the engine's KV cache starts small and
+    # grows in buckets, so 8k max_len costs 8k-sized HBM only when an
+    # 8k request actually arrives; prompts past the largest bucket
+    # stream through chunked prefill
+    max_len: int = 8192
+    prefill_buckets: tuple = (64, 128, 256, 512, 1024, 2048)
     cache_dtype: str = "bfloat16"
     steps_per_sync: int = 8
     seed: int = 0

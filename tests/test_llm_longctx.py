@@ -1,0 +1,115 @@
+"""Long-context serving: bucketed KV-cache growth, chunked prefill at
+multi-k prompt lengths, flash-kernel parity in the serving forward pass.
+
+Reference capability: vLLM long-context serving (paged KV + chunked
+prefill) behind ray.serve.llm; here the engine's dense cache grows in
+buckets and prompts stream through lm.prefill_chunk.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+
+
+def _tiny(**kw):
+    from ray_tpu.models import llama
+    base = dict(vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, ffn_dim=128, dtype="float32",
+                logits_dtype="float32", attn_impl="reference")
+    base.update(kw)
+    return llama.tiny(**base)
+
+
+def _params(cfg, seed=0):
+    from ray_tpu.models import llama
+    return llama.init_params(jax.random.PRNGKey(seed), cfg)
+
+
+def _engine(cfg, params, **kw):
+    from ray_tpu.llm.engine import LLMEngine
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("cache_dtype", "float32")
+    kw.setdefault("steps_per_sync", 4)
+    return LLMEngine(cfg, params, **kw)
+
+
+def test_cache_starts_small_and_grows_in_buckets():
+    cfg = _tiny()
+    eng = _engine(cfg, _params(cfg), max_len=8192,
+                  prefill_buckets=(64, 128, 256))
+    assert eng._cache_len == 1024          # not 8192 up front
+    assert eng.stats["cache_len"] == 1024
+
+    async def run(n_prompt, n_new):
+        prompt = [int(x) for x in
+                  np.random.default_rng(0).integers(1, 127, n_prompt)]
+        return await eng.generate(prompt, max_new_tokens=n_new,
+                                  temperature=0.0)
+
+    out = asyncio.run(run(16, 8))
+    assert len(out["tokens"]) == 8
+    assert eng._cache_len == 1024          # short request: no growth
+    # a request needing 1500 positions doubles the cache once
+    out = asyncio.run(run(1400, 100))
+    assert len(out["tokens"]) == 100
+    assert eng._cache_len == 2048
+    assert eng.stats["cache_len"] == 2048
+
+
+def test_long_prompt_chunked_equals_single_bucket():
+    """A 1.3k-token prompt streamed through 256-sized chunks decodes
+    the same greedy tokens as one big-bucket prefill — the chunked
+    path is exact, not approximate."""
+    cfg = _tiny()
+    params = _params(cfg)
+    prompt = [int(x) for x in
+              np.random.default_rng(1).integers(1, 127, 1300)]
+
+    chunked = _engine(cfg, params, max_len=2048,
+                      prefill_buckets=(256,))
+    direct = _engine(cfg, params, max_len=2048,
+                     prefill_buckets=(2048,))
+
+    async def gen(eng):
+        return await eng.generate(prompt, max_new_tokens=24,
+                                  temperature=0.0)
+
+    a = asyncio.run(gen(chunked))["tokens"]
+    b = asyncio.run(gen(direct))["tokens"]
+    assert a == b, (a, b)
+
+
+def test_flash_serving_prefill_matches_reference():
+    """The pallas flash kernel (interpret mode on CPU) in the serving
+    prefill produces the same greedy decode as the XLA reference —
+    including the chunked path with its absolute causal offset."""
+    ref_cfg = _tiny(attn_impl="reference")
+    fl_cfg = _tiny(attn_impl="flash_interpret")
+    params = _params(ref_cfg)
+    prompt = [int(x) for x in
+              np.random.default_rng(2).integers(1, 127, 200)]
+
+    async def gen(cfg, buckets):
+        eng = _engine(cfg, params, max_len=512,
+                      prefill_buckets=buckets)
+        return (await eng.generate(prompt, max_new_tokens=16,
+                                   temperature=0.0))["tokens"]
+
+    ref = asyncio.run(gen(ref_cfg, (256,)))       # chunked (200<256? no:
+    # 200 fits bucket 256 -> single prefill) and a chunked variant:
+    ref_chunked = asyncio.run(gen(ref_cfg, (128,)))   # 2 chunks
+    fl = asyncio.run(gen(fl_cfg, (256,)))
+    fl_chunked = asyncio.run(gen(fl_cfg, (128,)))
+    assert ref == ref_chunked
+    assert fl == ref, (fl, ref)
+    assert fl_chunked == ref, (fl_chunked, ref)
+
+
+def test_default_serve_config_is_long_context():
+    from ray_tpu.serve.llm import LLMConfig
+    cfg = LLMConfig()
+    assert cfg.max_len >= 8192
+    assert max(cfg.prefill_buckets) >= 2048
