@@ -615,6 +615,14 @@ class ActorClass:
         scheduling = {}
         if opts.get("labels"):
             scheduling["labels"] = opts["labels"]
+        groups = opts.get("concurrency_groups")
+        mc = opts.get("max_concurrency", 1)
+        if groups and "max_concurrency" not in opts:
+            # declaring groups implies a concurrent actor: the caller
+            # pipeline must admit at least as many in-flight calls as
+            # the groups can execute (reference: concurrency_groups
+            # actors are concurrent by construction)
+            mc = max(1, sum(int(v) for v in groups.values()))
         try:
             actor_id = _run(ctx.create_actor(
                 self._cls, args, kwargs,
@@ -622,7 +630,8 @@ class ActorClass:
                 namespace=opts.get("namespace", _g.namespace),
                 resources=resources,
                 max_restarts=opts.get("max_restarts", 0),
-                max_concurrency=opts.get("max_concurrency", 1),
+                max_concurrency=mc,
+                concurrency_groups=groups,
                 pg=_pg_tuple(opts),
                 scheduling=scheduling or None,
                 lifetime=opts.get("lifetime"),

@@ -74,7 +74,7 @@ class LLMEngine:
         tensor_parallel_size + placement bundles per replica)."""
         import jax.numpy as jnp
         if mesh is not None and getattr(cfg, "attn_impl", "auto") in (
-                "auto", "flash", "flash_interpret"):
+                "auto", "flash", "flash_interpret", "ring"):
             # Tensor-parallel serving shards the head dim via GSPMD,
             # and the pallas flash kernel cannot be auto-partitioned
             # (training wraps it in shard_map; the serving jits don't)
@@ -282,11 +282,6 @@ class LLMEngine:
                             self._waiting.empty():
                         continue
                     r = self._waiting.get_nowait()
-                    need = len(r.tokens) + r.max_new_tokens
-                    if r.prefilled is not None:
-                        need = max(need, int(r.prefilled["k"].shape[1]))
-                    if need > self._cache_len:
-                        self._grow_cache(need)
                     try:
                         tok = await loop.run_in_executor(
                             None, self._admit_sync, slot, r)
@@ -361,6 +356,15 @@ class LLMEngine:
         into the slot."""
         import jax.numpy as jnp
         n = len(r.tokens)
+        # Bucketed growth runs HERE (executor thread): padding and
+        # re-uploading a multi-GB cache on the event loop would stall
+        # every in-flight stream. Admits and decode blocks are awaited
+        # one at a time by the loop, so cache mutation stays serialized.
+        need = n + r.max_new_tokens
+        if r.prefilled is not None:
+            need = max(need, int(r.prefilled["k"].shape[1]))
+        if need > self._cache_len:
+            self._grow_cache(need)
         if r.prefilled is not None:
             p = r.prefilled
             r.prefilled = None          # free the host copy after write

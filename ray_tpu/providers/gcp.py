@@ -53,6 +53,12 @@ def pod_type_for(chips: int, chips_per_host: float,
     return f"{generation}-{int(chips)}"
 
 
+class TransientAPIError(RuntimeError):
+    """A rate-limit (429) / server-blip API failure that outlived the
+    client's quick retries: the caller should back off and try again
+    later — it is NOT a permanent failure."""
+
+
 class GCPClient:
     """Minimal REST transport for tpu.googleapis.com.
 
@@ -111,6 +117,35 @@ class GCPClient:
     def _parent(self) -> str:
         return f"projects/{self.project}/locations/{self.zone}"
 
+    # Transient API statuses worth an immediate short retry: rate
+    # limits and server-side blips. Anything else is surfaced to the
+    # reconciler, which applies its own longer, non-blocking backoff.
+    RETRYABLE = frozenset({429, 500, 502, 503, 504})
+
+    def _call(self, method: str, url: str,
+              body: Optional[dict]) -> Tuple[int, dict]:
+        """_request with two quick exponential retries on transient
+        statuses/transport errors — absorbs blips without stalling the
+        reconcile loop for long (sustained 429s are the RECONCILER's
+        problem: it backs off per-PG without blocking)."""
+        delay = 0.5
+        last_exc: Optional[Exception] = None
+        for attempt in range(3):
+            try:
+                status, resp = self._request(method, url, body)
+                last_exc = None
+            except Exception as e:  # noqa: BLE001 — network blip
+                last_exc = e
+                status, resp = 599, {"error": str(e)}
+            transient = status in self.RETRYABLE or status == 599
+            if not transient or attempt == 2:
+                if last_exc is not None:
+                    raise TransientAPIError(str(last_exc)) from last_exc
+                return status, resp
+            time.sleep(delay)
+            delay *= 2
+        return status, resp  # pragma: no cover — loop always returns
+
     # --- queued resources ----------------------------------------------
 
     def create_queued_resource(self, qr_id: str, node: dict) -> dict:
@@ -122,7 +157,10 @@ class GCPClient:
         body = {"tpu": {"node_spec": [{"parent": self._parent(),
                                        "node_id": qr_id,
                                        "node": node}]}}
-        status, resp = self._request("POST", url, body)
+        status, resp = self._call("POST", url, body)
+        if status in self.RETRYABLE:
+            raise TransientAPIError(
+                f"create_queued_resource {qr_id}: {status} {resp}")
         if status >= 300:
             raise RuntimeError(f"create_queued_resource {qr_id}: "
                                f"{status} {resp}")
@@ -131,14 +169,17 @@ class GCPClient:
     def delete_queued_resource(self, qr_id: str) -> None:
         url = (f"{self.API}/{self._parent()}/queuedResources/{qr_id}"
                f"?force=true")
-        status, resp = self._request("DELETE", url, None)
+        status, resp = self._call("DELETE", url, None)
+        if status in self.RETRYABLE:
+            raise TransientAPIError(
+                f"delete_queued_resource {qr_id}: {status} {resp}")
         if status >= 300 and status != 404:
             raise RuntimeError(f"delete_queued_resource {qr_id}: "
                                f"{status} {resp}")
 
     def list_queued_resources(self) -> List[dict]:
         url = f"{self.API}/{self._parent()}/queuedResources"
-        status, resp = self._request("GET", url, None)
+        status, resp = self._call("GET", url, None)
         if status >= 300:
             raise RuntimeError(f"list_queued_resources: {status} {resp}")
         return resp.get("queuedResources", [])
@@ -244,6 +285,14 @@ class TPUSliceAutoscaler(Autoscaler):
         self.slice_provider = slice_provider
         self._pg_slices: Dict[str, str] = {}     # pg hex -> qr handle
         self._slice_orphaned_at: Dict[str, float] = {}
+        # pg hex -> (next_attempt_monotonic, current_delay): create
+        # failures (quota 429s, API errors) back off exponentially per
+        # PG WITHOUT blocking the reconcile loop — a transient failure
+        # must not be indistinguishable from a permanent one, and a
+        # sustained quota error must not hammer the API every pass.
+        self._create_backoff: Dict[str, Tuple[float, float]] = {}
+        self.CREATE_BACKOFF_INITIAL_S = 5.0
+        self.CREATE_BACKOFF_MAX_S = 300.0
 
     async def reconcile_once(self) -> dict:
         actions = await super().reconcile_once()
@@ -285,22 +334,44 @@ class TPUSliceAutoscaler(Autoscaler):
                 self._pg_slices.setdefault(pg, h)
         claimed = set(self._pg_slices.values())
 
-        # create: one slice per unclaimed pending slice-PG
+        # create: one slice per unclaimed pending slice-PG (failures
+        # back off per PG — see _create_backoff)
+        now0 = time.monotonic()
+        actions["slice_create_errors"] = 0
         for pg_hex, bundles in pending.items():
             if pg_hex in self._pg_slices:
                 continue
             if len(handles) >= cfg.max_slices:
                 break
+            next_try, delay = self._create_backoff.get(pg_hex, (0.0, 0.0))
+            if now0 < next_try:
+                continue
             chips = int(sum(float(b["TPU"]) for b in bundles))
             pod_type = pod_type_for(chips, 0, cfg.generation)
             per_host = {"TPU": float(max(float(b["TPU"])
                                          for b in bundles))}
-            handle = await self.slice_provider.launch(
-                per_host, {"tpu_pod_type": pod_type,
-                           "slice_for_pg": pg_hex})
+            try:
+                handle = await self.slice_provider.launch(
+                    per_host, {"tpu_pod_type": pod_type,
+                               "slice_for_pg": pg_hex})
+            except Exception as e:  # noqa: BLE001 — transient OR quota
+                new_delay = min(
+                    self.CREATE_BACKOFF_MAX_S,
+                    max(self.CREATE_BACKOFF_INITIAL_S, delay * 2))
+                self._create_backoff[pg_hex] = (now0 + new_delay,
+                                                new_delay)
+                actions["slice_create_errors"] += 1
+                actions.setdefault("slice_create_last_error",
+                                   f"{type(e).__name__}: {e}")
+                continue
+            self._create_backoff.pop(pg_hex, None)
             self._pg_slices[pg_hex] = handle
             handles.add(handle)
             actions["slices_created"] += 1
+        # PGs that got a slice (or vanished) drop their backoff record
+        for pg_hex in list(self._create_backoff):
+            if pg_hex not in pending or pg_hex in self._pg_slices:
+                del self._create_backoff[pg_hex]
 
         # delete: slices whose motivating PG no longer exists
         now = time.monotonic()

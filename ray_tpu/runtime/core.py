@@ -1396,6 +1396,7 @@ class CoreContext:
                            resources: Optional[dict] = None,
                            max_restarts: int = 0,
                            max_concurrency: int = 1,
+                           concurrency_groups: Optional[dict] = None,
                            pg: Optional[tuple] = None,
                            scheduling: Optional[dict] = None,
                            lifetime: Optional[str] = None,
@@ -1409,6 +1410,8 @@ class CoreContext:
         creation_spec = cloudpickle.dumps({
             "cls": cls, "args": args, "kwargs": kwargs,
             "max_concurrency": max_concurrency,
+            "concurrency_groups": dict(concurrency_groups)
+            if concurrency_groups else None,
             "actor_id": actor_id,
         }, protocol=5)
         r = await self.pool.call(

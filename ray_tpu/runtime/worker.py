@@ -53,12 +53,31 @@ class _BatchError:
 
 
 class _HostedActor:
-    def __init__(self, instance, max_concurrency: int):
+    def __init__(self, instance, max_concurrency: int,
+                 concurrency_groups: Optional[dict] = None):
         self.instance = instance
         self.max_concurrency = max_concurrency
-        self.lock = asyncio.Lock() if max_concurrency == 1 else None
         self.executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=max_concurrency)
+        # Named concurrency groups (reference: core_worker/
+        # task_execution/concurrency_group_manager.h + the
+        # concurrency_groups actor option): each named group bounds its
+        # methods with its own semaphore + thread pool, so e.g. an "io"
+        # group keeps serving health checks while "compute" is
+        # saturated. Declaring groups implies a concurrent actor — the
+        # serialized-execution lock applies only to group-less actors
+        # with max_concurrency == 1.
+        self.groups: Dict[str, tuple] = {}
+        if concurrency_groups:
+            for name, n in concurrency_groups.items():
+                n = max(1, int(n))
+                self.groups[name] = (
+                    asyncio.Semaphore(n),
+                    concurrent.futures.ThreadPoolExecutor(max_workers=n))
+            self.groups.setdefault("_default", (
+                asyncio.Semaphore(max_concurrency), self.executor))
+        self.lock = (asyncio.Lock()
+                     if max_concurrency == 1 and not self.groups else None)
 
 
 class WorkerExecutor:
@@ -448,7 +467,8 @@ class WorkerExecutor:
             except (AttributeError, TypeError):
                 pass  # __slots__ etc.
             self.actors[actor_id] = _HostedActor(
-                instance, spec.get("max_concurrency", 1))
+                instance, spec.get("max_concurrency", 1),
+                spec.get("concurrency_groups"))
             return {"ok": True}
         except BaseException as e:  # noqa: BLE001
             import traceback
@@ -473,6 +493,18 @@ class WorkerExecutor:
             if stream_id is not None:
                 args, kwargs = await self._resolve_args(args_frame)
                 fn = getattr(hosted.instance, method)
+                # Concurrency-grouped actors: the stream counts against
+                # its group's limit for its WHOLE lifetime (a streaming
+                # call is still one call of that group).
+                if hosted.groups:
+                    grp = getattr(fn, "_method_opts", {}).get(
+                        "concurrency_group")
+                    sem, pool = hosted.groups.get(
+                        grp or "_default", hosted.groups["_default"])
+                    async with sem:
+                        return await self._drive_stream(
+                            fn, args, kwargs, stream_id, owner_addr,
+                            pool)
                 # Sync generators on a serialized (max_concurrency==1)
                 # actor hold the actor lock for the whole stream — the
                 # stream IS the call. Async generators interleave on the
@@ -497,7 +529,15 @@ class WorkerExecutor:
                 fn = partial(exec_loop, hosted.instance)
             else:
                 fn = getattr(hosted.instance, method)
-            if hosted.lock is not None and not \
+            if hosted.groups:
+                grp = getattr(fn, "_method_opts", {}).get(
+                    "concurrency_group")
+                sem, pool = hosted.groups.get(
+                    grp or "_default", hosted.groups["_default"])
+                async with sem:
+                    value = await self._run_callable(
+                        fn, args, kwargs, pool)
+            elif hosted.lock is not None and not \
                     inspect.iscoroutinefunction(fn):
                 async with hosted.lock:
                     value = await self._run_callable(
@@ -539,7 +579,8 @@ class WorkerExecutor:
                        and not inspect.iscoroutinefunction(m)
                        and not inspect.isgeneratorfunction(m)
                        for m in methods) and \
-            not any(c.get("stream_id") for c in calls)
+            not any(c.get("stream_id") for c in calls) and \
+            not hosted.groups  # grouped calls dispatch per-group
         if all_sync and hosted.lock is not None:
             resolved = []
             for c in calls:

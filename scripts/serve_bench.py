@@ -6,10 +6,12 @@ router -> replica -> continuous-batching engine on the chip. TTFT is
 measured at the CLIENT: time from request start to the first SSE data
 event.
 
-Run: PYTHONPATH=. python scripts/serve_bench.py [--requests N]
-Prints one JSON line (commit to SERVE_BENCH.json). On tunneled-TPU dev
-boxes both TTFT and tok/s are tunnel-RTT-bound (~120ms/sync) — see the
-caveat field.
+Run from the repo root: python scripts/serve_bench.py [--requests N]
+(do NOT export PYTHONPATH — with it set, spawned TPU workers hang
+before jax init on tunneled dev boxes; the script sys.path-inserts the
+cwd itself). Prints one JSON line per run plus an aggregate (commit to
+SERVE_BENCH.json). On tunneled-TPU dev boxes both TTFT and tok/s are
+tunnel-RTT-bound (~120ms/sync) — see the caveat field.
 
 Reference harness shape: release/llm_tests/serve/ (vLLM serve benchmark
 drives the HTTP endpoint and reports TTFT percentiles).
@@ -60,12 +62,15 @@ def _one_request(addr, prompt, max_new, out, idx):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="bench340m")
-    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=100)
+    ap.add_argument("--runs", type=int, default=3)
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--prompt-len", type=int, default=48)
     ap.add_argument("--max-new", type=int, default=64)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--steps-per-sync", type=int, default=8)
+    ap.add_argument("--long-prompt-len", type=int, default=2048)
+    ap.add_argument("--long-requests", type=int, default=12)
     args = ap.parse_args()
 
     import jax
@@ -91,8 +96,9 @@ def main():
     try:
         cfg = LLMConfig(
             model=model, model_overrides=overrides,
-            max_slots=args.slots, max_len=1024,
-            prefill_buckets=(64, 256),
+            max_slots=args.slots,
+            max_len=max(1024, args.long_prompt_len + args.max_new + 64),
+            prefill_buckets=(64, 256, 1024, 2048),
             steps_per_sync=args.steps_per_sync)
         serve.run(build_llm_deployment(cfg, name="bench"),
                   name="bench_app", route_prefix="/bench",
@@ -116,45 +122,68 @@ def main():
         warm = {}
         _one_request(addr, [1, 2, 3], args.steps_per_sync + 1, warm, 0)
 
-        rng = np.random.default_rng(0)
-        prompts = [
-            [int(x) for x in rng.integers(1, 31999,
-                                          size=args.prompt_len)]
-            for _ in range(args.requests)]
-        results = [None] * args.requests
-        t0 = time.monotonic()
-        cursor = 0
-        while cursor < args.requests:
-            batch = range(cursor,
-                          min(cursor + args.concurrency, args.requests))
-            threads = [
-                threading.Thread(target=_one_request,
-                                 args=(addr, prompts[i], args.max_new,
-                                       results, i))
-                for i in batch]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            cursor += args.concurrency
-        wall = time.monotonic() - t0
+        def sweep(n_requests, prompt_len, concurrency, seed):
+            rng = np.random.default_rng(seed)
+            prompts = [
+                [int(x) for x in rng.integers(1, 31999,
+                                              size=prompt_len)]
+                for _ in range(n_requests)]
+            results = [None] * n_requests
+            t0 = time.monotonic()
+            cursor = 0
+            while cursor < n_requests:
+                batch = range(cursor,
+                              min(cursor + concurrency, n_requests))
+                threads = [
+                    threading.Thread(
+                        target=_one_request,
+                        args=(addr, prompts[i], args.max_new,
+                              results, i))
+                    for i in batch]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                cursor += concurrency
+            wall = time.monotonic() - t0
+            ttfts = sorted(r["ttft_s"] for r in results
+                           if r and r["ttft_s"] is not None)
+            toks = sum(r["tokens"] for r in results if r)
+            assert ttfts and toks, results[:3]
 
-        ttfts = sorted(r["ttft_s"] for r in results
-                       if r and r["ttft_s"] is not None)
-        toks = sum(r["tokens"] for r in results if r)
-        assert ttfts and toks, results[:3]
+            def p(q):
+                return ttfts[min(len(ttfts) - 1, int(q * len(ttfts)))]
+
+            return {"ttft_p50_ms": round(p(0.50) * 1000, 1),
+                    "ttft_p95_ms": round(p(0.95) * 1000, 1),
+                    "ttft_p99_ms": round(p(0.99) * 1000, 1),
+                    "ttft_max_ms": round(ttfts[-1] * 1000, 1),
+                    "throughput_tok_s": round(toks / wall, 1),
+                    "requests": n_requests, "prompt_len": prompt_len,
+                    "concurrency": concurrency}
+
+        runs = []
+        for r in range(args.runs):
+            res = sweep(args.requests, args.prompt_len,
+                        args.concurrency, seed=r)
+            runs.append(res)
+            print(json.dumps({"run": r, **res}), flush=True)
+
+        # long-prompt row: chunked prefill under load
+        long_row = None
+        if args.long_requests > 0:
+            long_row = sweep(args.long_requests, args.long_prompt_len,
+                             min(4, args.concurrency),
+                             seed=args.runs)
+            print(json.dumps({"run": "long", **long_row}), flush=True)
+
         dev = jax.devices()[0]
-        p = lambda q: ttfts[min(len(ttfts) - 1,  # noqa: E731
-                                int(q * len(ttfts)))]
+        p50s = sorted(r["ttft_p50_ms"] for r in runs)
         print(json.dumps({
             "metric": "llm_serve_ttft_p50",
-            "value": round(p(0.50) * 1000, 1), "unit": "ms",
-            "ttft_p95_ms": round(p(0.95) * 1000, 1),
-            "ttft_max_ms": round(ttfts[-1] * 1000, 1),
-            "throughput_tok_s": round(toks / wall, 1),
-            "requests": args.requests,
-            "concurrency": args.concurrency,
-            "prompt_len": args.prompt_len, "max_new": args.max_new,
+            "value": p50s[len(p50s) // 2], "unit": "ms",
+            "runs": runs, "long_prompt": long_row,
+            "max_new": args.max_new,
             "slots": args.slots, "steps_per_sync": args.steps_per_sync,
             "path": "client->HTTP proxy (SSE)->router->replica->engine",
             "device": getattr(dev, "device_kind", str(dev)),

@@ -150,3 +150,76 @@ def _swallow(fn, *a, **kw):
         fn(*a, **kw)
     except Exception:
         pass   # the reservation is deliberately aborted by remove_pg
+
+
+def test_client_retries_transient_statuses():
+    """Two 429s then success: the client's quick retries absorb the
+    blip without surfacing an error."""
+    api = FakeTPUApi()
+    fails = {"n": 0}
+
+    def flaky(method, url, body):
+        if method == "POST" and fails["n"] < 2:
+            fails["n"] += 1
+            return 429, {"error": "rate limited"}
+        return api.request(method, url, body)
+
+    c = GCPClient("proj", "us-central2-b", request=flaky)
+    c.create_queued_resource("qr-1", {"acceleratorType": "v5litepod-8"})
+    assert fails["n"] == 2
+    assert "qr-1" in api.resources
+
+
+def test_reconciler_backs_off_on_sustained_quota_errors():
+    """Sustained 429s: reconcile does not raise, records the error,
+    and does NOT hammer the API every pass — the next create attempt
+    waits out the per-PG backoff window (weak #9: a transient 429 must
+    not be indistinguishable from a permanent failure)."""
+    import asyncio
+
+    api = FakeTPUApi()
+    posts = {"n": 0}
+
+    def quota_limited(method, url, body):
+        if method == "POST":
+            posts["n"] += 1
+            return 429, {"error": {"status": "RESOURCE_EXHAUSTED"}}
+        return api.request(method, url, body)
+
+    client = GCPClient("proj", "us-central2-b", request=quota_limited)
+    provider = TPUQueuedResourceProvider(client, "head:1")
+    ray_tpu.init(num_cpus=1)
+    try:
+        scaler = TPUSliceAutoscaler(
+            f"{ray_tpu.api._g.ctx.head_addr[0]}:"
+            f"{ray_tpu.api._g.ctx.head_addr[1]}",
+            provider, SliceScalerConfig(generation="v5e"))
+        # fake a pending all-TPU gang by monkeypatching the PG listing
+        pgs = [{"pg_id": b"\x01" * 14, "state": "PENDING",
+                "bundles": [{"TPU": 4.0}, {"TPU": 4.0}]}]
+
+        async def fake_call(addr, method, **kw):
+            if method == "list_pgs":
+                return pgs
+            return await type(scaler.pool).call(
+                scaler.pool, addr, method, **kw)
+
+        scaler.pool.call = fake_call
+        a1 = asyncio.run(scaler._reconcile_slices())
+        assert a1["slice_create_errors"] == 1
+        assert "429" in a1["slice_create_last_error"]
+        n_after_first = posts["n"]          # 1 attempt x 3 client tries
+        assert n_after_first == 3
+        # immediate re-reconcile: inside the backoff window, no new POST
+        a2 = asyncio.run(scaler._reconcile_slices())
+        assert posts["n"] == n_after_first
+        assert a2["slice_create_errors"] == 0
+        # after the window, it tries again
+        (pg_key,) = scaler._create_backoff
+        _next_try, delay = scaler._create_backoff[pg_key]
+        scaler._create_backoff[pg_key] = (0.0, delay)  # expire window
+        a3 = asyncio.run(scaler._reconcile_slices())
+        assert posts["n"] == n_after_first + 3
+        assert a3["slice_create_errors"] == 1
+    finally:
+        ray_tpu.shutdown()
