@@ -543,7 +543,8 @@ class ActorMethod:
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=num_returns,
             max_task_retries=self._opts.get(
-                "max_task_retries", self._handle._max_task_retries))
+                "max_task_retries", self._handle._max_task_retries),
+            concurrency_group=self._opts.get("concurrency_group"))
         if num_returns == "streaming":
             return ObjectRefGenerator(refs)  # refs IS the stream id
         return refs[0] if num_returns == 1 else refs

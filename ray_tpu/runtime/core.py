@@ -1447,7 +1447,9 @@ class CoreContext:
     def submit_actor_call_sync(self, actor_id: ActorID, method: str,
                                args: tuple, kwargs: dict,
                                num_returns: int = 1,
-                               max_task_retries: int = 0) -> List[ObjectRef]:
+                               max_task_retries: int = 0,
+                               concurrency_group: Optional[str] = None
+                               ) -> List[ObjectRef]:
         """Thread-safe actor-call submission (see submit_task_sync)."""
         streaming = num_returns == "streaming"
         stream_id = None
@@ -1464,7 +1466,7 @@ class CoreContext:
         args_frame = dumps_oob((args, kwargs))
         self._stage_put(self._enqueue_actor_call, actor_id,
                         (method, args_frame, oids, max_task_retries, 0,
-                         stream_id))
+                         stream_id, concurrency_group))
         return stream_id if streaming else refs
 
     async def submit_actor_call(self, actor_id: ActorID, method: str,
@@ -1541,19 +1543,21 @@ class CoreContext:
 
     async def _drive_actor_batch(self, actor_id: ActorID, batch: list):
         if len(batch) == 1:
-            method, args_frame, oids, retries, _att, stream_id = batch[0]
+            (method, args_frame, oids, retries, _att, stream_id,
+             cgroup) = batch[0]
             await self._drive_actor_call(
-                actor_id, method, args_frame, oids, retries, stream_id)
+                actor_id, method, args_frame, oids, retries, stream_id,
+                cgroup)
             return
         calls = [{"method": m, "args_frame": af, "return_oids": oids,
-                  "stream_id": sid}
-                 for (m, af, oids, _r, _a, sid) in batch]
+                  "stream_id": sid, "concurrency_group": cg}
+                 for (m, af, oids, _r, _a, sid, cg) in batch]
         try:
             addr = await self.resolve_actor_addr(actor_id)
             r = await self.pool.call(
                 addr, "actor_call_batch", actor_id=actor_id,
                 calls=calls, owner_addr=self.addr, timeout=None)
-            for res, (_m, _af, oids, _r2, _a, _s) in zip(
+            for res, (_m, _af, oids, _r2, _a, _s, _c) in zip(
                     r["batch"], batch):
                 self._apply_result(oids, res)
         except (rpc.ConnectionLost, OSError) as e:
@@ -1562,7 +1566,7 @@ class CoreContext:
             # back through the pump individually.
             self._actor_addr_cache.pop(actor_id, None)
             retryable = []
-            for (m, af, oids, retries, attempt, sid) in batch:
+            for (m, af, oids, retries, attempt, sid, cg) in batch:
                 if attempt + 1 > retries:
                     self._fail_all(oids, ActorDiedError(
                         f"actor {actor_id} connection lost: {e}"))
@@ -1571,7 +1575,7 @@ class CoreContext:
                             f"actor {actor_id} connection lost: {e}"))
                 else:
                     retryable.append(
-                        (m, af, oids, retries, attempt + 1, sid))
+                        (m, af, oids, retries, attempt + 1, sid, cg))
             if retryable:
                 await asyncio.sleep(0.2)
                 for call in retryable:
@@ -1579,13 +1583,14 @@ class CoreContext:
         except (rpc.RemoteError, ActorError) as e:
             err = (TaskError(str(e))
                    if isinstance(e, rpc.RemoteError) else e)
-            for (_m, _af, oids, _r2, _a, sid) in batch:
+            for (_m, _af, oids, _r2, _a, sid, _c) in batch:
                 self._fail_all(oids, err)
                 if sid is not None:
                     self.fail_stream(sid, err)
 
     async def _drive_actor_call(self, actor_id, method, args_frame, oids,
-                                retries, stream_id=None):
+                                retries, stream_id=None,
+                                concurrency_group=None):
         attempt = 0
         while True:
             try:
@@ -1594,6 +1599,7 @@ class CoreContext:
                     addr, "actor_call", actor_id=actor_id, method=method,
                     args_frame=args_frame, return_oids=oids,
                     owner_addr=self.addr, stream_id=stream_id,
+                    concurrency_group=concurrency_group,
                     timeout=None)
                 self._apply_result(oids, r)
                 return

@@ -477,7 +477,8 @@ class WorkerExecutor:
 
     async def actor_call(self, actor_id: ActorID, method: str,
                          args_frame: bytes, return_oids: List[ObjectID],
-                         owner_addr, stream_id=None):
+                         owner_addr, stream_id=None,
+                         concurrency_group=None):
         hosted = self.actors.get(actor_id)
         if hosted is None:
             err0 = TaskError(f"actor {actor_id} not hosted here")
@@ -497,8 +498,8 @@ class WorkerExecutor:
                 # its group's limit for its WHOLE lifetime (a streaming
                 # call is still one call of that group).
                 if hosted.groups:
-                    grp = getattr(fn, "_method_opts", {}).get(
-                        "concurrency_group")
+                    grp = concurrency_group or getattr(
+                        fn, "_method_opts", {}).get("concurrency_group")
                     sem, pool = hosted.groups.get(
                         grp or "_default", hosted.groups["_default"])
                     async with sem:
@@ -530,8 +531,10 @@ class WorkerExecutor:
             else:
                 fn = getattr(hosted.instance, method)
             if hosted.groups:
-                grp = getattr(fn, "_method_opts", {}).get(
-                    "concurrency_group")
+                # call-site options(concurrency_group=...) beats the
+                # method-decorator default (reference: .options routing)
+                grp = concurrency_group or getattr(
+                    fn, "_method_opts", {}).get("concurrency_group")
                 sem, pool = hosted.groups.get(
                     grp or "_default", hosted.groups["_default"])
                 async with sem:
@@ -607,7 +610,8 @@ class WorkerExecutor:
         out = await asyncio.gather(*[
             self.actor_call(actor_id, c["method"], c["args_frame"],
                             c["return_oids"], owner_addr,
-                            c.get("stream_id"))
+                            c.get("stream_id"),
+                            c.get("concurrency_group"))
             for c in calls])
         return {"batch": list(out)}
 

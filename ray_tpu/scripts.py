@@ -51,57 +51,102 @@ def _node_files():
                   for f in os.listdir(sd) if f.endswith(".json"))
 
 
-def cmd_start(args) -> int:
+def _node_cmd(info_file: str, *, head: bool, address=None,
+              host: str = "127.0.0.1", port: int = 0, node_host=None,
+              num_cpus=None, resources=None, labels=None,
+              system_config=None, metrics_port=None) -> list:
+    cmd = [sys.executable, "-m", "ray_tpu.node", "--info-file", info_file]
+    if head:
+        cmd += ["--head", "--host", host, "--port", str(port)]
+    else:
+        cmd += ["--address", address]
+    if node_host:
+        cmd += ["--node-host", node_host]
+    if num_cpus is not None:
+        cmd += ["--num-cpus", str(num_cpus)]
+    if resources:
+        cmd += ["--resources",
+                resources if isinstance(resources, str)
+                else json.dumps(resources)]
+    if labels:
+        cmd += ["--labels",
+                labels if isinstance(labels, str) else json.dumps(labels)]
+    if system_config:
+        cmd += ["--system-config", system_config]
+    if metrics_port is not None:
+        cmd += ["--metrics-port", str(metrics_port)]
+    return cmd
+
+
+def start_node(*, head: bool, address=None, host: str = "127.0.0.1",
+               port: int = 0, node_host=None, num_cpus=None,
+               resources=None, labels=None, system_config=None,
+               metrics_port=None, timeout_s: float = 60.0) -> dict:
+    """Spawn one detached ``ray_tpu.node`` process and wait for its
+    info file (the session-dir protocol the whole CLI shares). Returns
+    the node info dict plus ``info_file``/``log_file`` paths. Used by
+    ``ray-tpu start`` AND the cluster launcher (`ray-tpu up`)."""
     sd = session_dir()
     os.makedirs(sd, exist_ok=True)
     info_file = os.path.join(
         sd, f"node-{int(time.time()*1000)}-{os.getpid()}.json")
-    cmd = [sys.executable, "-m", "ray_tpu.node", "--info-file", info_file]
-    if args.head:
-        cmd += ["--head", "--host", args.host, "--port", str(args.port)]
-    else:
-        cmd += ["--address", args.address]
-    if args.node_host:
-        cmd += ["--node-host", args.node_host]
-    if args.num_cpus is not None:
-        cmd += ["--num-cpus", str(args.num_cpus)]
-    if args.resources:
-        cmd += ["--resources", args.resources]
-    if args.labels:
-        cmd += ["--labels", args.labels]
-    if args.system_config:
-        cmd += ["--system-config", args.system_config]
-    if args.metrics_port is not None:
-        cmd += ["--metrics-port", str(args.metrics_port)]
-
-    if args.block:
-        return subprocess.call(cmd)
-
-    log = open(os.path.join(
-        sd, os.path.basename(info_file)[:-5] + ".log"), "ab")
+    cmd = _node_cmd(info_file, head=head, address=address, host=host,
+                    port=port, node_host=node_host, num_cpus=num_cpus,
+                    resources=resources, labels=labels,
+                    system_config=system_config,
+                    metrics_port=metrics_port)
+    log_path = info_file[:-5] + ".log"
+    log = open(log_path, "ab")
     proc = subprocess.Popen(cmd, stdout=log, stderr=log,
                             start_new_session=True)
-    deadline = time.time() + args.start_timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
         if os.path.exists(info_file):
             with open(info_file) as f:
                 info = json.load(f)
-            print(f"node up: address={info['address']} "
-                  f"node_id={info['node_id']} pid={info['pid']}")
-            if args.head:
-                print("connect other nodes with:\n  "
-                      f"ray-tpu start --address={info['address']}\n"
-                      "or from Python:\n  "
-                      f"ray_tpu.init(address=\"{info['address']}\")")
-            return 0
+            info["info_file"] = info_file
+            info["log_file"] = log_path
+            return info
         if proc.poll() is not None:
-            print(f"node process exited rc={proc.returncode}; see "
-                  f"{log.name}", file=sys.stderr)
-            return 1
+            raise RuntimeError(
+                f"node process exited rc={proc.returncode}; "
+                f"see {log_path}")
         time.sleep(0.1)
-    print("timed out waiting for node to come up", file=sys.stderr)
     proc.terminate()
-    return 1
+    raise RuntimeError("timed out waiting for node to come up")
+
+
+def cmd_start(args) -> int:
+    if args.block:
+        sd = session_dir()
+        os.makedirs(sd, exist_ok=True)
+        info_file = os.path.join(
+            sd, f"node-{int(time.time()*1000)}-{os.getpid()}.json")
+        return subprocess.call(_node_cmd(
+            info_file, head=args.head, address=args.address,
+            host=args.host, port=args.port, node_host=args.node_host,
+            num_cpus=args.num_cpus, resources=args.resources,
+            labels=args.labels, system_config=args.system_config,
+            metrics_port=args.metrics_port))
+    try:
+        info = start_node(
+            head=args.head, address=args.address, host=args.host,
+            port=args.port, node_host=args.node_host,
+            num_cpus=args.num_cpus, resources=args.resources,
+            labels=args.labels, system_config=args.system_config,
+            metrics_port=args.metrics_port,
+            timeout_s=args.start_timeout)
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    print(f"node up: address={info['address']} "
+          f"node_id={info['node_id']} pid={info['pid']}")
+    if args.head:
+        print("connect other nodes with:\n  "
+              f"ray-tpu start --address={info['address']}\n"
+              "or from Python:\n  "
+              f"ray_tpu.init(address=\"{info['address']}\")")
+    return 0
 
 
 def cmd_stop(args) -> int:
@@ -141,6 +186,30 @@ def _resolve_address(args) -> str:
               file=sys.stderr)
         raise SystemExit(2)
     return addr
+
+
+def cmd_up(args) -> int:
+    """One-command bring-up (reference: `ray up` —
+    autoscaler/_private/commands.py)."""
+    from ray_tpu import launcher
+    cfg = launcher.load_config(args.config)
+    state = launcher.up(cfg)
+    print(f"cluster {cfg['cluster_name']!r} up: "
+          f"address={state['address']} "
+          f"nodes={len(state['nodes'])} "
+          f"slices={len(state['slice_handles'])}")
+    print(f"connect: ray_tpu.init(address=\"{state['address']}\")")
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu import launcher
+    cfg = launcher.load_config(args.config)
+    errors = launcher.down(cfg)
+    for e in errors:
+        print(f"warning: {e}", file=sys.stderr)
+    print(f"cluster {cfg['cluster_name']!r} down")
+    return 0
 
 
 def cmd_status(args) -> int:
@@ -326,6 +395,17 @@ def main(argv=None) -> int:
     pt = sub.add_parser("stop", help="stop nodes started on this host")
     pt.add_argument("--keep-files", action="store_true")
     pt.set_defaults(fn=cmd_stop)
+
+    pup = sub.add_parser(
+        "up", help="bring up a whole cluster from a YAML config "
+                   "(head + local nodes + cloud TPU slices)")
+    pup.add_argument("config", help="cluster YAML path")
+    pup.set_defaults(fn=cmd_up)
+
+    pdn = sub.add_parser("down",
+                         help="tear down a cluster brought up with `up`")
+    pdn.add_argument("config", help="cluster YAML path")
+    pdn.set_defaults(fn=cmd_down)
 
     pu = sub.add_parser("status", help="cluster resource summary")
     pu.add_argument("--address")

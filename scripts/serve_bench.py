@@ -152,7 +152,11 @@ def main():
             assert ttfts and toks, results[:3]
 
             def p(q):
-                return ttfts[min(len(ttfts) - 1, int(q * len(ttfts)))]
+                # nearest-rank: ceil(q*n)-1 (int(q*n) overshoots by one
+                # — at n=100 it would report p99 as the max sample)
+                import math
+                return ttfts[max(0, min(len(ttfts) - 1,
+                                        math.ceil(q * len(ttfts)) - 1))]
 
             return {"ttft_p50_ms": round(p(0.50) * 1000, 1),
                     "ttft_p95_ms": round(p(0.95) * 1000, 1),

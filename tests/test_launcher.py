@@ -1,0 +1,129 @@
+"""One-command cluster bring-up (reference: `ray up` —
+autoscaler/_private/commands.py create_or_update_cluster)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _write_yaml(path, text):
+    with open(path, "w") as f:
+        f.write(text)
+    return str(path)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_session_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path / "sessions"))
+
+
+def test_up_down_local_cluster(tmp_path):
+    """A YAML with a head + one local worker boots a whole cluster
+    (CLI `ray-tpu up`), a driver joins it by address, and `down` stops
+    every process."""
+    from ray_tpu import launcher
+    cfg = launcher.load_config(_write_yaml(tmp_path / "c.yaml", """
+cluster_name: lttest
+head:
+  num_cpus: 2
+  resources: {headres: 1}
+workers:
+  - num_cpus: 3
+    labels: {zone: b}
+"""))
+    state = launcher.up(cfg)
+    try:
+        assert len(state["nodes"]) == 2
+        ray_tpu.init(address=state["address"], num_cpus=0)
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                res = ray_tpu.cluster_resources()
+                if res.get("CPU", 0) >= 5 and "headres" in res:
+                    break
+                time.sleep(0.2)
+            res = ray_tpu.cluster_resources()
+            assert res.get("CPU", 0) >= 5.0, res
+            assert res.get("headres") == 1.0, res
+
+            @ray_tpu.remote
+            def who():
+                return "ok"
+
+            assert ray_tpu.get(who.remote(), timeout=60) == "ok"
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        errors = launcher.down(cfg)
+        assert not errors, errors
+    # processes are gone
+    time.sleep(1.0)
+    for n in state["nodes"]:
+        with pytest.raises(OSError):
+            os.kill(n["pid"], 0)
+    # double-down errors cleanly
+    with pytest.raises(RuntimeError, match="no recorded state"):
+        launcher.down(cfg)
+
+
+def test_up_creates_cloud_slices_with_join_scripts(tmp_path):
+    """A provider section creates one queued resource per slice whose
+    startup script joins the head; down deletes them."""
+    from ray_tpu import launcher
+    from tests.test_provider_gcp import FakeTPUApi
+    from ray_tpu.providers.gcp import GCPClient
+
+    api = FakeTPUApi()
+    client = GCPClient("proj", "us-central2-b", request=api.request)
+    cfg = launcher.load_config(_write_yaml(tmp_path / "g.yaml", """
+cluster_name: gcptest
+head:
+  num_cpus: 1
+provider:
+  type: gcp
+  project: proj
+  zone: us-central2-b
+  pod_type: v5e-16
+  slices: 2
+"""))
+    state = launcher.up(cfg, gcp_client=client)
+    try:
+        assert len(state["slice_handles"]) == 2
+        assert len(api.resources) == 2
+        for qr in api.resources.values():
+            node = qr["tpu"]["node_spec"][0]["node"]
+            assert node["acceleratorType"] == "v5litepod-16"
+            script = node["metadata"]["startup-script"]
+            assert state["address"] in script
+            assert "ray_tpu.node" in script
+    finally:
+        errors = launcher.down(cfg, gcp_client=client)
+        assert not errors, errors
+    assert api.resources == {}
+
+
+def test_cli_up_down_roundtrip(tmp_path):
+    """The actual CLI entry points."""
+    yaml_path = _write_yaml(tmp_path / "cli.yaml", """
+cluster_name: clitest
+head:
+  num_cpus: 1
+""")
+    env = {**os.environ, "PYTHONPATH": os.getcwd(),
+           "RAY_TPU_SESSION_DIR": str(tmp_path / "sessions")}
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "up", yaml_path],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "clitest" in r.stdout and "address=" in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "down", yaml_path],
+        capture_output=True, text=True, timeout=60, env=env)
+    assert r2.returncode == 0, r2.stderr
+    assert "down" in r2.stdout

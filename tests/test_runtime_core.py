@@ -308,3 +308,13 @@ def test_named_concurrency_groups(cluster):
     assert any(overlap(spans["c0"], x) or overlap(spans["c1"], x)
                for x in ios), "compute blocked the io group"
     assert wall < 1.1, wall  # serialized-everything would be ~1.8s
+
+    # call-site routing: options(concurrency_group=...) overrides the
+    # decorator — an io-annotated call pushed into compute serializes
+    # with compute work
+    refs = [a.compute_op.remote(10),
+            a.io_op.options(concurrency_group="compute").remote(11)]
+    assert sorted(ray_tpu.get(refs, timeout=60)) == [10, 11]
+    spans = ray_tpu.get(a.get_spans.remote(), timeout=30)
+    assert not overlap(spans["c10"], spans["io11"]), \
+        "options(concurrency_group) was ignored"
