@@ -7,7 +7,7 @@ from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.datasource import (from_blocks, from_items, from_numpy,
                                      from_pandas, range,
                                      read_binary_files, read_csv,
-                                     read_images, read_json, read_numpy,
+                                     read_images, read_json, read_numpy, read_sql,
                                      read_parquet, read_text,
                                      read_tfrecord, write_csv,
                                      write_json, write_parquet,
@@ -17,7 +17,7 @@ from ray_tpu.data.iterator import DataIterator
 __all__ = [
     "Dataset", "DataIterator", "from_blocks", "from_items", "from_numpy",
     "from_pandas", "range", "read_binary_files", "read_csv",
-    "read_images", "read_json", "read_numpy",
+    "read_images", "read_json", "read_numpy", "read_sql",
     "read_parquet", "read_text", "read_tfrecord", "write_csv",
     "write_json", "write_parquet", "write_tfrecord",
 ]

@@ -211,6 +211,33 @@ def read_images(paths, *, size: Optional[tuple] = None,
     return _source_ds("read_images", block_fns=[make(p) for p in files])
 
 
+def read_sql(sql: str, connection_factory: Callable[[], Any], *,
+             block_size: int = 4096) -> Dataset:
+    """Rows of a SQL query as blocks (reference: read_api.py read_sql —
+    there over any DBAPI connection; same contract here:
+    ``connection_factory`` returns a DBAPI2 connection, e.g.
+    ``lambda: sqlite3.connect(path)``). The query runs lazily at
+    execution; results stream in ``block_size``-row blocks."""
+    def gen():
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            cols = [d[0] for d in cur.description]
+            while True:
+                rows = cur.fetchmany(block_size)
+                if not rows:
+                    break
+                yield {c: np.asarray([r[i] for r in rows])
+                       for i, c in enumerate(cols)}
+        finally:
+            conn.close()
+
+    def fn():
+        return gen()
+    return _source_ds("read_sql", block_fns=[fn])
+
+
 def read_numpy(paths) -> Dataset:
     files = _expand(paths)
 

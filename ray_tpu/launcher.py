@@ -89,10 +89,8 @@ def up(cfg: dict, *, gcp_client=None) -> dict:
     # Cloud slices must reach the head over the network: with a
     # provider section, loopback can't be the bind host.
     host = head_cfg.get("host", "127.0.0.1")
-    if cfg.get("provider") and host in ("127.0.0.1", "localhost"):
-        import socket
-        host = head_cfg.get("host") or socket.gethostbyname(
-            socket.gethostname())
+    if cfg.get("provider") and not head_cfg.get("host"):
+        host = _routable_host()
     head = start_node(
         head=True, host=host, port=int(head_cfg.get("port", 0)),
         num_cpus=head_cfg.get("num_cpus"),
@@ -115,14 +113,48 @@ def up(cfg: dict, *, gcp_client=None) -> dict:
                 handle = asyncio.run(provider.launch(
                     {}, {"slice_index": str(i)}))
                 state["slice_handles"].append(handle)
-    except BaseException:
-        # partial bring-up must not leak processes/slices
-        _teardown(state, cfg, gcp_client=gcp_client)
+    except BaseException as boot_err:
+        # partial bring-up must not leak processes/slices; anything the
+        # rollback could NOT clean (a slice whose delete failed) is
+        # persisted so a later `down` can retry with its handle
+        errors = _teardown(state, cfg, gcp_client=gcp_client)
+        if state.get("slice_handles"):
+            state["nodes"] = []
+            os.makedirs(_session_dir(), exist_ok=True)
+            with open(sp, "w") as f:
+                json.dump(state, f, indent=2)
+        if errors:
+            raise RuntimeError(
+                f"cluster bring-up failed ({boot_err}); rollback left: "
+                + "; ".join(errors)) from boot_err
         raise
     os.makedirs(_session_dir(), exist_ok=True)
     with open(sp, "w") as f:
         json.dump(state, f, indent=2)
     return state
+
+
+def _routable_host() -> str:
+    """A non-loopback address cloud slices can dial. gethostname
+    resolution is NOT enough (Debian maps it to 127.0.1.1); the
+    UDP-connect trick reads the address of the default route. No
+    routable address at all is a hard error — slices joining loopback
+    would silently never form a cluster."""
+    import socket
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))   # no packets sent (UDP)
+            addr = s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        addr = ""
+    if not addr or addr.startswith("127."):
+        raise ValueError(
+            "cannot auto-detect a routable head address for cloud "
+            "slices to join; set head.host in the cluster YAML")
+    return addr
 
 
 def _pid_alive(pid: int) -> bool:
@@ -141,18 +173,25 @@ def _pid_alive(pid: int) -> bool:
 def _teardown(state: dict, cfg: Optional[dict],
               gcp_client=None) -> List[str]:
     errors: List[str] = []
-    remaining_slices: List[str] = []
-    if state.get("slice_handles") and cfg and cfg.get("provider"):
-        import asyncio
-        provider = _slice_provider(cfg, state.get("address", ""),
-                                   gcp_client)
-        for h in state["slice_handles"]:
-            try:
-                asyncio.run(provider.terminate(h))
-            except Exception as e:  # noqa: BLE001 — collect, keep going
-                errors.append(f"slice {h}: {e}")
-                remaining_slices.append(h)
-    state["slice_handles"] = remaining_slices
+    if state.get("slice_handles"):
+        if not (cfg and cfg.get("provider")):
+            # wiping handles we cannot terminate would orphan
+            # still-billing slices — keep them and surface it
+            errors.append(
+                "state records cloud slices but the config has no "
+                "provider section; restore it and re-run down")
+        else:
+            import asyncio
+            provider = _slice_provider(cfg, state.get("address", ""),
+                                       gcp_client)
+            remaining: List[str] = []
+            for h in state["slice_handles"]:
+                try:
+                    asyncio.run(provider.terminate(h))
+                except Exception as e:  # noqa: BLE001 — keep going
+                    errors.append(f"slice {h}: {e}")
+                    remaining.append(h)
+            state["slice_handles"] = remaining
     import signal
     nodes = list(reversed(state.get("nodes") or []))  # workers first
     for n in nodes:
@@ -160,26 +199,28 @@ def _teardown(state: dict, cfg: Optional[dict],
             os.killpg(os.getpgid(n["pid"]), signal.SIGTERM)
         except (OSError, ProcessLookupError):
             pass  # already gone
-    # Reap our children (zombies would keep `kill -0` succeeding) and
-    # escalate to SIGKILL for anything that outlives the grace window.
+    # Grace window: poll liveness (works whether or not the nodes are
+    # OUR children — `down` usually runs in a different process than
+    # `up`); reap children opportunistically so zombies don't read as
+    # alive; escalate to SIGKILL past the window.
     deadline = time.monotonic() + 10.0
-    for n in nodes:
-        while time.monotonic() < deadline:
+    pending = {n["pid"] for n in nodes}
+    while pending and time.monotonic() < deadline:
+        for pid in list(pending):
             try:
-                pid, _status = os.waitpid(n["pid"], os.WNOHANG)
+                os.waitpid(pid, os.WNOHANG)
             except ChildProcessError:
-                break               # not our child / already reaped
-            if pid:
-                break
+                pass                # not our child: liveness poll only
+            if not _pid_alive(pid):
+                pending.discard(pid)
+        if pending:
             time.sleep(0.1)
-    for n in nodes:
-        if _pid_alive(n["pid"]):
-            try:
-                os.killpg(os.getpgid(n["pid"]), signal.SIGKILL)
-                errors.append(
-                    f"node pid {n['pid']} ignored SIGTERM; killed")
-            except (OSError, ProcessLookupError):
-                pass
+    for pid in pending:
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGKILL)
+            errors.append(f"node pid {pid} ignored SIGTERM; killed")
+        except (OSError, ProcessLookupError):
+            pass
     # Drop the per-node session records: the rest of the CLI
     # (`ray-tpu status` default address, `stop`) trusts them, and a
     # dead cluster's files would point it at gone pids/ports.

@@ -1,0 +1,138 @@
+"""Behavior cloning: offline RL from a logged transition dataset.
+
+The offline column of the reference's algorithm matrix (reference:
+python/ray/rllib/algorithms/bc/bc.py — learn a policy by supervised
+imitation of a logged dataset, evaluated by rolling the cloned policy
+in the env). TPU-idiomatic like the other learners: the dataset rides
+ray_tpu.data (any reader — parquet, tfrecord, from_items), and the
+whole K-minibatch cross-entropy update runs as ONE jitted ``lax.scan``
+per train iteration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.ppo import init_policy, policy_forward
+
+
+@partial(jax.jit, static_argnames=("lr",))
+def bc_update(params, opt_state, batches, *, lr=1e-3):
+    """Cross-entropy imitation over a stack of minibatches in one
+    lax.scan. batches: {"obs": (K, B, O), "actions": (K, B)}."""
+    import jax.numpy as jnp
+    import optax
+
+    opt = optax.adam(lr)
+
+    def loss_fn(p, mb):
+        logits, _v = policy_forward(p, mb["obs"])
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(
+            logp, mb["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+        return nll.mean()
+
+    def step(carry, mb):
+        p, os_ = carry
+        l, g = jax.value_and_grad(loss_fn)(p, mb)
+        updates, os_ = opt.update(g, os_, p)
+        p = optax.apply_updates(p, updates)
+        return (p, os_), l
+
+    (params, opt_state), losses = jax.lax.scan(
+        step, (params, opt_state), batches)
+    return params, opt_state, losses.mean()
+
+
+@dataclass
+class BCConfig:
+    env: str = "CartPole-v1"          # for evaluation rollouts
+    batch_size: int = 256
+    updates_per_iter: int = 32
+    lr: float = 1e-3
+    hidden: tuple = (64, 64)
+    eval_episodes: int = 8
+    seed: int = 0
+
+
+class BC:
+    """``BC(dataset, config).train()`` — dataset is a ray_tpu.data
+    Dataset (or any iterable of blocks) with ``obs`` (row-major float)
+    and ``action`` (int) columns."""
+
+    def __init__(self, dataset, config: Optional[BCConfig] = None):
+        import optax
+        self.cfg = config or BCConfig()
+        env = make_env(self.cfg.env, 1, 0)
+        self.obs_dim, self.n_actions = env.OBS_DIM, env.N_ACTIONS
+        # materialize the logged data once (offline training data is
+        # bounded; the reference's BC reads it through ray.data too)
+        obs, act = [], []
+        seen_cols = set()
+        for b in dataset.iter_blocks():
+            seen_cols.update(b.keys())
+            if len(b.get("action", ())):
+                obs.append(np.asarray(b["obs"], np.float32))
+                act.append(np.asarray(b["action"], np.int64))
+        if not obs:
+            raise ValueError(
+                "BC needs a dataset with 'obs' and 'action' columns; "
+                f"got columns {sorted(seen_cols) or '(no rows)'}")
+        self._obs = np.concatenate(obs)
+        self._act = np.concatenate(act)
+        if self._obs.shape[1] != self.obs_dim:
+            raise ValueError(
+                f"dataset obs dim {self._obs.shape[1]} != env obs dim "
+                f"{self.obs_dim}")
+        self.params = init_policy(
+            jax.random.PRNGKey(self.cfg.seed), self.obs_dim,
+            self.n_actions, self.cfg.hidden)
+        self.opt_state = optax.adam(self.cfg.lr).init(self.params)
+        self._rng = np.random.default_rng(self.cfg.seed)
+        self._fwd = jax.jit(policy_forward)
+        self._iter = 0
+
+    def train(self) -> dict:
+        import jax.numpy as jnp
+        c = self.cfg
+        self._iter += 1
+        n = len(self._obs)
+        ids = self._rng.integers(0, n, size=(c.updates_per_iter,
+                                             c.batch_size))
+        batches = {"obs": jnp.asarray(self._obs[ids]),
+                   "actions": jnp.asarray(self._act[ids])}
+        self.params, self.opt_state, loss = bc_update(
+            self.params, self.opt_state, batches, lr=c.lr)
+        ret = self.evaluate(c.eval_episodes)
+        return {"training_iteration": self._iter,
+                "loss": float(loss),
+                "episode_reward_mean": ret,
+                "dataset_size": n}
+
+    def evaluate(self, episodes: int) -> float:
+        """Greedy rollouts of the cloned policy."""
+        env = make_env(self.cfg.env, episodes, self.cfg.seed + 7)
+        obs = env.reset_all()
+        done_ret = []
+        ep_ret = np.zeros(episodes, np.float32)
+        for _ in range(env.MAX_STEPS + 1):
+            logits, _v = self._fwd(self.params, obs)
+            a = np.asarray(logits).argmax(axis=1).astype(np.int32)
+            obs, r, done = env.step(a)
+            ep_ret += r
+            if done.any():
+                for i in np.where(done)[0]:
+                    done_ret.append(float(ep_ret[i]))
+                    ep_ret[i] = 0.0
+            if len(done_ret) >= episodes:
+                break
+        return float(np.mean(done_ret)) if done_ret else 0.0
+
+    def get_policy_params(self):
+        return jax.device_get(self.params)

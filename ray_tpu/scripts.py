@@ -96,9 +96,11 @@ def start_node(*, head: bool, address=None, host: str = "127.0.0.1",
                     system_config=system_config,
                     metrics_port=metrics_port)
     log_path = info_file[:-5] + ".log"
-    log = open(log_path, "ab")
-    proc = subprocess.Popen(cmd, stdout=log, stderr=log,
-                            start_new_session=True)
+    with open(log_path, "ab") as log:
+        # the child holds its own copies of the fd; keeping ours open
+        # would leak one per node in long-lived callers (launcher.up)
+        proc = subprocess.Popen(cmd, stdout=log, stderr=log,
+                                start_new_session=True)
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         if os.path.exists(info_file):

@@ -414,3 +414,23 @@ def test_read_images_recurses_subfolders(tmp_path):
     rows = ds.take_all()
     assert len(rows) == 2
     assert {r["path"].split("/")[-2] for r in rows} == {"cat", "dog"}
+
+
+def test_read_sql_sqlite(tmp_path):
+    """read_sql over any DBAPI connection (stdlib sqlite3 here);
+    streams query results in row blocks (reference: read_api.py
+    read_sql)."""
+    import sqlite3
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE m (id INTEGER, score REAL, name TEXT)")
+    conn.executemany("INSERT INTO m VALUES (?, ?, ?)",
+                     [(i, i * 0.5, f"n{i}") for i in range(500)])
+    conn.commit()
+    conn.close()
+    ds = rd.read_sql("SELECT id, score FROM m WHERE id >= 100",
+                     lambda: sqlite3.connect(db), block_size=128)
+    assert ds.count() == 400
+    assert ds.sum("id") == sum(range(100, 500))
+    first = ds.take(1)[0]
+    assert first == {"id": 100, "score": 50.0}
