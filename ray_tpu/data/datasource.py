@@ -233,9 +233,8 @@ def read_sql(sql: str, connection_factory: Callable[[], Any], *,
         finally:
             conn.close()
 
-    def fn():
-        return gen()
-    return _source_ds("read_sql", block_fns=[fn])
+    # the source executor accepts callables returning block iterators
+    return _source_ds("read_sql", block_fns=[gen])
 
 
 def read_numpy(paths) -> Dataset:

@@ -77,7 +77,7 @@ class BC:
         seen_cols = set()
         for b in dataset.iter_blocks():
             seen_cols.update(b.keys())
-            if len(b.get("action", ())):
+            if len(b.get("action", ())) and "obs" in b:
                 obs.append(np.asarray(b["obs"], np.float32))
                 act.append(np.asarray(b["action"], np.int64))
         if not obs:

@@ -11,11 +11,12 @@ train_fn as mesh axes (ray_tpu.parallel), not as framework protocols.
 from ray_tpu.train.api import (Checkpoint, CheckpointConfig, FailureConfig,
                                Result, RunConfig, ScalingConfig,
                                get_context, report)
-from ray_tpu.train.trainer import (JaxTrainer, TorchTrainer,
+from ray_tpu.train.trainer import (JaxTrainer, SklearnTrainer,
+                                   TorchTrainer,
                                    get_controller)
 
 __all__ = [
     "Checkpoint", "CheckpointConfig", "FailureConfig", "Result",
-    "RunConfig", "ScalingConfig", "get_context", "report",
+    "RunConfig", "ScalingConfig", "SklearnTrainer", "get_context", "report",
     "JaxTrainer", "TorchTrainer", "get_controller",
 ]
