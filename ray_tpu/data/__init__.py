@@ -9,7 +9,8 @@ from ray_tpu.data.datasource import (from_blocks, from_items, from_numpy,
                                      read_binary_files, read_csv,
                                      read_images, read_json, read_numpy, read_sql,
                                      read_parquet, read_text,
-                                     read_tfrecord, write_csv,
+                                     read_tfrecord, read_webdataset,
+                                     write_csv,
                                      write_json, write_parquet,
                                      write_tfrecord)
 from ray_tpu.data.iterator import DataIterator
@@ -18,6 +19,7 @@ __all__ = [
     "Dataset", "DataIterator", "from_blocks", "from_items", "from_numpy",
     "from_pandas", "range", "read_binary_files", "read_csv",
     "read_images", "read_json", "read_numpy", "read_sql",
-    "read_parquet", "read_text", "read_tfrecord", "write_csv",
+    "read_parquet", "read_text", "read_tfrecord", "read_webdataset",
+    "write_csv",
     "write_json", "write_parquet", "write_tfrecord",
 ]

@@ -211,6 +211,59 @@ def read_images(paths, *, size: Optional[tuple] = None,
     return _source_ds("read_images", block_fns=[make(p) for p in files])
 
 
+def read_webdataset(paths, *, include_keys: bool = False,
+                    columns: Optional[List[str]] = None) -> Dataset:
+    """WebDataset-style tar shards: members grouped by their path minus
+    extension (WebDataset semantics: ``a/0001.jpg`` + ``a/0001.cls``
+    form sample ``a/0001``), one ROW per sample with one column per
+    extension (reference: read_api.py read_webdataset — there via the
+    webdataset package; here a stdlib tarfile codec). Decode with
+    map/map_batches (e.g. PIL for images, int(...) for labels).
+
+    One block per shard; directories walk recursively but only
+    ``.tar``/``.tar.gz``/``.tgz`` members are read (published sets ship
+    index/README sidecars). Shards with DIFFERING extension sets yield
+    ragged schemas — pass ``columns`` to pin the schema (missing
+    payloads become None) when shards are heterogeneous."""
+    import tarfile
+    files = [p for p in _expand_files(paths)
+             if p.endswith((".tar", ".tar.gz", ".tgz"))]
+
+    def make(path):
+        def fn():
+            rows = []
+            cur_key, cur = None, {}
+            with tarfile.open(path) as tf:
+                for m in tf:
+                    if not m.isfile():
+                        continue
+                    dirpart, base = os.path.split(m.name)
+                    if "." not in base:
+                        continue
+                    stem, ext = base.split(".", 1)
+                    key = os.path.join(dirpart, stem) if dirpart else stem
+                    if key != cur_key:
+                        if cur:
+                            rows.append(cur)
+                        cur_key, cur = key, {}
+                        if include_keys:
+                            cur["__key__"] = key
+                    cur[ext] = tf.extractfile(m).read()
+                if cur:
+                    rows.append(cur)
+            keys = (list(columns) + (["__key__"] if include_keys else [])
+                    if columns is not None
+                    else sorted({k for r in rows for k in r}))
+            # object-dtype columns: numpy's S dtype silently strips
+            # trailing NUL bytes from binary payloads
+            return {k: np.asarray([r.get(k) for r in rows],
+                                  dtype=object)
+                    for k in keys}
+        return fn
+    return _source_ds("read_webdataset",
+                      block_fns=[make(p) for p in files])
+
+
 def read_sql(sql: str, connection_factory: Callable[[], Any], *,
              block_size: int = 4096) -> Dataset:
     """Rows of a SQL query as blocks (reference: read_api.py read_sql —
