@@ -1,0 +1,63 @@
+"""A/B the PD KV handoff: host-staged numpy payload vs device-resident
+TensorRef (same process — the zero-copy path). Run from the repo root
+on the real chip; prints one JSON line per mode."""
+import asyncio
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+
+from ray_tpu.llm.engine import LLMEngine  # noqa: E402
+from ray_tpu.llm.pd import PrefillEngine  # noqa: E402
+from ray_tpu.models import llama  # noqa: E402
+
+
+def main():
+    cfg = llama.LlamaConfig(vocab_size=2048, dim=512, n_layers=4,
+                            n_heads=8, n_kv_heads=4, ffn_dim=1024,
+                            dtype="bfloat16", attn_impl="flash")
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    pre = PrefillEngine(cfg, params, prefill_buckets=(512, 1024, 2048),
+                        max_len=4096, cache_dtype="bfloat16")
+    eng = LLMEngine(cfg, params, max_slots=2, max_len=4096,
+                    prefill_buckets=(512,), cache_dtype="bfloat16",
+                    steps_per_sync=4)
+    rng = np.random.default_rng(0)
+    prompt = [int(x) for x in rng.integers(1, 2047, 2048)]
+
+    async def handoff(device):
+        t0 = time.monotonic()
+        p = pre.prefill(prompt, device=device)
+        t_prefill = time.monotonic() - t0
+        t1 = time.monotonic()
+        out = await eng.generate_prefilled(prompt, p, max_new_tokens=4,
+                                           temperature=0.0)
+        t_admit = time.monotonic() - t1
+        return t_prefill, t_admit, out["tokens"]
+
+    async def bench():
+        # one event loop for everything: the engine's queues bind to
+        # the first loop they run on
+        for device in (False, True):      # warm compiles per mode
+            await handoff(device)
+        for device in (False, True):
+            tp, ta, toks = await handoff(device)
+            kv_mb = (cfg.n_layers * 2048 * cfg.n_kv_heads
+                     * cfg.head_dim * 2 * 2) / 1e6
+            print(json.dumps({
+                "mode": "tensor_ref_device" if device else "host_numpy",
+                "prefill_s": round(tp, 3),
+                "admit_plus_4tok_s": round(ta, 3),
+                "kv_payload_mb": round(kv_mb, 1),
+                "tokens": toks[:4]}), flush=True)
+
+    asyncio.run(bench())
+
+
+if __name__ == "__main__":
+    main()
