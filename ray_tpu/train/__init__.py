@@ -10,13 +10,14 @@ train_fn as mesh axes (ray_tpu.parallel), not as framework protocols.
 
 from ray_tpu.train.api import (Checkpoint, CheckpointConfig, FailureConfig,
                                Result, RunConfig, ScalingConfig,
-                               get_context, report)
+                               ensure_jax_distributed, get_context, report)
 from ray_tpu.train.trainer import (JaxTrainer, SklearnTrainer,
                                    TorchTrainer,
                                    get_controller)
 
 __all__ = [
     "Checkpoint", "CheckpointConfig", "FailureConfig", "Result",
-    "RunConfig", "ScalingConfig", "SklearnTrainer", "get_context", "report",
+    "RunConfig", "ScalingConfig", "SklearnTrainer",
+    "ensure_jax_distributed", "get_context", "report",
     "JaxTrainer", "TorchTrainer", "get_controller",
 ]

@@ -30,6 +30,24 @@ class ScalingConfig:
     # v2/_internal/execution/scaling_policy/elastic.py:29 resize
     # decisions in both directions). 0 disables grow checks.
     elastic_grow_interval_s: float = 5.0
+    # Whether the controller runs jax.distributed.initialize on every
+    # worker before train_fn starts (reference: _JaxBackend.on_start at
+    # v2/jax/config.py:96-124 does this unconditionally). "auto" = only
+    # for multi-worker TPU groups; True forces it (e.g. multi-process CPU
+    # meshes); False leaves bootstrap to the env route / train_fn.
+    jax_distributed: Union[bool, str] = "auto"
+
+    def __post_init__(self):
+        if isinstance(self.jax_distributed, str) and \
+                self.jax_distributed != "auto":
+            raise ValueError(
+                f"jax_distributed must be True, False or 'auto', got "
+                f"{self.jax_distributed!r}")
+
+    def wants_jax_distributed(self) -> bool:
+        if self.jax_distributed == "auto":
+            return self.use_tpu and self.max_workers > 1
+        return bool(self.jax_distributed)
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker:
@@ -274,3 +292,43 @@ def report(metrics: Dict[str, Any],
 
 def get_dataset_shard(name: str = "train"):
     return get_context().get_dataset_shard(name)
+
+
+def jax_distributed_initialized() -> bool:
+    """True once this process has joined a jax.distributed world."""
+    try:
+        from jax._src import distributed as _dist
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:  # noqa: BLE001 — private-API drift: assume not init
+        return False
+
+
+def ensure_jax_distributed() -> bool:
+    """Join the jax.distributed world from the controller-provided env if
+    this process hasn't already (the controller runs the handshake itself
+    for TPU groups — see ScalingConfig.jax_distributed — so a train_fn
+    calling this is a no-op there; on jax_distributed=False groups it is
+    the opt-in bootstrap). Returns True if distributed is active."""
+    if jax_distributed_initialized():
+        return True
+    if not os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        return False
+    missing = [k for k in ("JAX_NUM_PROCESSES", "JAX_PROCESS_ID")
+               if k not in os.environ]
+    if missing:
+        raise RuntimeError(
+            f"JAX_COORDINATOR_ADDRESS is set but {missing} are not — "
+            f"the jax.distributed env route needs all three")
+    import jax
+
+    # The TPU plugin can ignore JAX_PLATFORMS from the env; pin the
+    # platform via the config API before the backend initializes so
+    # CPU-mesh groups (tests, multi-process CPU) stay off the chip.
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    jax.distributed.initialize(
+        coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]))
+    return True

@@ -147,6 +147,21 @@ class TrainController:
                 env["TPU_ACCELERATOR_TYPE"] = self.scaling.topology
             sets.append(w.setup_env.remote(env))
         ray_tpu.get(sets, timeout=60)
+        # Execute the actual multi-process handshake when the group spans
+        # processes: every worker calls jax.distributed.initialize and
+        # blocks until the coordinator (rank 0) has all of them — so the
+        # calls MUST be issued in parallel and rank 0 must be among them
+        # (reference: v2/jax/config.py:96-107 on_start).
+        if self.scaling.wants_jax_distributed():
+            oks = ray_tpu.get(
+                [w.init_jax_distributed.remote() for w in self._workers],
+                timeout=300)
+            if not all(oks):
+                # A False means that worker saw no coordinator env and
+                # silently formed its own 1-process world — wrong world
+                # size with locally-truncated collectives. Fail fast.
+                raise TrainGroupError(
+                    f"jax.distributed bootstrap incomplete: {oks}")
 
     def _recover_latest_checkpoint(self):
         """Restart path: recover the durably-persisted latest checkpoint

@@ -65,14 +65,17 @@ class TrainWorker:
         return True
 
     def init_jax_distributed(self) -> bool:
-        """Explicit jax.distributed.initialize (multi-host path). Only
-        called when the group really spans hosts with local devices."""
-        import jax
-        jax.distributed.initialize(
-            coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
-            num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
-            process_id=int(os.environ["JAX_PROCESS_ID"]))
-        return True
+        """Explicit jax.distributed.initialize (multi-host path): connects
+        this process to the rank-0 coordinator service and blocks until the
+        whole group is present, so afterwards jax.device_count() spans ALL
+        hosts' chips (reference: v2/jax/config.py:96-107 on_start)."""
+        from ray_tpu.train import api as train_api
+
+        # Idempotent: a no-op if the train_fn (or a prior call) already
+        # joined — jax.distributed.initialize raises on double-init. The
+        # helper also pins JAX_PLATFORMS via the config API (the TPU
+        # plugin can ignore the env var).
+        return train_api.ensure_jax_distributed()
 
     def start_train_fn(self, fn_payload: bytes,
                        train_loop_config: Optional[dict],
