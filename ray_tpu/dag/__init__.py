@@ -33,8 +33,8 @@ from ray_tpu.dag.channel import (DATA, ERROR, STOP, ChannelTimeout,
                                  ShmRingChannel)
 from ray_tpu.runtime.serialization import dumps_oob, loads_oob, serialize
 
-__all__ = ["InputNode", "MethodNode", "compile", "CompiledDag",
-           "DagFuture"]
+__all__ = ["InputNode", "MethodNode", "MultiOutputNode", "allreduce",
+           "compile", "CompiledDag", "DagFuture"]
 
 
 class InputNode:
@@ -57,6 +57,61 @@ class MethodNode:
         return compile(self, **kw)
 
 
+class MultiOutputNode:
+    """Gathers several nodes' outputs into one list per executed item
+    (reference: dag/output_node.py MultiOutputNode) — the sink shape for
+    SPMD patterns where every participant's result matters, e.g.
+    ``compile(MultiOutputNode(allreduce([...])))``."""
+
+    def __init__(self, nodes):
+        self.nodes = list(nodes)
+        if not self.nodes:
+            raise ValueError("MultiOutputNode needs at least one node")
+
+
+class AllReduceNode:
+    """Output of one participant in a dag collective. Created only by
+    allreduce(); its value is the elementwise reduction of every
+    participant's parent output for the same item."""
+
+    def __init__(self, parent: MethodNode, group: dict, rank: int):
+        self.parent = parent
+        self.group = group
+        self.rank = rank
+
+
+_REDUCE_OPS = ("sum", "mean", "max", "min")
+
+
+def allreduce(nodes, op: str = "sum"):
+    """Bind an allreduce across DAG actors (reference:
+    dag/collective_node.py:252 + experimental/collective/operations.py —
+    which lower to NCCL; here the collective rides the host object plane:
+    a star reduce over the same placement-aware channels as data edges,
+    shm when co-located, TCP across nodes. Within one process holding a
+    mesh, tensor reductions belong to jit'd psum over ICI, not the DAG).
+
+    Takes one upstream MethodNode per participant actor; returns one
+    AllReduceNode per participant, each carrying the reduced value. The
+    raw parent outputs are consumed by the collective and cannot also be
+    bound elsewhere."""
+    nodes = list(nodes)
+    if len(nodes) < 2:
+        raise ValueError("allreduce needs at least 2 participants")
+    if op not in _REDUCE_OPS:
+        raise ValueError(f"op must be one of {_REDUCE_OPS}, got {op!r}")
+    for n in nodes:
+        if not isinstance(n, MethodNode):
+            raise TypeError(
+                "allreduce participants must be bound method nodes")
+    import uuid as _uuid
+    group = {"id": _uuid.uuid4().hex[:16], "op": op, "size": len(nodes),
+             "members": []}
+    out = [AllReduceNode(n, group, rank) for rank, n in enumerate(nodes)]
+    group["members"] = out
+    return out
+
+
 class DagFuture:
     def __init__(self, dag: "CompiledDag", seq: int):
         self._dag = dag
@@ -67,15 +122,32 @@ class DagFuture:
 
 
 class CompiledDag:
-    def __init__(self, sink: MethodNode, *, nslots: int, slot_bytes: int,
-                 zero_copy: bool = False):
-        if not isinstance(sink, MethodNode):
+    def __init__(self, sink, *, nslots: int, slot_bytes: int,
+                 zero_copy: bool = False, overlap: bool = True,
+                 collective_timeout_s: float = 600.0):
+        self._coll_timeout = collective_timeout_s
+        if isinstance(sink, MultiOutputNode):
+            self._sink_members = list(sink.nodes)
+            self._unwrap_single = False   # 1-member MultiOutput -> [v]
+        elif isinstance(sink, (MethodNode, AllReduceNode)):
+            self._sink_members = [sink]
+            self._unwrap_single = True
+        else:
             raise TypeError("compile() expects the dag's output node")
+        for m in self._sink_members:
+            if not isinstance(m, (MethodNode, AllReduceNode)):
+                raise TypeError(
+                    "MultiOutputNode members must be dag nodes")
         self._nslots = nslots
         self._slot_bytes = slot_bytes
         self._zero_copy = zero_copy
+        self._overlap = overlap and not zero_copy
         self._nodes: List[MethodNode] = []
-        self._topo(sink, set())
+        self._groups: List[dict] = []       # allreduce groups in the dag
+        self._groups_seen = set()
+        seen = set()
+        for m in self._sink_members:
+            self._topo(m, seen)
         self._validate()
         self._channels: List[ShmRingChannel] = []
         # edge channels: producer node -> list of (consumer, arg position)
@@ -83,18 +155,32 @@ class CompiledDag:
         self._templates: Dict[int, list] = {}
         self._out_chans: Dict[int, List[dict]] = {}
         self._input_chans: List[ShmRingChannel] = []
-        self._build(sink)
+        self._sink_chans: List = []
+        self._coll_spec: Dict[int, dict] = {}        # node idx -> role spec
+        self._build()
         self._loops = []
+        self.stage_stats: Optional[List[dict]] = None
         self._start()
         self._next_seq = 0
         self._read_seq = 0
-        self._results: Dict[int, tuple] = {}
+        self._results: Dict[int, list] = {}
+        self._sink_bufs: List[list] = [[] for _ in self._sink_chans]
         self._lock = threading.Lock()
         self._torn_down = False
 
     # --- graph wiring ---------------------------------------------------
 
     def _topo(self, node, seen):
+        if isinstance(node, AllReduceNode):
+            # Reaching ANY participant pulls in the WHOLE group: every
+            # member's parent must run a loop or the collective hangs.
+            g = node.group
+            if g["id"] not in self._groups_seen:
+                self._groups_seen.add(g["id"])
+                self._groups.append(g)
+                for m in g["members"]:
+                    self._topo(m.parent, seen)
+            return
         if id(node) in seen or not isinstance(node, MethodNode):
             return
         seen.add(id(node))
@@ -129,6 +215,24 @@ class CompiledDag:
                                       actor_id=aid))
             self._node_placement.append(
                 (info or {}).get("node_id") or ctx.node_id)
+        # Collective participants: the raw parent output is consumed by
+        # the reduce — binding it elsewhere too would need a second fan-out
+        # edge carrying the UNreduced value, which allreduce() forbids.
+        parents = {}
+        for g in self._groups:
+            for m in g["members"]:
+                if id(m.parent) in parents:
+                    raise ValueError(
+                        "a node cannot participate in two allreduce groups")
+                parents[id(m.parent)] = g["id"]
+        if parents:
+            consumers = [a for n in self._nodes for a in n.args]
+            consumers += self._sink_members
+            for a in consumers:
+                if isinstance(a, MethodNode) and id(a) in parents:
+                    raise ValueError(
+                        "a collective participant's raw output cannot be "
+                        "bound downstream — bind its AllReduceNode instead")
 
     def _local(self, i: Optional[int]) -> bool:
         """True when dag node i (None = the driver) runs on the
@@ -153,7 +257,7 @@ class CompiledDag:
             if producer is None:
                 self._input_chans.append(ch)
             if consumer is None:
-                self._sink_chan = ch
+                self._sink_chans.append(ch)
             return ch.spec()
         if producer is not None and consumer is not None and \
                 self._node_placement[producer] == \
@@ -173,10 +277,10 @@ class CompiledDag:
         if consumer is None:
             ch = TcpChannel(spec, "consumer")  # publishes endpoint now
             self._channels.append(ch)
-            self._sink_chan = ch
+            self._sink_chans.append(ch)
         return spec
 
-    def _build(self, sink: MethodNode):
+    def _build(self):
         idx = {id(n): i for i, n in enumerate(self._nodes)}
         for i, n in enumerate(self._nodes):
             self._in_chans[i] = []
@@ -188,16 +292,45 @@ class CompiledDag:
                     spec = self._new_edge(None, i)
                     self._in_chans[i].append(spec)
                     self._templates[i].append(("chan", None))
-                elif isinstance(a, MethodNode):
-                    spec = self._new_edge(idx[id(a)], i)
-                    self._out_chans[idx[id(a)]].append(spec)
+                elif isinstance(a, (MethodNode, AllReduceNode)):
+                    # An AllReduceNode's value leaves from its PARENT's
+                    # loop (the reduce happens in-loop before writes).
+                    src = idx[id(a.parent)] if isinstance(a, AllReduceNode) \
+                        else idx[id(a)]
+                    spec = self._new_edge(src, i)
+                    self._out_chans[src].append(spec)
                     self._in_chans[i].append(spec)
                     self._templates[i].append(("chan", None))
                 else:
                     self._templates[i].append(("const", dumps_oob(a)))
-        # sink -> driver
-        self._out_chans[idx[id(sink)]].append(
-            self._new_edge(idx[id(sink)], None))
+        # collective star wiring: rank 0 hosts the reduce, every other
+        # participant sends up / receives the reduced value down
+        for g in self._groups:
+            idxs = [idx[id(m.parent)] for m in g["members"]]
+            root = idxs[0]
+            root_spec = {"role": "root", "op": g["op"], "size": g["size"],
+                         "timeout_s": self._coll_timeout,
+                         "up": [], "down": []}
+            for leaf in idxs[1:]:
+                up = self._new_edge(leaf, root)
+                down = self._new_edge(root, leaf)
+                root_spec["up"].append(up)
+                root_spec["down"].append(down)
+                self._coll_spec[leaf] = {"role": "leaf", "op": g["op"],
+                                         "size": g["size"],
+                                         "timeout_s": self._coll_timeout,
+                                         "up": up, "down": down}
+            self._coll_spec[root] = root_spec
+        # sinks -> driver: one channel per member, combined in lockstep
+        seen_sinks = set()
+        for m in self._sink_members:
+            si = idx[id(m.parent)] if isinstance(m, AllReduceNode) \
+                else idx[id(m)]
+            if si in seen_sinks:
+                raise ValueError(
+                    "the same node cannot appear twice in MultiOutputNode")
+            seen_sinks.add(si)
+            self._out_chans[si].append(self._new_edge(si, None))
 
     def _start(self):
         from ray_tpu.api import ActorMethod
@@ -206,7 +339,9 @@ class CompiledDag:
                     "in_channels": self._in_chans[i],
                     "arg_template": self._templates[i],
                     "out_channels": self._out_chans[i],
-                    "zero_copy": self._zero_copy}
+                    "zero_copy": self._zero_copy,
+                    "overlap": self._overlap,
+                    "collective": self._coll_spec.get(i)}
             # retries pinned to 0: a replayed loop would attach a second
             # consumer to SPSC rings and race on the sequence counters.
             m = ActorMethod(n.handle, "__dag_exec_loop__",
@@ -254,17 +389,26 @@ class CompiledDag:
                     ch.flush(0.0)
                 except Exception:
                     pass   # surfaced by the next write/get on that edge
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            try:
-                kind, payload = self._sink_chan.read_bytes(
-                    timeout if blocking else 0.0)
-            except ChannelTimeout:
+            # complete ONE seq: a frame from EVERY sink channel, in
+            # lockstep (partial fills survive a timeout in _sink_bufs)
+            for j, ch in enumerate(self._sink_chans):
+                if self._sink_bufs[j]:
+                    continue
                 if blocking:
-                    raise
-                return
-            if kind == STOP:
+                    left = None if deadline is None else \
+                        max(deadline - time.monotonic(), 0.0)
+                    self._sink_bufs[j].append(ch.read_bytes(left))
+                else:
+                    try:
+                        self._sink_bufs[j].append(ch.read_bytes(0.0))
+                    except ChannelTimeout:
+                        return
+            frames = [buf.pop(0) for buf in self._sink_bufs]
+            if any(k == STOP for k, _ in frames):
                 raise RuntimeError("dag torn down mid-stream")
-            self._results[self._read_seq] = (kind, payload)
+            self._results[self._read_seq] = frames
             self._read_seq += 1
             if blocking:
                 return
@@ -273,12 +417,14 @@ class CompiledDag:
         with self._lock:
             while seq not in self._results:
                 self._pump_sink(blocking=True, timeout=timeout)
-        kind, payload = self._results.pop(seq)
-        if kind == ERROR:
-            err = loads_oob(payload)
-            raise err if isinstance(err, BaseException) else \
-                RuntimeError(str(err))
-        return loads_oob(payload)
+        frames = self._results.pop(seq)
+        for kind, payload in frames:
+            if kind == ERROR:
+                err = loads_oob(payload)
+                raise err if isinstance(err, BaseException) else \
+                    RuntimeError(str(err))
+        vals = [loads_oob(p) for _, p in frames]
+        return vals[0] if self._unwrap_single else vals
 
     def teardown(self, timeout: float = 30.0):
         if self._torn_down:
@@ -287,30 +433,57 @@ class CompiledDag:
         deadline = time.monotonic() + timeout
         from ray_tpu import api
         from ray_tpu.dag.channel import ChannelClosed
-        for ch in self._input_chans:
-            try:
-                ch.write(b"", STOP, timeout=timeout)
-                if hasattr(ch, "flush"):
-                    ch.flush(min(timeout, 5.0))
-            except (ChannelTimeout, ChannelClosed):
-                pass    # stalled or dead stage: the drain below and
-                        # close() still run
-        # Drain the sink until STOP flows out: stages blocked writing
-        # results into a full sink must unblock to ever see the STOP —
-        # otherwise their loops would spin (holding the actor's executor
-        # thread) against channels we are about to unlink.
-        while time.monotonic() < deadline:
-            try:
-                kind, _ = self._sink_chan.read_bytes(timeout=1.0)
-            except ChannelTimeout:
-                continue
-            except ChannelClosed:
-                break     # sink stage died: nothing more will arrive
-            if kind == STOP:
-                break
+        stop_seen = [False] * len(self._sink_chans)
+
+        def _drain_sinks(block_s: float):
+            """Pull whatever sits in the sinks; mark channels whose STOP
+            arrived. Draining is what unwinds a wedged pipeline: stages
+            blocked writing results into a full sink must unblock to
+            ever see the STOP — otherwise their loops would spin
+            (holding the actor's executor thread) against channels we
+            are about to unlink."""
+            for j, ch in enumerate(self._sink_chans):
+                if stop_seen[j]:
+                    continue
+                wait = block_s
+                try:
+                    while True:
+                        kind, _ = ch.read_bytes(wait)
+                        if kind == STOP:
+                            stop_seen[j] = True
+                            break
+                        wait = 0.0   # opportunistic after the first
+                except ChannelTimeout:
+                    pass
+                except ChannelClosed:
+                    stop_seen[j] = True   # stage died: nothing more
+
+        # Phase 1: place STOP on every input edge. A wedged pipeline
+        # (stage blocked writing a full sink -> prefetch queue full ->
+        # reader not consuming -> input ring full) only unwinds if the
+        # sink is drained WHILE trying — never burn the whole budget
+        # blocking on one full input ring.
+        pending_stop = list(self._input_chans)
+        while pending_stop and time.monotonic() < deadline:
+            for ch in list(pending_stop):
+                try:
+                    ch.write(b"", STOP, timeout=0.2)
+                    if hasattr(ch, "flush"):
+                        ch.flush(0.0)
+                    pending_stop.remove(ch)
+                except ChannelTimeout:
+                    pass                      # ring still full: drain more
+                except ChannelClosed:
+                    pending_stop.remove(ch)   # consumer stage is gone
+            _drain_sinks(0.0)
+        # Phase 2: drain until STOP flows out of every sink.
+        while not all(stop_seen) and time.monotonic() < deadline:
+            _drain_sinks(0.5)
         try:
-            api.get(self._loops,
-                    timeout=max(1.0, deadline - time.monotonic()))
+            # Keep the per-stage results: timing/overlap stats
+            # ({processed, timing, items}) readable via stage_stats.
+            self.stage_stats = api.get(
+                self._loops, timeout=max(1.0, deadline - time.monotonic()))
         except Exception:
             pass
         for ch in self._channels:
@@ -324,11 +497,22 @@ class CompiledDag:
             pass
 
 
-def compile(sink: MethodNode, *, nslots: int = 8,
+def compile(sink, *, nslots: int = 8,
             slot_bytes: int = 4 << 20,
-            zero_copy: bool = False) -> CompiledDag:
+            zero_copy: bool = False,
+            overlap: bool = True,
+            collective_timeout_s: float = 600.0) -> CompiledDag:
     """zero_copy=True deserializes single-input stage args directly from
     the ring slot (no copy) — only safe when stage methods do NOT retain
-    references to their array arguments past the call."""
+    references to their array arguments past the call (and disables
+    overlap: the slot window cannot outlive a prefetch).
+
+    overlap=True (default) compiles each stage to an overlapped operation
+    schedule — a reader thread prefetches the NEXT item's inputs while
+    the current item computes (reference: dag/dag_node_operation.py:86
+    compiles per-actor READ/COMPUTE/WRITE schedules for the same reason).
+    Cross-node TCP receives hide under compute; per-item recv/compute
+    spans land in the trace and in CompiledDag.stage_stats."""
     return CompiledDag(sink, nslots=nslots, slot_bytes=slot_bytes,
-                       zero_copy=zero_copy)
+                       zero_copy=zero_copy, overlap=overlap,
+                       collective_timeout_s=collective_timeout_s)

@@ -1,10 +1,14 @@
 """Worker-side pinned execution loop for compiled DAGs.
 
-The analog of the reference's compiled-graph executor loop (reference:
-python/ray/dag/compiled_dag_node.py:805 _execute_until / the per-actor
-do_exec_tasks loop): each pinned actor blocks on its input channels,
-runs its bound method, and pushes the result downstream — no RPC, no
-scheduler, no driver round-trip per item.
+The analog of the reference's compiled-graph executor schedule (reference:
+python/ray/dag/dag_node_operation.py:86 — each actor's node is compiled
+into READ/COMPUTE/WRITE operations that overlap channel I/O with compute;
+compiled_dag_node.py:805 _execute_until): each pinned actor runs an
+operation schedule per item — a reader thread prefetches the NEXT item's
+inputs (TCP receives hide under compute), the executor thread runs the
+bound method, participates in any collective, and pushes downstream.
+Per-item recv/compute windows are recorded (trace spans + a timing block
+in the loop result) so overlap is measurable, not asserted.
 
 jax.Array results are staged to host (np.asarray) before entering the
 channel — the seed of the tensor-transport path (reference:
@@ -14,7 +18,10 @@ ICI belongs to jit'd collectives, not the object plane.
 
 from __future__ import annotations
 
+import queue as _queue
 import sys
+import threading
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -22,6 +29,8 @@ import numpy as np
 from ray_tpu.dag.channel import (DATA, ERROR, STOP, ShmRingChannel,
                                  attach_channel)
 from ray_tpu.runtime.serialization import dumps_oob, loads_oob, serialize
+
+_MAX_TIMED_ITEMS = 512   # per-item windows kept for overlap analysis
 
 
 def _stage_to_host(value):
@@ -48,6 +57,117 @@ class _Upstream(Exception):
         self.frame = frame
 
 
+class _ReaderDead(Exception):
+    """The prefetch reader hit a channel error (peer death/teardown):
+    terminal for the loop — nobody will produce another round."""
+
+    def __init__(self, cause: BaseException):
+        self.cause = cause
+
+
+# --- collective (host-plane star reduce) --------------------------------
+
+
+def _tree_reduce(op: str, vals: list):
+    """Elementwise reduce over matching pytrees of arrays/scalars. Host
+    plane: numpy, no jax import (reference lowers collective nodes to
+    NCCL allreduce, dag/collective_node.py:252; within one process
+    holding a mesh, jit'd psum over ICI is the right tool instead)."""
+    v0 = vals[0]
+    if isinstance(v0, dict):
+        return {k: _tree_reduce(op, [v[k] for v in vals]) for k in v0}
+    if isinstance(v0, tuple) and hasattr(v0, "_fields"):   # NamedTuple
+        return type(v0)(*(
+            _tree_reduce(op, [v[i] for v in vals])
+            for i in range(len(v0))))
+    if isinstance(v0, (list, tuple)):
+        return type(v0)(
+            _tree_reduce(op, [v[i] for v in vals])
+            for i in range(len(v0)))
+    arrs = [np.asarray(v) for v in vals]
+    out = arrs[0]
+    for a in arrs[1:]:
+        if op in ("sum", "mean"):
+            out = out + a
+        elif op == "max":
+            out = np.maximum(out, a)
+        else:
+            out = np.minimum(out, a)
+    if op == "mean":
+        out = out / len(arrs)
+    return out
+
+
+class _Collective:
+    """One participant's view of a dag allreduce group. Every data round
+    EVERY participant enters the round (with its value, or with the
+    ERROR frame it would have shipped) — peers must never be left
+    blocking in a reduce because one participant failed. Reads are
+    bounded by `timeout_s` (shm rings carry no peer-death signal): a
+    dead/killed peer surfaces as a terminal stall instead of pinning
+    this actor's executor thread forever."""
+
+    def __init__(self, spec: dict):
+        self.role = spec["role"]
+        self.op = spec["op"]
+        self.timeout_s = float(spec.get("timeout_s", 600.0))
+        if self.role == "root":
+            self.up = [attach_channel(s, "consumer") for s in spec["up"]]
+            self.down = [attach_channel(s, "producer")
+                         for s in spec["down"]]
+        else:
+            self.up = [attach_channel(spec["up"], "producer")]
+            self.down = [attach_channel(spec["down"], "consumer")]
+
+    def channels(self) -> list:
+        return self.up + self.down
+
+    def round(self, kind: int, value, err_frame: Optional[bytes]):
+        """Returns (DATA, reduced_frame) or (ERROR, frame). The reduced
+        value travels onward as the already-encoded frame — participants
+        forward it downstream without a second serialize/deserialize."""
+        from ray_tpu.dag.channel import ChannelClosed, ChannelTimeout
+        try:
+            if self.role == "leaf":
+                if kind == DATA:
+                    self.up[0].write(serialize(value), DATA,
+                                     timeout=self.timeout_s)
+                else:
+                    self.up[0].write(err_frame, ERROR,
+                                     timeout=self.timeout_s)
+                return self.down[0].read_bytes(self.timeout_s)
+        except (ChannelTimeout, ChannelClosed) as e:
+            raise _ReaderDead(RuntimeError(
+                f"allreduce peer unresponsive for {self.timeout_s}s "
+                f"(participant died?): {e}"))
+        # root: gather every leaf's contribution, reduce, broadcast
+        contribs = []
+        err = err_frame if kind == ERROR else None
+        for ch in self.up:
+            try:
+                k, p = ch.read_bytes(self.timeout_s)
+            except (ChannelTimeout, ChannelClosed) as e:
+                raise _ReaderDead(RuntimeError(
+                    f"allreduce peer unresponsive for {self.timeout_s}s "
+                    f"(participant died?): {e}"))
+            if k == ERROR:
+                err = err or p
+            else:
+                contribs.append(loads_oob(p))
+        if err is not None:
+            for ch in self.down:
+                ch.write(err, ERROR)
+            return (ERROR, err)
+        red = _tree_reduce(self.op, [value] + contribs)
+        ser = serialize(red)
+        for ch in self.down:
+            ch.write(ser, DATA)
+        return (DATA, ser)
+
+
+# --- the loop -----------------------------------------------------------
+
+
 def exec_loop(instance, spec: dict) -> dict:
     """Runs inside the actor's executor thread until a STOP frame.
 
@@ -57,6 +177,8 @@ def exec_loop(instance, spec: dict) -> dict:
       arg_template: list where each element is ("chan", idx) or
         ("const", frame) — positional args in order
       out_channels: list of channel specs (broadcast to every consumer)
+      overlap: prefetch next item's inputs on a reader thread
+      collective: optional allreduce role spec (see _Collective)
     """
     method = getattr(instance, spec["method"])
     # shm rings attach by name (same host); tcp edges bind/connect per
@@ -65,20 +187,26 @@ def exec_loop(instance, spec: dict) -> dict:
         attach_channel(s, "consumer") for s in spec["in_channels"]]
     outs: List[ShmRingChannel] = [
         attach_channel(s, "producer") for s in spec["out_channels"]]
+    coll = _Collective(spec["collective"]) if spec.get("collective") \
+        else None
     template = [loads_oob(frame) if k == "const" else None
                 for k, frame in spec["arg_template"]]
     chan_pos = [i for i, (k, _) in enumerate(spec["arg_template"])
                 if k == "chan"]
     # Zero-copy is opt-in (compile(zero_copy=True)): args alias the ring
-    # slot, which is only safe when the method does not retain them.
-    single = len(ins) == 1 and spec.get("zero_copy")
+    # slot, which is only safe when the method does not retain them —
+    # and incompatible with both prefetch (the window would escape) and
+    # collectives (the value must outlive the slot for the reduce).
+    single = len(ins) == 1 and spec.get("zero_copy") and coll is None
+    overlap = bool(spec.get("overlap")) and not single and ins
 
-    def _take_copy(kind, mv):
-        """Deserialize from a copy — the slot is released on return, so
-        zero-copy views must not escape this window."""
-        if kind == DATA:
-            return loads_oob(bytes(mv))
-        raise _Stop() if kind == STOP else _Upstream(bytes(mv))
+    from ray_tpu.util import tracing
+    items: List[dict] = []          # first N per-item timing windows
+    # recv windows span WAIT + transfer (channels expose no first-byte
+    # mark): overlapped_recv_s is the receive-side blocking hidden under
+    # compute — the overlap the schedule creates — not pure wire time;
+    # an upstream-starved stage shows long recv spans by design.
+    stats = {"recv_s": 0.0, "compute_s": 0.0, "overlapped_recv_s": 0.0}
 
     def _run_in_window(kind, mv):
         """Zero-copy fast path: the method consumes the frame AND the
@@ -94,32 +222,126 @@ def exec_loop(instance, spec: dict) -> dict:
         for out in outs:
             out.write(ser, DATA)
 
+    # --- overlapped reader: prefetches whole input rounds ---------------
+    rounds_q: Optional[_queue.Queue] = None
+    reader: Optional[threading.Thread] = None
+    if overlap:
+        rounds_q = _queue.Queue(maxsize=2)
+
+        def _read_rounds():
+            while True:
+                t0 = time.time()
+                frames = []
+                for ch in ins:
+                    try:
+                        frames.append(ch.read_bytes())
+                    except BaseException as e:  # noqa: BLE001
+                        rounds_q.put(("fail", e, (t0, time.time())))
+                        return
+                rounds_q.put(("round", frames, (t0, time.time())))
+                if any(k == STOP for k, _ in frames):
+                    return   # lockstep: STOP reaches every edge together
+
+        reader = threading.Thread(target=_read_rounds, daemon=True,
+                                  name="dag-prefetch")
+        reader.start()
+
+    def _next_round():
+        """One input round: [(kind, payload)] per in-channel + the recv
+        window. Raises what a direct read would raise."""
+        if overlap:
+            tag, payload, win = rounds_q.get()
+            if tag == "fail":
+                raise _ReaderDead(payload)
+            return payload, win
+        t0 = time.time()
+        frames = [ch.read_bytes() for ch in ins]
+        return frames, (t0, time.time())
+
     processed = 0
+    compute_until = 0.0             # wall time the last compute ended
     try:
         while True:
             try:
                 if single:
                     ins[0].read_with(_run_in_window)
-                else:
+                    processed += 1
+                    continue
+                frames, (r0, r1) = _next_round()
+                stats["recv_s"] += r1 - r0
+                if compute_until > r0:
+                    # the part of this receive that hid under the
+                    # previous item's compute — the overlap win itself
+                    stats["overlapped_recv_s"] += \
+                        min(r1, compute_until) - r0
+                if any(k == STOP for k, _ in frames):
+                    raise _Stop()
+                err_frame = next(
+                    (p for k, p in frames if k == ERROR), None)
+                value = None
+                c0 = c1 = r1
+                if err_frame is None:
                     args = list(template)
-                    pending: Optional[BaseException] = None
-                    for pos, ch in zip(chan_pos, ins):
-                        # Drain every input each round even after a
-                        # stop/error so the channels stay in lockstep.
+                    for pos, (_, payload) in zip(chan_pos, frames):
+                        args[pos] = loads_oob(payload)
+                    c0 = time.time()
+                    try:
+                        value = _stage_to_host(method(*args))
+                    except BaseException as e:  # noqa: BLE001
                         try:
-                            args[pos] = ch.read_with(_take_copy)
-                        except (_Stop, _Upstream) as e:
-                            pending = pending or e
-                    if pending is not None:
-                        raise pending
-                    ser = serialize(_stage_to_host(method(*args)))
+                            err_frame = dumps_oob(e)
+                        except Exception:   # unpicklable payload
+                            err_frame = dumps_oob(RuntimeError(
+                                f"{type(e).__name__}: {e}"))
+                    c1 = time.time()
+                    stats["compute_s"] += c1 - c0
+                    compute_until = c1
+                out_frame = None      # pre-encoded downstream payload
+                if coll is not None:
+                    kind = ERROR if err_frame is not None else DATA
+                    kind, frame = coll.round(kind, value, err_frame)
+                    if kind == ERROR:
+                        err_frame = frame
+                    else:
+                        out_frame, err_frame = frame, None
+                if len(items) < _MAX_TIMED_ITEMS:
+                    items.append({"recv": (r0, r1), "compute": (c0, c1)})
+                if tracing.enabled():
+                    tracing.record_exec("", "dag",
+                                        f"{spec['method']}:recv", r0, r1)
+                    tracing.record_exec("", "dag",
+                                        f"{spec['method']}", c0, c1,
+                                        error=err_frame is not None)
+                if err_frame is not None:
+                    for out in outs:
+                        out.write(err_frame, ERROR)
+                else:
+                    ser = out_frame if out_frame is not None \
+                        else serialize(value)
                     for out in outs:
                         out.write(ser, DATA)
+                    processed += 1
             except _Stop:
                 for out in outs:
                     out.write(b"", STOP)
                 break
-            except _Upstream as e:
+            except _ReaderDead as e:
+                # TERMINAL: the reader exited, no further round will
+                # arrive — resuming the loop would block on an empty
+                # queue forever and pin the executor thread through
+                # teardown. Ship the error and leave.
+                try:
+                    frame = dumps_oob(e.cause)
+                except Exception:
+                    frame = dumps_oob(RuntimeError(
+                        f"{type(e.cause).__name__}: {e.cause}"))
+                for out in outs:
+                    try:
+                        out.write(frame, ERROR, timeout=5.0)
+                    except Exception:  # noqa: BLE001 — tearing down
+                        pass
+                break
+            except _Upstream as e:   # zero-copy path only
                 for out in outs:
                     out.write(e.frame, ERROR)
             except BaseException as e:  # noqa: BLE001 — ship downstream
@@ -130,11 +352,11 @@ def exec_loop(instance, spec: dict) -> dict:
                         f"{type(e).__name__}: {e}"))
                 for out in outs:
                     out.write(frame, ERROR)
-            else:
-                processed += 1
     finally:
-        for ch in ins + outs:
+        coll_chans = coll.channels() if coll is not None else []
+        for ch in ins + outs + coll_chans:
             ch.close()
             if getattr(ch, "_lazy_owner", False):
                 ch.unlink()   # consumer created this same-node segment
-    return {"processed": processed}
+    return {"method": spec["method"], "processed": processed,
+            "timing": stats, "items": items}

@@ -18,8 +18,9 @@ from ray_tpu.dag.channel import ShmRingChannel
 @pytest.fixture(scope="module")
 def cluster():
     # Actors persist across this module's tests (no distributed GC);
-    # budget a CPU per pinned stage actor created below.
-    ray_tpu.init(num_cpus=16)
+    # budget a CPU per pinned stage actor created below — the round-5
+    # collective/multi-output tests pushed the total past 16.
+    ray_tpu.init(num_cpus=48)
     yield
     ray_tpu.shutdown()
 
@@ -235,6 +236,11 @@ def test_jax_array_staged_through_dag(cluster):
     @ray_tpu.remote
     class J:
         def f(self, x):
+            # Hermetic: pin the worker's jax to CPU before backend init
+            # (the TPU plugin ignores the JAX_PLATFORMS env var, and this
+            # test exercises channel staging, not the chip).
+            import jax
+            jax.config.update("jax_platforms", "cpu")
             import jax.numpy as jnp
             return jnp.asarray(x) * 2
 
@@ -262,6 +268,8 @@ def test_tensor_ref_rides_dag_channels(cluster):
     @ray_tpu.remote
     class Prod:
         def park(self, x):
+            import jax
+            jax.config.update("jax_platforms", "cpu")  # hermetic (no chip)
             import jax.numpy as jnp
 
             from ray_tpu.runtime.device_store import put_device
@@ -271,6 +279,8 @@ def test_tensor_ref_rides_dag_channels(cluster):
     @ray_tpu.remote
     class Cons:
         def use(self, ref):
+            import jax
+            jax.config.update("jax_platforms", "cpu")  # hermetic (no chip)
             import numpy as _np
 
             from ray_tpu.runtime.device_store import TensorRef
@@ -292,3 +302,211 @@ def test_tensor_ref_rides_dag_channels(cluster):
         assert np.allclose(v2, (x + 1) * 3.0 + 1.0)
     finally:
         cd.teardown()
+
+
+# --- collectives + multi-output + overlap (round 5) ---------------------
+
+
+def test_multi_output_node(cluster):
+    from ray_tpu.dag import MultiOutputNode
+
+    @ray_tpu.remote
+    class S:
+        def __init__(self, k):
+            self.k = k
+
+        def f(self, x):
+            return x * self.k
+
+    s1, s2 = S.remote(3), S.remote(5)
+    with InputNode() as inp:
+        out = MultiOutputNode([s1.f.bind(inp), s2.f.bind(inp)])
+    cd = compile(out)
+    try:
+        for i in range(4):
+            assert cd.execute(i).get(timeout=60) == [i * 3, i * 5]
+    finally:
+        cd.teardown()
+    # a 1-member MultiOutputNode still returns a LIST (only a bare
+    # MethodNode sink unwraps)
+    s3 = S.remote(7)
+    with InputNode() as inp:
+        cd2 = compile(MultiOutputNode([s3.f.bind(inp)]))
+    try:
+        assert cd2.execute(2).get(timeout=60) == [14]
+    finally:
+        cd2.teardown()
+
+
+def test_tree_reduce_pytrees():
+    from collections import namedtuple
+
+    from ray_tpu.dag.runtime import _tree_reduce
+    NT = namedtuple("NT", ["loss", "grads"])
+    a = NT(loss=1.0, grads={"w": np.ones(4)})
+    b = NT(loss=3.0, grads={"w": np.full(4, 2.0)})
+    out = _tree_reduce("sum", [a, b])
+    assert isinstance(out, NT)
+    assert out.loss == 4.0 and np.allclose(out.grads["w"], 3.0)
+    out = _tree_reduce("mean", [a, b])
+    assert out.loss == 2.0 and np.allclose(out.grads["w"], 1.5)
+    assert _tree_reduce("max", [(1, [2.0]), (5, [0.5])]) == (5, [2.0])
+
+
+def test_dag_allreduce_sum(cluster):
+    """3-way allreduce over pytree values: every participant observes the
+    elementwise sum (reference: dag/collective_node.py:252 allreduce
+    bind; here the reduce rides the host-plane star)."""
+    from ray_tpu.dag import MultiOutputNode, allreduce
+
+    @ray_tpu.remote
+    class Worker:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def grad(self, x):
+            return {"w": np.full(8, float(x) * self.scale),
+                    "b": float(self.scale)}
+
+    ws = [Worker.remote(s) for s in (1.0, 10.0, 100.0)]
+    with InputNode() as inp:
+        reduced = allreduce([w.grad.bind(inp) for w in ws], op="sum")
+        out = MultiOutputNode(reduced)
+    cd = compile(out)
+    try:
+        for i in range(1, 4):
+            vals = cd.execute(i).get(timeout=60)
+            assert len(vals) == 3
+            for v in vals:    # every participant sees the SAME reduction
+                assert np.allclose(v["w"], np.full(8, i * 111.0))
+                assert v["b"] == pytest.approx(111.0)
+    finally:
+        cd.teardown()
+
+
+def test_dag_allreduce_mean_feeds_downstream(cluster):
+    from ray_tpu.dag import allreduce
+
+    @ray_tpu.remote
+    class W:
+        def __init__(self, k):
+            self.k = k
+
+        def val(self, x):
+            return np.array([x * self.k], dtype=np.float64)
+
+    @ray_tpu.remote
+    class Apply:
+        def plus1(self, m):
+            return float(m[0]) + 1.0
+
+    w1, w2, app = W.remote(2.0), W.remote(4.0), Apply.remote()
+    with InputNode() as inp:
+        r1, r2 = allreduce([w1.val.bind(inp), w2.val.bind(inp)],
+                           op="mean")
+        out = app.plus1.bind(r1)
+    cd = compile(out)
+    try:
+        for i in range(3):
+            assert cd.execute(i).get(timeout=60) == \
+                pytest.approx(i * 3.0 + 1.0)
+    finally:
+        cd.teardown()
+
+
+def test_dag_allreduce_error_reaches_all_and_stream_continues(cluster):
+    from ray_tpu.dag import MultiOutputNode, allreduce
+
+    @ray_tpu.remote
+    class W:
+        def __init__(self, trip):
+            self.trip = trip
+
+        def f(self, x):
+            if self.trip and x == 2:
+                raise ValueError("participant boom")
+            return np.full(4, float(x))
+
+    w1, w2 = W.remote(True), W.remote(False)
+    with InputNode() as inp:
+        out = MultiOutputNode(
+            allreduce([w1.f.bind(inp), w2.f.bind(inp)]))
+    cd = compile(out)
+    try:
+        futs = [cd.execute(i) for i in range(5)]
+        for i, f in enumerate(futs):
+            if i == 2:
+                with pytest.raises(ValueError, match="participant boom"):
+                    f.get(timeout=60)
+            else:
+                vals = f.get(timeout=60)
+                assert np.allclose(vals[0], np.full(4, 2.0 * i))
+                assert np.allclose(vals[1], vals[0])
+    finally:
+        cd.teardown()
+
+
+def test_dag_allreduce_validation(cluster):
+    from ray_tpu.dag import allreduce
+
+    @ray_tpu.remote
+    class S:
+        def f(self, x):
+            return x
+
+    s1, s2 = S.remote(), S.remote()
+    with InputNode() as inp:
+        n1, n2 = s1.f.bind(inp), s2.f.bind(inp)
+    with pytest.raises(ValueError, match="at least 2"):
+        allreduce([n1])
+    with pytest.raises(ValueError, match="op must be"):
+        allreduce([n1, n2], op="prod")
+    # raw parent output bound downstream of a collective is rejected
+    @ray_tpu.remote
+    class T:
+        def g(self, a, b):
+            return a
+
+    t = T.remote()
+    reduced = allreduce([n1, n2])
+    bad = t.g.bind(reduced[0], n1)
+    with pytest.raises(ValueError, match="raw output"):
+        compile(bad)
+
+
+def test_dag_overlap_recv_hides_under_compute(cluster):
+    """The operation schedule must prefetch: stage2's receive of item
+    k+1 completes while item k is still computing (reference:
+    dag/dag_node_operation.py:86 — overlapped READ/COMPUTE/WRITE)."""
+
+    @ray_tpu.remote
+    class Fast:
+        def produce(self, x):
+            return np.full(1 << 14, float(x))
+
+    @ray_tpu.remote
+    class Slow:
+        def consume(self, a):
+            time.sleep(0.05)       # compute window recv can hide under
+            return float(a[0])
+
+    f, s = Fast.remote(), Slow.remote()
+    with InputNode() as inp:
+        out = s.consume.bind(f.produce.bind(inp))
+    cd = compile(out)
+    try:
+        futs = [cd.execute(i) for i in range(8)]
+        assert [fu.get(timeout=120) for fu in futs] == \
+            [float(i) for i in range(8)]
+    finally:
+        cd.teardown()
+    stats = {st["method"]: st for st in cd.stage_stats}
+    slow = stats["consume"]
+    assert slow["processed"] == 8
+    items = slow["items"]
+    # next item fully received before the current compute finished
+    overlapped = [
+        i for i in range(len(items) - 1)
+        if items[i + 1]["recv"][1] < items[i]["compute"][1]]
+    assert overlapped, f"no overlapped receives: {items}"
+    assert slow["timing"]["overlapped_recv_s"] > 0.0
