@@ -32,6 +32,11 @@ class Config:
     head_port: int = 0                      # 0 = pick a free port
     health_check_period_s: float = 1.0      # head -> agent liveness probes
     health_check_failure_threshold: int = 5
+    # Cluster-view snapshot staleness bound: heartbeat replies ship a
+    # cached pickled view rebuilt at most this often (O(nodes) to build,
+    # so per-beat rebuilds are O(nodes^2)/s cluster-wide — see
+    # SCALE_BENCH_STRETCH.json for the measured collapse at 1k nodes).
+    view_snapshot_interval_s: float = 0.5
     kv_max_value_bytes: int = 64 * 1024 * 1024
 
     # --- node agent / workers ---

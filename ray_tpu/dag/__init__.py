@@ -233,6 +233,14 @@ class CompiledDag:
                     raise ValueError(
                         "a collective participant's raw output cannot be "
                         "bound downstream — bind its AllReduceNode instead")
+        # Shape checks belong HERE, before _build creates any channel:
+        # raising mid-build would leak shm segments / TCP listeners
+        # (CompiledDag.__init__ aborts with nothing to teardown).
+        sink_nodes = [m.parent if isinstance(m, AllReduceNode) else m
+                      for m in self._sink_members]
+        if len({id(n) for n in sink_nodes}) != len(sink_nodes):
+            raise ValueError(
+                "the same node cannot appear twice in MultiOutputNode")
 
     def _local(self, i: Optional[int]) -> bool:
         """True when dag node i (None = the driver) runs on the
@@ -322,14 +330,10 @@ class CompiledDag:
                                          "up": up, "down": down}
             self._coll_spec[root] = root_spec
         # sinks -> driver: one channel per member, combined in lockstep
-        seen_sinks = set()
+        # (duplicates were rejected in _validate, before channels exist)
         for m in self._sink_members:
             si = idx[id(m.parent)] if isinstance(m, AllReduceNode) \
                 else idx[id(m)]
-            if si in seen_sinks:
-                raise ValueError(
-                    "the same node cannot appear twice in MultiOutputNode")
-            seen_sinks.add(si)
             self._out_chans[si].append(self._new_edge(si, None))
 
     def _start(self):

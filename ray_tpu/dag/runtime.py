@@ -26,8 +26,8 @@ from typing import List, Optional
 
 import numpy as np
 
-from ray_tpu.dag.channel import (DATA, ERROR, STOP, ShmRingChannel,
-                                 attach_channel)
+from ray_tpu.dag.channel import (DATA, ERROR, STOP, ChannelClosed,
+                                 ShmRingChannel, attach_channel)
 from ray_tpu.runtime.serialization import dumps_oob, loads_oob, serialize
 
 _MAX_TIMED_ITEMS = 512   # per-item windows kept for overlap analysis
@@ -255,7 +255,10 @@ def exec_loop(instance, spec: dict) -> dict:
                 raise _ReaderDead(payload)
             return payload, win
         t0 = time.time()
-        frames = [ch.read_bytes() for ch in ins]
+        try:
+            frames = [ch.read_bytes() for ch in ins]
+        except BaseException as e:  # channel death: terminal, like the
+            raise _ReaderDead(e)    # prefetch reader's fail path
         return frames, (t0, time.time())
 
     processed = 0
@@ -264,7 +267,10 @@ def exec_loop(instance, spec: dict) -> dict:
         while True:
             try:
                 if single:
-                    ins[0].read_with(_run_in_window)
+                    try:
+                        ins[0].read_with(_run_in_window)
+                    except ChannelClosed as e:
+                        raise _ReaderDead(e)   # peer died: terminal
                     processed += 1
                     continue
                 frames, (r0, r1) = _next_round()
@@ -338,6 +344,10 @@ def exec_loop(instance, spec: dict) -> dict:
                 for out in outs:
                     try:
                         out.write(frame, ERROR, timeout=5.0)
+                        # STOP too: downstream stages must terminate —
+                        # shm rings carry no peer-death signal, so an
+                        # un-terminated consumer would block forever.
+                        out.write(b"", STOP, timeout=5.0)
                     except Exception:  # noqa: BLE001 — tearing down
                         pass
                 break

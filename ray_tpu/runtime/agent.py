@@ -97,6 +97,7 @@ class NodeAgent:
             maxlen=self.config.event_buffer_size)
         self.cluster_view: Dict[NodeID, dict] = {}
         self._view_version = 0
+        self._known_cluster_view = -1   # last view version applied
         self._pulls: Dict[ObjectID, asyncio.Future] = {}
         self.store = SharedObjectStore(
             session_id,
@@ -391,6 +392,7 @@ class NodeAgent:
                     version=self._view_version,
                     pending_demand=[req["resources"]
                                     for req, _ in self._wait_queue],
+                    known_view=self._known_cluster_view,
                     timeout=10.0)
                 if r.get("drained"):
                     # deliberately removed: stop beating — the node is
@@ -403,13 +405,19 @@ class NodeAgent:
                     # (node_manager.proto:457); here the head's "unknown"
                     # reply is the restart signal.
                     await self._rejoin_head()
-                elif r.get("view"):
-                    self.cluster_view = r["view"]
+                elif r.get("view_blob") is not None:
+                    # view rides pre-pickled (control caches one blob
+                    # per version instead of re-encoding per node)
+                    import pickle
+                    self.cluster_view = pickle.loads(r["view_blob"])
+                    self._known_cluster_view = r.get("view_version", -1)
             except Exception:
                 pass
             await asyncio.sleep(period)
 
     async def _rejoin_head(self):
+        # a restarted control has fresh view versions: re-fetch
+        self._known_cluster_view = -1
         r = await self.pool.call(
             self.head_addr, "register_node", node_id=self.node_id,
             addr=self.addr, resources_total=self.resources_total,

@@ -137,6 +137,11 @@ class ControlService:
         # object directory: oid -> {node_id: size}
         self.object_locations: Dict[ObjectID, Dict[NodeID, int]] = {}
         self.pubsub = Pubsub()
+        # Epoch-seeded so a restarted control never hands out a version
+        # an old incarnation already used (agents gate view refresh on
+        # equality; rejoin also resets, this is belt-and-braces).
+        self._view_version = int(time.time() * 1000) << 8
+        self._view_blob_cache = (0, 0.0, None)   # (version, built_at, blob)
         self.pool = rpc.ConnectionPool()
         self.server = rpc.RpcServer(
             self._handlers(),
@@ -333,17 +338,23 @@ class ControlService:
             resources_total=dict(resources_total),
             resources_available=dict(resources_total),
             labels=dict(labels or {}))
+        self._bump_view()
         await self.pubsub.publish(
             "nodes", {"event": "node_added", "node_id": node_id,
                       "addr": tuple(addr)})
         return {"ok": True}
 
     async def heartbeat(self, node_id: NodeID, resources_available=None,
-                        version: int = 0, pending_demand=None):
+                        version: int = 0, pending_demand=None,
+                        known_view: int = -1):
         """Liveness + resource-view sync in one beat (reference splits these
         across GcsHealthCheckManager and ray_syncer; one RPC suffices at
-        TPU-pod node counts). Reply carries the full cluster resource view
-        so every agent can make spillback decisions locally."""
+        TPU-pod node counts). The reply carries the cluster resource view
+        (for local spillback decisions) ONLY when the agent's copy is
+        stale: a naive view-per-beat reply is O(nodes^2)/s cluster-wide
+        and measurably collapses the control core near 1,000 nodes
+        (SCALE_BENCH_STRETCH.json) — the reference's ray_syncer exists
+        for the same reason."""
         if node_id in self._drained:
             # covers the restart case too: the node isn't in self.nodes
             # (nodes aren't persisted) but the drain intent is — reply
@@ -360,11 +371,45 @@ class ControlService:
         n.last_heartbeat = time.monotonic()
         if not n.alive:
             n.alive = True  # node came back before we GC'd it
+            self._bump_view()
         if resources_available is not None:
-            n.resources_available = dict(resources_available)
+            if resources_available != n.resources_available:
+                n.resources_available = dict(resources_available)
+                self._bump_view()
             n.version = version
+        # pending_demand feeds the autoscaler via get_nodes, NOT _view():
+        # no bump — it would only churn the snapshot cache.
         n.pending_demand = list(pending_demand or [])
-        return {"ok": True, "view": self._view()}
+        # Gate on the SNAPSHOT's version (what agents can actually hold),
+        # not the live counter: under churn the live counter always leads
+        # the throttled snapshot, and gating on it would re-ship the same
+        # blob to every agent every beat — the O(nodes^2)/s this exists
+        # to kill.
+        ver, blob = self._view_snapshot()
+        reply = {"ok": True, "view_version": ver}
+        if known_view != ver:
+            reply["view_blob"] = blob
+        return reply
+
+    def _bump_view(self) -> None:
+        self._view_version += 1
+
+    def _view_snapshot(self):
+        """(version, pickled view), rebuilt at most every
+        view_snapshot_interval_s: under churn every beat would otherwise
+        rebuild + re-pickle an O(nodes) view per node per second. Agents
+        tolerate sub-second staleness by design (they already act on
+        views one heartbeat period old)."""
+        import pickle
+        ver, t, blob = self._view_blob_cache
+        now = time.monotonic()
+        if blob is None or (
+                ver != self._view_version and
+                now - t >= self.config.view_snapshot_interval_s):
+            ver = self._view_version
+            blob = pickle.dumps(self._view(), protocol=5)
+            self._view_blob_cache = (ver, now, blob)
+        return self._view_blob_cache[0], self._view_blob_cache[2]
 
     def _view(self):
         return {
@@ -419,6 +464,7 @@ class ControlService:
         if n is None or not n.alive:
             return
         n.alive = False
+        self._bump_view()
         await self.pubsub.publish(
             "nodes", {"event": "node_dead", "node_id": node_id,
                       "reason": reason})
