@@ -312,12 +312,14 @@ def exec_loop(instance, spec: dict) -> dict:
                         out_frame, err_frame = frame, None
                 if len(items) < _MAX_TIMED_ITEMS:
                     items.append({"recv": (r0, r1), "compute": (c0, c1)})
-                if tracing.enabled():
-                    tracing.record_exec("", "dag",
-                                        f"{spec['method']}:recv", r0, r1)
-                    tracing.record_exec("", "dag",
-                                        f"{spec['method']}", c0, c1,
-                                        error=err_frame is not None)
+                # no enabled() pre-check: record_exec gates itself, and
+                # the task-events flag must reach dag rows even when
+                # span tracing is off (state.list_tasks)
+                tracing.record_exec("", "dag",
+                                    f"{spec['method']}:recv", r0, r1)
+                tracing.record_exec("", "dag",
+                                    f"{spec['method']}", c0, c1,
+                                    error=err_frame is not None)
                 if err_frame is not None:
                     for out in outs:
                         out.write(err_frame, ERROR)

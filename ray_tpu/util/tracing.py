@@ -15,7 +15,10 @@ Design: every process records into its local ring buffer (util/events):
 (control -> agents -> workers) and ``chrome_path=`` writes a
 chrome://tracing / Perfetto-loadable JSON file.
 
-Disable with RAY_TPU_TRACE_TASKS=0 (recording costs ~1us/event).
+RAY_TPU_TRACE_TASKS=0 disables the submit->exec flow EDGES only; exec
+records double as always-on task events (`ray-tpu list tasks`) and need
+RAY_TPU_TASK_EVENTS=0 as well to stop entirely (recording costs
+~1us/event).
 """
 
 from __future__ import annotations
@@ -28,8 +31,15 @@ from typing import List, Optional
 
 from ray_tpu.util import events
 
-_ENABLED = os.environ.get("RAY_TPU_TRACE_TASKS", "1").lower() \
-    not in ("0", "false", "off")
+_OFF = ("0", "false", "off")
+_ENABLED = os.environ.get("RAY_TPU_TRACE_TASKS", "1").lower() not in _OFF
+# Task events (exec records: name/start/duration/error) are ALWAYS-ON
+# independently of the tracing flag (reference: GCS task events,
+# src/ray/gcs/gcs_task_manager.h, feed `ray list tasks` regardless of
+# OTel tracing) — `ray-tpu list tasks` must not come back empty just
+# because span tracing was off when the work ran. Disable explicitly
+# with RAY_TPU_TASK_EVENTS=0; recording costs ~1us/event.
+_EVENTS = os.environ.get("RAY_TPU_TASK_EVENTS", "1").lower() not in _OFF
 
 # hex id of the task/actor-call this process is currently executing
 current_span: contextvars.ContextVar = contextvars.ContextVar(
@@ -51,8 +61,10 @@ def record_submit(child_hex: str, kind: str, name: str) -> None:
 def record_exec(task_hex: str, kind: str, name: str,
                 t0: float, t1: float, *, error: bool = False,
                 batch: int = 1) -> None:
-    """Called by the worker executor around user code."""
-    if not _ENABLED:
+    """Called by the worker executor around user code. Doubles as the
+    always-on task-event record: gated on the task-events flag, not the
+    tracing flag (only the submit->exec flow EDGES are tracing-only)."""
+    if not (_ENABLED or _EVENTS):
         return
     events.record("trace", "exec", ph="X", task=task_hex, kind=kind,
                   target=name, ts=t0, dur=t1 - t0, error=error,

@@ -1,0 +1,65 @@
+"""Always-on task events: `ray list tasks` must return rows even when
+span tracing is disabled (reference: GCS task events are always-on,
+src/ray/gcs/gcs_task_manager.h — `ray list tasks` never depends on the
+OTel tracing flag). Own file: needs a cluster whose WORKERS inherit
+RAY_TPU_TRACE_TASKS=0 from the driver env."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.config import Config
+# Import BEFORE any setenv: tracing snapshots its flags at import — if a
+# fixture's env patch were the thing that FIRST imported it, monkeypatch
+# would capture (and "restore") the patched value, leaking tracing-off
+# into every later test in the session.
+from ray_tpu.util import tracing
+
+
+@pytest.fixture()
+def cluster_tracing_off(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TRACE_TASKS", "0")   # workers inherit
+    monkeypatch.setattr(tracing, "_ENABLED", False)  # driver side too
+    ray_tpu.init(num_cpus=4, config=Config.from_env(
+        num_workers_prestart=0, default_max_task_retries=0))
+    yield
+    ray_tpu.shutdown()
+
+
+def test_list_tasks_with_tracing_off(cluster_tracing_off):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def marked_task(i):
+        return i * 2
+
+    assert ray_tpu.get([marked_task.remote(i) for i in range(4)],
+                       timeout=120) == [0, 2, 4, 6]
+    # worker buffers flush to the agent every ~1s; poll rather than
+    # guess a sleep
+    rows = []
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        rows = [r for r in state.list_tasks(limit=1000)
+                if "marked_task" in (r["name"] or "")]
+        if len(rows) >= 4:
+            break
+        time.sleep(0.3)
+    assert len(rows) >= 4, rows
+    assert all(r["duration_s"] >= 0 for r in rows)
+    # summaries ride the same always-on records
+    summ = state.summarize_tasks()
+    hit = [k for k in summ if "marked_task" in k]
+    assert hit and summ[hit[0]]["count"] >= 4
+
+
+def test_events_can_be_disabled_explicitly(monkeypatch):
+    from ray_tpu.util import tracing
+    monkeypatch.setattr(tracing, "_ENABLED", False)
+    monkeypatch.setattr(tracing, "_EVENTS", False)
+    from ray_tpu.util import events
+    before = len(events.dump())
+    tracing.record_exec("", "task", "nope", 0.0, 1.0)
+    assert len(events.dump()) == before
