@@ -4,6 +4,17 @@ Reference: python/ray/data/block.py (blocks are Arrow tables there). Here a
 block is a dict[str, np.ndarray] — numpy-native so batches flow zero-copy
 into jax.device_put / torch.from_numpy; Arrow interop at the parquet
 boundary only.
+
+Column dtype contract:
+- uniform scalars / equal-shape sequences -> dense numeric arrays (2D+
+  for tensor columns): the ZERO-COPY tensor path into device_put.
+- strings -> native numpy 'U' arrays (vectorized sort/compare).
+- RAGGED sequences (per-row variable shape: token lists, boxes, dicts)
+  -> an explicit 1-D object array holding the Python values. Row
+  identity is preserved through slice/take/concat — shuffle, sort,
+  groupby and join all work — but the column rides the OBJECT path:
+  no vectorized kernels, no zero-copy into jax. Pad/truncate to a
+  fixed shape (e.g. map_batches) before feeding device code.
 """
 
 from __future__ import annotations
@@ -26,8 +37,24 @@ def block_from_rows(rows: List[dict]) -> Block:
 
 
 def _to_array(values: list) -> np.ndarray:
-    arr = np.asarray(values)
-    return arr
+    try:
+        return np.asarray(values)
+    except ValueError:
+        # Ragged rows (inhomogeneous shapes raise under numpy>=1.24):
+        # keep the column honest as a 1-D object array of the original
+        # Python values instead of crashing the pipeline — see the
+        # module docstring's dtype contract.
+        return object_array(values)
+
+
+def object_array(values: list) -> np.ndarray:
+    """1-D object array with one slot per ROW (np.empty + per-row
+    assignment: a plain fill can still trip numpy's broadcasting when
+    rows happen to share a length)."""
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
 
 
 def block_from_items(items: List[Any]) -> Block:
@@ -56,8 +83,18 @@ def block_concat(blocks: List[Block]) -> Block:
     blocks = [b for b in blocks if block_num_rows(b)]
     if not blocks:
         return {}
-    keys = blocks[0].keys()
-    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    out: Block = {}
+    for k in blocks[0].keys():
+        parts = [b[k] for b in blocks]
+        try:
+            out[k] = np.concatenate(parts)
+        except ValueError:
+            # a column ragged ACROSS blocks (dense [n,3] in one part,
+            # [m,4] or object in another): fall back to one object row
+            # per element, same contract as _to_array
+            out[k] = object_array(
+                [v for p in parts for v in list(p)])
+    return out
 
 
 def block_rows(b: Block) -> Iterable[dict]:

@@ -23,7 +23,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from ray_tpu.data.block import (Block, block_concat, block_num_rows,
-                                block_slice, block_take)
+                                block_slice, block_take, object_array)
 
 # Partitions per shuffle: bounded so n_in x n_out ref fan-out stays sane.
 MAX_PARTITIONS = 64
@@ -180,7 +180,13 @@ def _sample_bounds(in_refs, spec: dict, n_out: int) -> np.ndarray:
     samples = ray_tpu.get([sample_fn.remote(r) for r in in_refs],
                           timeout=300)
     allv = np.sort(np.concatenate([s for s in samples if len(s)]))
-    qs = [int(len(allv) * (j + 1) / n_out) for j in range(n_out - 1)]
+    # n_out == 1 needs NO boundaries — np.clip([]) yields a FLOAT empty
+    # array that then faults as an index
+    if n_out <= 1:
+        return allv[:0]
+    qs = np.asarray(
+        [int(len(allv) * (j + 1) / n_out) for j in range(n_out - 1)],
+        dtype=np.int64)
     return allv[np.clip(qs, 0, len(allv) - 1)]
 
 
@@ -243,15 +249,24 @@ def join_blocks(lb: Optional[Block], rb: Optional[Block], key: str,
                                  # be silently overwritten
             if r_rows:
                 v = np.asarray(block_take({col: vals}, rtake)[col])
+                if nulls.any():
+                    if v.dtype.kind in "fiub" and v.ndim == 1:
+                        v = v.astype(np.float64)
+                        v[nulls] = np.nan
+                    else:
+                        # strings, object/ragged AND multi-dim tensor
+                        # columns: numpy cannot represent a missing
+                        # row densely — demote to object rows with
+                        # None (np.resize would silently FLATTEN a
+                        # [n,d] tensor column across rows)
+                        v = object_array(list(v))
+                        v[nulls] = None
             else:  # zero-row right partition: every match is null
-                v = np.asarray(vals)
-            if nulls.any() or not r_rows:
-                if v.dtype.kind in "fiub":
-                    v = np.resize(v.astype(np.float64), len(li))
-                    v[nulls] = np.nan
+                proto = np.asarray(vals)
+                if proto.dtype.kind in "fiub" and proto.ndim == 1:
+                    v = np.full(len(li), np.nan)
                 else:
-                    v = np.resize(v.astype(object), len(li))
-                    v[nulls] = None
+                    v = np.empty(len(li), dtype=object)   # all None
             out[name] = v
     return out
 

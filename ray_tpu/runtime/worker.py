@@ -687,6 +687,15 @@ async def _amain():
 
 
 def main():
+    # RAY_TPU_FORCE_JAX_PLATFORM pins jax BEFORE any user code can
+    # initialize a backend: plugin platforms (TPU) may ignore the
+    # JAX_PLATFORMS env var, and a worker that only wanted CPU can
+    # otherwise stall for minutes grabbing a tunnelled chip. Used by
+    # the test harness (conftest) and CPU-only deployments.
+    plat = os.environ.get("RAY_TPU_FORCE_JAX_PLATFORM")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
     from ray_tpu.runtime.rpc import new_event_loop
     loop = new_event_loop()
     asyncio.set_event_loop(loop)

@@ -62,8 +62,9 @@ def record_exec(task_hex: str, kind: str, name: str,
                 t0: float, t1: float, *, error: bool = False,
                 batch: int = 1) -> None:
     """Called by the worker executor around user code. Doubles as the
-    always-on task-event record: gated on the task-events flag, not the
-    tracing flag (only the submit->exec flow EDGES are tracing-only)."""
+    always-on task-event record: recorded when EITHER flag is on — both
+    RAY_TPU_TRACE_TASKS=0 and RAY_TPU_TASK_EVENTS=0 are needed to stop
+    it (only the submit->exec flow EDGES are tracing-only)."""
     if not (_ENABLED or _EVENTS):
         return
     events.record("trace", "exec", ph="X", task=task_hex, kind=kind,

@@ -9,6 +9,10 @@ bench.py outside pytest.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Spawned WORKER processes also pin jax to CPU (runtime/worker.py main):
+# the axon TPU plugin ignores JAX_PLATFORMS, and a flaky/absent tunnel
+# must never decide whether CPU-only tests pass.
+os.environ["RAY_TPU_FORCE_JAX_PLATFORM"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -490,3 +490,73 @@ def test_read_webdataset_subdir_keys_and_pinned_schema(tmp_path):
     assert [r["__key__"] for r in rows] == ["a/0001", "b/0001", "b/0002"]
     assert rows[0]["jpg"] == b"A1" and rows[1]["jpg"] == b"B1"
     assert rows[2]["cls"] is None           # pinned schema, None-filled
+
+
+# --- ragged-column honesty (round 5) ------------------------------------
+
+
+def test_ragged_column_block_build():
+    """Per-row variable shapes build 1-D object columns instead of
+    crashing (numpy>=1.24 raises on inhomogeneous asarray); uniform
+    sequences keep the dense tensor path (see data/block.py contract)."""
+    from ray_tpu.data.block import block_from_rows
+    b = block_from_rows([
+        {"k": 1, "toks": [1, 2, 3], "name": "aa"},
+        {"k": 0, "toks": [4], "name": "b"},
+        {"k": 2, "toks": [5, 6], "name": "ccc"},
+    ])
+    assert b["toks"].dtype == object and b["toks"].ndim == 1
+    assert b["name"].dtype.kind == "U"       # strings stay vectorized
+    assert b["k"].dtype.kind == "i"
+    dense = block_from_rows([{"v": [1, 2]}, {"v": [3, 4]}])
+    assert dense["v"].shape == (2, 2)        # tensor path intact
+
+
+def test_ragged_and_string_survive_sort_join_shuffle():
+    ds = rd.from_items([
+        {"k": i, "toks": list(range(i % 3 + 1)), "name": f"row{i}"}
+        for i in range(12)
+    ], block_size=4)
+    # sort round-trip: ragged + string payloads follow their rows
+    rows = rd.from_items(list(reversed(ds.take_all()))) \
+        .sort("k").take_all()
+    assert [r["k"] for r in rows] == list(range(12))
+    assert rows[4]["toks"] == [0, 1] and rows[4]["name"] == "row4"
+    # shuffle round-trip preserves row identity
+    shuffled = ds.random_shuffle(seed=7).take_all()
+    assert sorted(r["k"] for r in shuffled) == list(range(12))
+    for r in shuffled:
+        assert r["toks"] == list(range(r["k"] % 3 + 1))
+        assert r["name"] == f"row{r['k']}"
+    # join: ragged column rides as payload through the hash join; "vec"
+    # is a uniform 2-vector (the dense tensor path) on the right side
+    right = rd.from_items(
+        [{"k": i, "extra": [9] * (i // 2 % 2 + 1), "vec": [i, i + 1]}
+         for i in range(0, 12, 2)])
+    joined = ds.join(right, on="k").take_all()
+    assert len(joined) == 6
+    for r in joined:
+        assert r["toks"] == list(range(r["k"] % 3 + 1))
+        assert r["extra"] == [9] * (r["k"] // 2 % 2 + 1)
+        assert list(r["vec"]) == [r["k"], r["k"] + 1]
+    # left join: unmatched rows fill ragged AND tensor right columns
+    # with None (a dense [n,2] column cannot hold a missing row)
+    left = ds.join(right, on="k", join_type="left").take_all()
+    assert len(left) == 12
+    for r in left:
+        if r["k"] % 2 == 1:
+            assert r["extra"] is None and r["vec"] is None
+        else:
+            assert r["extra"] == [9] * (r["k"] // 2 % 2 + 1)
+            assert list(r["vec"]) == [r["k"], r["k"] + 1]
+
+
+def test_ragged_across_blocks_concat():
+    """A column dense-by-luck in one block and ragged in another must
+    concat into one honest object column."""
+    from ray_tpu.data.block import block_concat, block_from_rows
+    b1 = block_from_rows([{"v": [1, 2]}, {"v": [3, 4]}])   # dense (2,2)
+    b2 = block_from_rows([{"v": [5]}, {"v": [6, 7, 8]}])   # object
+    out = block_concat([b1, b2])
+    assert out["v"].dtype == object and out["v"].ndim == 1
+    assert list(out["v"][0]) == [1, 2] and out["v"][2] == [5]
