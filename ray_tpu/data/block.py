@@ -91,9 +91,14 @@ def block_concat(blocks: List[Block]) -> Block:
         except ValueError:
             # a column ragged ACROSS blocks (dense [n,3] in one part,
             # [m,4] or object in another): fall back to one object row
-            # per element, same contract as _to_array
-            out[k] = object_array(
-                [v for p in parts for v in list(p)])
+            # per element. Dense parts convert via tolist() so the
+            # column holds plain Python values THROUGHOUT — mixing
+            # ndarray rows with list rows would make `row == [...]`
+            # comparisons blow up for some rows only.
+            rows = []
+            for p in parts:
+                rows.extend(list(p) if p.dtype == object else p.tolist())
+            out[k] = object_array(rows)
     return out
 
 

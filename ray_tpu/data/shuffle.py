@@ -276,9 +276,12 @@ def _join_partition(key: str, join_type: str, suffix: str, n_left: int,
                     *parts: Block) -> Block:
     """One output partition: concat this partition's left and right
     sub-blocks, join them. Runs inside a worker task. `r_schema`
-    ({col: dtype}) is the right side's schema, threaded through so a
-    left join emits the right columns (as nulls) even in partitions —
-    or whole joins — where the right side has no rows at all."""
+    ({col: (dtype, ndim)}) is the right side's schema, threaded through
+    so a left join emits the right columns (as nulls) even in
+    partitions — or whole joins — where the right side has no rows at
+    all. ndim matters: a 2-D tensor column's nulls must be None (object
+    rows), not NaN, and a zero-row 1-D reconstruction would lose
+    that."""
     left = [p for p in parts[:n_left] if block_num_rows(p)]
     # keep zero-row right parts: they carry the right-side SCHEMA, which
     # a left join needs to emit null columns in right-empty partitions
@@ -288,7 +291,8 @@ def _join_partition(key: str, join_type: str, suffix: str, n_left: int,
     rb = block_concat(nonempty_r) if nonempty_r else (
         right[0] if right else None)
     if rb is None and r_schema:
-        rb = {c: np.empty(0, dtype=dt) for c, dt in r_schema.items()}
+        rb = {c: np.empty((0,) * max(nd, 1), dtype=dt)
+              for c, (dt, nd) in r_schema.items()}
     return join_blocks(lb, rb, key, join_type, suffix)
 
 
@@ -301,10 +305,11 @@ def distributed_join(left: Iterator[Block], right: Iterator[Block],
 
     l_refs = [ray_tpu.put(b) for b in left if block_num_rows(b)]
     r_refs = []
-    r_schema = None     # first right block's {col: dtype}, rows or not
+    r_schema = None   # first right block's {col: (dtype, ndim)}
     for b in right:
         if r_schema is None and len(b) > 0:
-            r_schema = {c: np.asarray(v).dtype for c, v in b.items()}
+            r_schema = {c: (np.asarray(v).dtype, np.asarray(v).ndim)
+                        for c, v in b.items()}
         if block_num_rows(b):
             r_refs.append(ray_tpu.put(b))
     if not l_refs:

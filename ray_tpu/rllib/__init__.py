@@ -6,13 +6,21 @@ covering both halves of the algorithm matrix: on-policy (PPO) and
 off-policy with a replay-buffer actor (DQN).
 """
 
+from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.bc import BC, BCConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
-from ray_tpu.rllib.env import CartPoleVec, PendulumVec, make_env
+from ray_tpu.rllib.env import (CartPoleVec, MultiCartPoleVec,
+                               PendulumVec, make_env)
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.multi_agent import (MultiAgentPPO,
+                                       MultiAgentPPOConfig,
+                                       make_multi_agent_env)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
 
-__all__ = ["BC", "BCConfig", "DQN", "DQNConfig", "IMPALA",
-           "IMPALAConfig", "PPO", "PPOConfig", "SAC", "SACConfig",
-           "ReplayBuffer", "CartPoleVec", "PendulumVec", "make_env"]
+__all__ = ["APPO", "APPOConfig", "BC", "BCConfig", "DQN", "DQNConfig",
+           "IMPALA", "IMPALAConfig", "MultiAgentPPO",
+           "MultiAgentPPOConfig", "PPO", "PPOConfig", "SAC",
+           "SACConfig", "ReplayBuffer", "CartPoleVec",
+           "MultiCartPoleVec", "PendulumVec", "make_env",
+           "make_multi_agent_env"]

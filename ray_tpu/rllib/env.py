@@ -130,7 +130,40 @@ class PendulumVec:
         return self._obs(), (-cost).astype(np.float32), done
 
 
+class MultiCartPoleVec:
+    """Two-agent cart-pole: each agent balances its OWN pole, with
+    per-agent obs/action/reward/done DICTS — the multi-agent env
+    contract (reference: rllib/env/multi_agent_env.py; the reference's
+    own MultiAgentCartPole example is likewise N independent poles).
+    Vectorized over num_envs per agent."""
+
+    AGENTS = ("agent_0", "agent_1")
+    OBS_DIM = 4
+    N_ACTIONS = 2
+
+    def __init__(self, num_envs: int, seed: int = 0):
+        self.num_envs = num_envs
+        self._envs = {a: CartPoleVec(num_envs, seed + 17 * i)
+                      for i, a in enumerate(self.AGENTS)}
+
+    @property
+    def agents(self):
+        return self.AGENTS
+
+    def reset_all(self):
+        return {a: e.reset_all() for a, e in self._envs.items()}
+
+    def step(self, actions):
+        """actions: {agent: (n,)}. Returns ({agent: obs}, {agent: r},
+        {agent: done}) — each agent's envs auto-reset independently."""
+        obs, rew, done = {}, {}, {}
+        for a, e in self._envs.items():
+            obs[a], rew[a], done[a] = e.step(actions[a])
+        return obs, rew, done
+
+
 ENVS = {"CartPole-v1": CartPoleVec, "Pendulum-v1": PendulumVec}
+MULTI_AGENT_ENVS = {"MultiCartPole-v0": MultiCartPoleVec}
 
 
 def make_env(name: str, num_envs: int, seed: int = 0):

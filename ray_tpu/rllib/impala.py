@@ -148,6 +148,15 @@ class IMPALA:
         self._iter = 0
         self._returns = []
 
+    def _apply_update(self, batch):
+        """One fragment's learner step — the hook APPO swaps for the
+        clipped-surrogate objective (same async pipeline)."""
+        return impala_update(
+            self.params, self.opt_state, batch,
+            lr=self.cfg.lr, gamma=self.cfg.gamma,
+            vf_coef=self.cfg.vf_coef, ent_coef=self.cfg.ent_coef,
+            rho_bar=self.cfg.rho_bar, c_bar=self.cfg.c_bar)
+
     def train(self) -> dict:
         import jax.numpy as jnp
         self._iter += 1
@@ -171,12 +180,8 @@ class IMPALA:
                     # evaluated under the CURRENT params in-update
                     "last_obs": jnp.asarray(frag["last_obs"]),
                 }
-                self.params, self.opt_state, loss, rho = impala_update(
-                    self.params, self.opt_state, batch,
-                    lr=self.cfg.lr, gamma=self.cfg.gamma,
-                    vf_coef=self.cfg.vf_coef,
-                    ent_coef=self.cfg.ent_coef,
-                    rho_bar=self.cfg.rho_bar, c_bar=self.cfg.c_bar)
+                self.params, self.opt_state, loss, rho = \
+                    self._apply_update(batch)
                 losses.append(float(loss))
                 rhos.append(float(rho))
                 if len(frag["episode_returns"]):

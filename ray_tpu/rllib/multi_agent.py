@@ -1,0 +1,227 @@
+"""Multi-agent training: per-agent dict envs through a shared rollout
+collector, one policy per policy id with an agent->policy mapping.
+
+Reference: python/ray/rllib/env/multi_agent_env.py (per-agent
+obs/action/reward dicts) + the multi-agent config surface
+(policies + policy_mapping_fn on AlgorithmConfig.multi_agent). The
+TPU-idiomatic shape: each policy's update stays ONE jitted ppo_update
+over (T, N_agents_mapped * num_envs) — agents sharing a policy batch
+into the same matmul, they don't loop."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import MULTI_AGENT_ENVS
+from ray_tpu.rllib.ppo import (_gae, init_policy, policy_forward,
+                               ppo_update)
+
+
+def make_multi_agent_env(name: str, num_envs: int, seed: int = 0):
+    try:
+        return MULTI_AGENT_ENVS[name](num_envs, seed)
+    except KeyError:
+        raise ValueError(
+            f"unknown multi-agent env {name!r}; register it in "
+            f"ray_tpu.rllib.env.MULTI_AGENT_ENVS")
+
+
+@ray_tpu.remote
+class MultiAgentEnvRunner:
+    """Shared rollout collector: ONE env step advances every agent;
+    actions come from each agent's mapped policy (reference:
+    rllib/env/multi_agent_env_runner.py sample())."""
+
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int,
+                 seed: int, mapping: Dict[str, str]):
+        try:
+            jax.config.update("jax_platforms", "cpu")  # tiny MLP steps
+        except Exception:
+            pass
+        self.env = make_multi_agent_env(env_name, num_envs, seed)
+        self.rollout_len = rollout_len
+        self.mapping = mapping
+        self.obs = self.env.reset_all()
+        self.key = jax.random.PRNGKey(seed)
+        self.ep_ret = {a: np.zeros(num_envs, np.float32)
+                       for a in self.env.agents}
+        self.done_returns = {a: [] for a in self.env.agents}
+
+        @jax.jit
+        def act(params, obs, key):
+            logits, value = policy_forward(params, obs)
+            a = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[
+                np.arange(obs.shape[0]), a]
+            return a, logp, value
+        self._act = act
+        self._forward = jax.jit(policy_forward)
+
+    def sample(self, params_by_policy: Dict[str, dict]
+               ) -> Dict[str, Dict[str, np.ndarray]]:
+        """One fragment per agent: {agent: {obs (T,N,D), actions, logp,
+        values, rewards, dones (T,N), last_value, last_obs,
+        episode_returns}}."""
+        agents = self.env.agents
+        out = {a: {k: [] for k in ("obs", "actions", "logp", "values",
+                                   "rewards", "dones")}
+               for a in agents}
+        for _ in range(self.rollout_len):
+            actions = {}
+            for a in agents:
+                self.key, k = jax.random.split(self.key)
+                act, logp, v = self._act(
+                    params_by_policy[self.mapping[a]], self.obs[a], k)
+                actions[a] = np.asarray(act)
+                out[a]["obs"].append(self.obs[a])
+                out[a]["actions"].append(actions[a])
+                out[a]["logp"].append(np.asarray(logp))
+                out[a]["values"].append(np.asarray(v))
+            obs2, rew, done = self.env.step(actions)
+            for a in agents:
+                out[a]["rewards"].append(rew[a])
+                out[a]["dones"].append(done[a].astype(np.float32))
+                self.ep_ret[a] += rew[a]
+                if done[a].any():
+                    for i in np.where(done[a])[0]:
+                        self.done_returns[a].append(
+                            float(self.ep_ret[a][i]))
+                        self.ep_ret[a][i] = 0.0
+                    self.done_returns[a] = self.done_returns[a][-100:]
+            self.obs = obs2
+        frags = {}
+        for a in agents:
+            _, last_v = map(np.asarray, self._forward(
+                params_by_policy[self.mapping[a]], self.obs[a]))
+            frag = {k: np.stack(v) for k, v in out[a].items()}
+            frag["last_value"] = last_v
+            frag["last_obs"] = np.asarray(self.obs[a])
+            frag["episode_returns"] = np.array(
+                self.done_returns[a], np.float32)
+            frags[a] = frag
+        return frags
+
+
+@dataclass
+class MultiAgentPPOConfig:
+    env: str = "MultiCartPole-v0"
+    num_env_runners: int = 1
+    num_envs_per_runner: int = 8
+    rollout_len: int = 128
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lam: float = 0.95
+    clip: float = 0.2
+    epochs: int = 4
+    minibatches: int = 4
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+    # agent id -> policy id; None = one INDEPENDENT policy per agent.
+    # Mapping several agents onto one id trains a SHARED policy on
+    # their pooled experience (reference: policy_mapping_fn).
+    policy_mapping: Optional[Dict[str, str]] = None
+    runner_options: dict = field(default_factory=dict)
+
+
+class MultiAgentPPO:
+    """Independent/shared-policy PPO over a multi-agent env."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        import optax
+        self.cfg = config
+        env = make_multi_agent_env(config.env, 1, 0)
+        self.agents = tuple(env.agents)
+        self.mapping = dict(config.policy_mapping or
+                            {a: a for a in self.agents})
+        missing = [a for a in self.agents if a not in self.mapping]
+        if missing:
+            raise ValueError(f"policy_mapping lacks agents: {missing}")
+        unknown = [a for a in self.mapping if a not in self.agents]
+        if unknown:
+            raise ValueError(
+                f"policy_mapping names unknown agents {unknown}; env "
+                f"{config.env!r} has {list(self.agents)}")
+        self.policies = tuple(sorted(set(self.mapping.values())))
+        self.params: Dict[str, dict] = {}
+        self.opt_state: Dict[str, object] = {}
+        self._opt = optax.adam(config.lr)
+        for i, pid in enumerate(self.policies):
+            self.params[pid] = init_policy(
+                jax.random.PRNGKey(config.seed + i), env.OBS_DIM,
+                env.N_ACTIONS, config.hidden)
+            self.opt_state[pid] = self._opt.init(self.params[pid])
+        self.key = jax.random.PRNGKey(config.seed + 1)
+        self.runners = [
+            MultiAgentEnvRunner.options(**config.runner_options).remote(
+                config.env, config.num_envs_per_runner,
+                config.rollout_len, config.seed + 100 + i, self.mapping)
+            for i in range(config.num_env_runners)]
+        self._iter = 0
+
+    def train(self) -> dict:
+        import jax.numpy as jnp
+        self._iter += 1
+        host = {pid: jax.device_get(p)
+                for pid, p in self.params.items()}
+        results = ray_tpu.get(
+            [r.sample.remote(host) for r in self.runners], timeout=300)
+        rewards = {}
+        losses = {}
+        for pid in self.policies:
+            # pool every fragment of every agent mapped to this policy
+            # along the env axis -> ONE (T, N_total) update
+            frags = [res[a] for res in results for a in self.agents
+                     if self.mapping[a] == pid]
+            cat = {k: np.concatenate([f[k] for f in frags], axis=1)
+                   for k in ("obs", "actions", "logp", "rewards",
+                             "dones", "values")}
+            last_v = np.concatenate([f["last_value"] for f in frags])
+            advs, rets = _gae(jnp.asarray(cat["rewards"]),
+                              jnp.asarray(cat["values"]),
+                              jnp.asarray(cat["dones"]),
+                              jnp.asarray(last_v),
+                              self.cfg.gamma, self.cfg.lam)
+            batch = {"obs": jnp.asarray(cat["obs"]),
+                     "actions": jnp.asarray(cat["actions"]),
+                     "logp": jnp.asarray(cat["logp"]),
+                     "advantages": advs, "returns": rets}
+            self.key, k = jax.random.split(self.key)
+            self.params[pid], self.opt_state[pid], loss = ppo_update(
+                self.params[pid], self.opt_state[pid], batch, k,
+                lr=self.cfg.lr, clip=self.cfg.clip,
+                epochs=self.cfg.epochs,
+                minibatches=self.cfg.minibatches)
+            losses[pid] = float(loss)
+        for a in self.agents:
+            ep = np.concatenate(
+                [res[a]["episode_returns"] for res in results
+                 if len(res[a]["episode_returns"])]) \
+                if any(len(res[a]["episode_returns"])
+                       for res in results) else np.array([0.0])
+            rewards[a] = float(ep.mean())
+        return {
+            "training_iteration": self._iter,
+            "episode_reward_mean": float(np.mean(list(rewards.values()))),
+            "agent_reward_mean": rewards,
+            "policy_loss": losses,
+            "timesteps_this_iter": int(
+                self.cfg.num_env_runners * self.cfg.num_envs_per_runner
+                * self.cfg.rollout_len * len(self.agents)),
+        }
+
+    def get_policy_params(self, policy_id: Optional[str] = None):
+        if policy_id is None and len(self.policies) == 1:
+            policy_id = self.policies[0]
+        return jax.device_get(self.params[policy_id])
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -11,11 +11,14 @@ train_fn as mesh axes (ray_tpu.parallel), not as framework protocols.
 from ray_tpu.train.api import (Checkpoint, CheckpointConfig, FailureConfig,
                                Result, RunConfig, ScalingConfig,
                                ensure_jax_distributed, get_context, report)
+from ray_tpu.train.boosting import (BoostingConfig, BoostingModel,
+                                    BoostingTrainer)
 from ray_tpu.train.trainer import (JaxTrainer, SklearnTrainer,
                                    TorchTrainer,
                                    get_controller)
 
 __all__ = [
+    "BoostingConfig", "BoostingModel", "BoostingTrainer",
     "Checkpoint", "CheckpointConfig", "FailureConfig", "Result",
     "RunConfig", "ScalingConfig", "SklearnTrainer",
     "ensure_jax_distributed", "get_context", "report",

@@ -560,3 +560,22 @@ def test_ragged_across_blocks_concat():
     out = block_concat([b1, b2])
     assert out["v"].dtype == object and out["v"].ndim == 1
     assert list(out["v"][0]) == [1, 2] and out["v"][2] == [5]
+
+
+def test_left_join_schema_only_right_keeps_tensor_nulls_none():
+    """r_schema reconstruction (right side has schema but zero rows in
+    reach) must preserve ndim: a 2-D tensor column's nulls are None,
+    never NaN floats."""
+    from ray_tpu.data.block import block_from_rows
+    from ray_tpu.data.shuffle import _join_partition
+    lb = block_from_rows([{"k": 1, "a": 10}, {"k": 2, "a": 20}])
+    out = _join_partition(
+        "k", "left", "_r", 1,
+        {"k": (np.dtype(np.int64), 1),
+         "w": (np.dtype(np.float64), 1),
+         "vec": (np.dtype(np.float64), 2)},
+        lb)
+    assert list(out["a"]) == [10, 20]
+    assert np.isnan(out["w"]).all()          # 1-D numeric: NaN
+    assert out["vec"].dtype == object        # 2-D tensor: None rows
+    assert all(v is None for v in out["vec"])
