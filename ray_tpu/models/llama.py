@@ -66,6 +66,15 @@ class LlamaConfig:
     # runs the exp/logsumexp passes at the faster bf16 VPU rate (loss error
     # ~1e-2 absolute — fine for throughput-oriented runs).
     logits_dtype: str = "float32"
+    # Fused cross-entropy: tokens per sequence chunk. 0 = classic path
+    # (materialize the full (b, s, vocab) logits). >0 = the loss scans
+    # seq chunks, computing each chunk's (b, ce_chunk, vocab) logits,
+    # reducing to scalars, and REMATing the chunk on backward — the
+    # full logits tensor never exists in HBM (at 7B shapes b4 s4096
+    # v32000 that's ~1 GiB bf16 + softmax temporaries, the largest
+    # single activation in the step). Costs one extra lm_head matmul
+    # per chunk on backward.
+    ce_chunk: int = 0
     attn_impl: str = "auto"        # auto | reference | flash | flash_interpret | ring
     attn_block_q: int = 128        # flash kernel tile sizes (MXU-multiple)
     attn_block_k: int = 128
@@ -270,10 +279,12 @@ def _remat(layer, cfg: LlamaConfig):
     return jax.checkpoint(layer, policy=policy)
 
 
-def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
-            mesh: Optional[Mesh] = None,
-            axes: MeshAxes = MeshAxes()) -> jax.Array:
-    """tokens: (batch, seq) int32 -> logits (batch, seq, vocab) float32."""
+def forward_hidden(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+                   mesh: Optional[Mesh] = None,
+                   axes: MeshAxes = MeshAxes()) -> jax.Array:
+    """tokens: (batch, seq) int32 -> final NORMED hidden states
+    (batch, seq, dim) — the pre-lm_head activations (the fused CE
+    consumes these chunk by chunk instead of full logits)."""
     b, s = tokens.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -309,9 +320,15 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
 
     step = _remat(layer, cfg)
     x, _ = lax.scan(step, x, params["layers"])
-    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.dtype(cfg.logits_dtype))
-    return logits
+    return _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+            mesh: Optional[Mesh] = None,
+            axes: MeshAxes = MeshAxes()) -> jax.Array:
+    """tokens: (batch, seq) int32 -> logits (batch, seq, vocab)."""
+    x = forward_hidden(params, tokens, cfg, mesh, axes)
+    return (x @ params["lm_head"]).astype(jnp.dtype(cfg.logits_dtype))
 
 
 def cross_entropy(logits: jax.Array, batch: dict) -> jax.Array:
@@ -333,9 +350,64 @@ def cross_entropy(logits: jax.Array, batch: dict) -> jax.Array:
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
+def fused_cross_entropy(x: jax.Array, lm_head: jax.Array, batch: dict,
+                        chunk: int, logits_dtype) -> jax.Array:
+    """Chunked logits-free cross-entropy: scan seq chunks, projecting
+    each (b, chunk, dim) -> (b, chunk, vocab), reducing to the masked
+    NLL sums, and dropping the chunk logits. jax.checkpoint on the
+    chunk body recomputes them on backward, so the peak live logits
+    tensor is (b, chunk, vocab) instead of (b, s, vocab) — the classic
+    big-vocab fusion (vocab stays shardable over tensor: the max /
+    sumexp reductions cross the vocab axis, GSPMD inserts the psums).
+    """
+    b, s, d = x.shape
+    n = s // chunk
+    dt = jnp.dtype(logits_dtype)
+    targets = batch["targets"]
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones((b, s), jnp.float32)
+    # (n, b, chunk, ...) scan layout
+    xc = jnp.moveaxis(x.reshape(b, n, chunk, d), 1, 0)
+    tc = jnp.moveaxis(targets.reshape(b, n, chunk), 1, 0)
+    mc = jnp.moveaxis(mask.astype(jnp.float32).reshape(b, n, chunk),
+                      1, 0)
+
+    @jax.checkpoint
+    def body(acc, inp):
+        xch, tch, mch = inp
+        logits = (xch @ lm_head).astype(dt)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        sumexp = jnp.sum(jnp.exp(logits - m), axis=-1,
+                         dtype=jnp.float32)
+        logz = m[..., 0].astype(jnp.float32) + jnp.log(sumexp)
+        gold = jnp.take_along_axis(
+            logits, tch[..., None], axis=-1)[..., 0]
+        nll = logz - gold.astype(jnp.float32)
+        tot, cnt = acc
+        return (tot + jnp.sum(nll * mch), cnt + jnp.sum(mch)), None
+
+    (tot, cnt), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (xc, tc, mc))
+    return tot / jnp.maximum(cnt, 1.0)
+
+
 def loss_fn(params: dict, batch: dict, cfg: LlamaConfig,
             mesh: Optional[Mesh] = None,
             axes: MeshAxes = MeshAxes()) -> jax.Array:
     """batch: {"tokens": (b, s), "targets": (b, s), "mask": optional}."""
+    s = batch["tokens"].shape[1]
+    if cfg.ce_chunk > 0:
+        if s % cfg.ce_chunk:
+            # silently materializing the full logits here would undo
+            # the exact memory saving the flag was set for
+            raise ValueError(
+                f"ce_chunk={cfg.ce_chunk} must divide seq len {s}")
+        if s > cfg.ce_chunk:
+            x = forward_hidden(params, batch["tokens"], cfg, mesh, axes)
+            return fused_cross_entropy(x, params["lm_head"], batch,
+                                       cfg.ce_chunk, cfg.logits_dtype)
+        # s == ce_chunk: one chunk IS the full logits — classic path
     logits = forward(params, batch["tokens"], cfg, mesh, axes)
     return cross_entropy(logits, batch)

@@ -47,6 +47,28 @@ def policy_forward(params, obs):
 
 # --- rollout actor ------------------------------------------------------
 
+def make_act_fns():
+    """CPU-pinned jitted (act, forward) pair shared by every rollout
+    collector (single- and multi-agent). Rollout policy steps are tiny
+    MLP batches issued one at a time — accelerator dispatch latency
+    dominates any compute win, so runners pin to the host CPU (the
+    reference's env runners are CPU-placed for the same reason)."""
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:   # backend already initialized in this worker
+        pass
+
+    @jax.jit
+    def act(params, obs, key):
+        logits, value = policy_forward(params, obs)
+        a = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            np.arange(obs.shape[0]), a]
+        return a, logp, value
+
+    return act, jax.jit(policy_forward)
+
+
 @ray_tpu.remote
 class EnvRunner:
     """Collects fixed-length rollout fragments with the current policy
@@ -54,30 +76,13 @@ class EnvRunner:
 
     def __init__(self, env_name: str, num_envs: int, rollout_len: int,
                  seed: int):
-        try:
-            # Rollout policy steps are tiny MLP batches issued one at a
-            # time — accelerator dispatch latency dominates any compute
-            # win, so runners pin to the host CPU (the reference's env
-            # runners are CPU-placed for the same reason).
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:  # backend already initialized in this worker
-            pass
         self.env = make_env(env_name, num_envs, seed)
         self.rollout_len = rollout_len
         self.obs = self.env.reset_all()
         self.key = jax.random.PRNGKey(seed)
         self.ep_ret = np.zeros(num_envs, np.float32)
         self.done_returns = deque(maxlen=100)
-
-        @jax.jit
-        def act(params, obs, key):
-            logits, value = policy_forward(params, obs)
-            a = jax.random.categorical(key, logits)
-            logp = jax.nn.log_softmax(logits)[
-                np.arange(obs.shape[0]), a]
-            return a, logp, value
-        self._act = act
-        self._forward = jax.jit(policy_forward)
+        self._act, self._forward = make_act_fns()
 
     def sample(self, params) -> Dict[str, np.ndarray]:
         T, N = self.rollout_len, self.env.num_envs

@@ -7,10 +7,12 @@ collective tracker). xgboost isn't vendored here, so this is a NATIVE
 histogram GBDT with the same distribution strategy xgboost itself uses
 (approx/hist algorithm): each worker holds a row shard, computes
 per-(node, feature, bin) gradient/hessian histograms locally, and the
-driver SUMS histograms across workers — an exact allreduce, so the
-distributed model is bit-identical to single-worker training on the
-concatenated data. Rows never move after sharding; only (nodes x
-features x bins) histograms cross the object plane per tree level.
+driver SUMS histograms across workers — an exact-sum allreduce, so the
+distributed model matches single-worker training on the concatenated
+data up to float64 summation order (shard-partial sums reassociate
+additions; a near-tie split gain could in principle resolve
+differently). Rows never move after sharding; only (nodes x features x
+bins) histograms cross the object plane per tree level.
 
 Supported: squared-error regression and logistic binary classification,
 quantile-binned features (<=256 bins -> uint8 storage), depth-wise tree
@@ -214,7 +216,6 @@ class BoostingConfig:
     min_child_weight: float = 1.0
     max_bins: int = MAX_BINS
     num_workers: int = 2
-    seed: int = 0
     worker_options: dict = field(default_factory=dict)
 
 
@@ -281,8 +282,9 @@ def _make_bins(X: np.ndarray, max_bins: int) -> List[np.ndarray]:
 
 class BoostingTrainer:
     """Distributed GBDT: rows sharded across worker actors, histograms
-    merged driver-side per tree level. Exact: the model equals
-    single-worker training on the concatenated data."""
+    merged driver-side per tree level. The model equals single-worker
+    training on the concatenated data (up to float summation order in
+    the histogram merge)."""
 
     def __init__(self, config: BoostingConfig,
                  train_set: Tuple[np.ndarray, np.ndarray],
@@ -317,6 +319,17 @@ class BoostingTrainer:
 
         trees: List[_Tree] = []
         history: List[dict] = []
+        # validation state kept INCREMENTALLY (bin once, add each new
+        # tree's contribution) — re-predicting the growing ensemble per
+        # round would be O(rounds^2) tree applications
+        if self.valid is not None:
+            Xv = np.asarray(self.valid[0], np.float64)
+            yv = np.asarray(self.valid[1], np.float64)
+            xb_v = np.empty(Xv.shape, np.uint8)
+            for f in range(Xv.shape[1]):
+                xb_v[:, f] = np.searchsorted(
+                    bin_edges[f], Xv[:, f], side="left")
+            valid_margin = np.full(len(Xv), base, np.float64)
         for rnd in range(cfg.num_boost_round):
             ray_tpu.get([w.start_round.remote() for w in workers],
                         timeout=300)
@@ -350,12 +363,10 @@ class BoostingTrainer:
                 sum(m * c for m, c in outs) / sum(c for _, c in outs))
             row = {"round": rnd, "train_metric": train_metric}
             if self.valid is not None:
-                model = BoostingModel(trees, bin_edges, cfg.objective,
-                                      base, cfg.learning_rate)
-                vm = _metric(cfg.objective,
-                             model.predict_margin(self.valid[0]),
-                             np.asarray(self.valid[1], np.float64))
-                row["valid_metric"] = vm
+                valid_margin += cfg.learning_rate * \
+                    tree.apply_binned(xb_v)
+                row["valid_metric"] = _metric(
+                    cfg.objective, valid_margin, yv)
             history.append(row)
         for w in workers:
             try:

@@ -94,6 +94,22 @@ def main():
                                         attn_block_k=2048), 8),
         ("full_b8_s4096_b4", base_cfg(attn_block_q=1024, attn_block_k=1024,
                                       max_seq_len=4096), 4),
+        # round-5 mechanism: fused chunked CE — the (b, s, 32000)
+        # logits never materialize; frees ~1 GiB at b4 s4096 and cuts
+        # the loss path's HBM traffic (cost: lm_head recompute per
+        # chunk on bwd)
+        ("fusedce1024_b4_s4096", base_cfg(
+            logits_dtype="bfloat16", max_seq_len=4096,
+            ce_chunk=1024, **big), 4),
+        ("fusedce512_b4_s4096", base_cfg(
+            logits_dtype="bfloat16", max_seq_len=4096,
+            ce_chunk=512, **big), 4),
+        ("fusedce1024_b8_s4096", base_cfg(
+            logits_dtype="bfloat16", max_seq_len=4096,
+            ce_chunk=1024, **big), 8),
+        ("fusedce2048_b4_s4096", base_cfg(
+            logits_dtype="bfloat16", max_seq_len=4096,
+            ce_chunk=2048, **big), 4),
     ]
     if len(sys.argv) > 1:
         names = set(sys.argv[1].split(","))
