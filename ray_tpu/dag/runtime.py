@@ -158,8 +158,22 @@ class _Collective:
             for ch in self.down:
                 ch.write(err, ERROR)
             return (ERROR, err)
-        red = _tree_reduce(self.op, [value] + contribs)
-        ser = serialize(red)
+        try:
+            red = _tree_reduce(self.op, [value] + contribs)
+            ser = serialize(red)
+        except BaseException as e:  # noqa: BLE001 — reduce failed
+            # e.g. mismatched pytree keys: the leaves are all parked on
+            # their down channels — broadcast the failure so they raise
+            # it this round instead of blocking for collective timeout_s
+            # with the group desynced
+            try:
+                frame = dumps_oob(e)
+            except Exception:   # unpicklable exception payload
+                frame = dumps_oob(RuntimeError(
+                    f"{type(e).__name__}: {e}"))
+            for ch in self.down:
+                ch.write(frame, ERROR)
+            return (ERROR, frame)
         for ch in self.down:
             ch.write(ser, DATA)
         return (DATA, ser)

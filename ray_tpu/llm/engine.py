@@ -36,6 +36,40 @@ class KVHandoffError(RuntimeError):
     request — never the shared scheduler loop."""
 
 
+def engine_metrics() -> dict:
+    """Get-or-create the engine's request-phase histograms (shared
+    process registry; every engine in the process observes into the
+    same series, and worker processes push them to the head via
+    util/metrics.push_loop). Catalog:
+
+      llm_queue_s        submit -> slot admission (waiting for a slot)
+      llm_ttft_device_s  prefill device compute (block_until_ready)
+      llm_ttft_wall_s    submit -> first token, wall clock
+      llm_tpot_s         decode wall time per output token
+      llm_batch_size     active decode slots per step block
+    """
+    from ray_tpu.util import metrics as m
+    return {
+        "queue": m.Histogram(
+            "llm_queue_s",
+            "Wait from request submission to slot admission"),
+        "ttft_device": m.Histogram(
+            "llm_ttft_device_s",
+            "Device compute time producing the first token (prefill "
+            "forward + cache write, block_until_ready-bounded)"),
+        "ttft_wall": m.Histogram(
+            "llm_ttft_wall_s",
+            "Wall time from submission to first token"),
+        "tpot": m.Histogram(
+            "llm_tpot_s", "Decode wall time per output token",
+            boundaries=(.0005, .001, .0025, .005, .01, .025, .05, .1,
+                        .25, .5, 1, 2.5)),
+        "batch": m.Histogram(
+            "llm_batch_size", "Active decode slots per step block",
+            boundaries=(1, 2, 4, 8, 16, 32, 64, 128, 256)),
+    }
+
+
 @dataclass
 class _Request:
     tokens: List[int]                       # prompt (token ids)
@@ -51,7 +85,9 @@ class _Request:
     fut: Optional[asyncio.Future] = None
     stream: Optional[asyncio.Queue] = None
     submitted: float = field(default_factory=time.monotonic)
+    admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
+    prefill_device_s: float = 0.0           # block_until_ready-bounded
     # KV computed by a remote prefill engine (disaggregated serving):
     # {"k","v": (layers, bucket, kvh, hd) numpy, "logits": (vocab,)}
     prefilled: Optional[dict] = None
@@ -116,9 +152,24 @@ class LLMEngine:
         self.steps_per_sync = max(1, steps_per_sync)
         self._loop_task: Optional[asyncio.Task] = None
         self._stopped = False
-        self.stats = {"requests": 0, "tokens_generated": 0,
-                      "ttft_sum": 0.0, "ttft_count": 0,
-                      "cache_len": self._cache_len}
+        # Request-phase telemetry rides the metrics registry (tagged
+        # histograms, pushed to the head from worker processes); the
+        # scalar counters below feed the legacy `stats` surface.
+        self._m = engine_metrics()
+        self._requests = 0
+        self._tokens_generated = 0
+        self._ttft_sum = 0.0
+        self._ttft_count = 0
+
+    @property
+    def stats(self) -> dict:
+        """Scalar engine counters (the per-phase distributions live in
+        the metrics registry — see engine_metrics())."""
+        return {"requests": self._requests,
+                "tokens_generated": self._tokens_generated,
+                "ttft_sum": self._ttft_sum,
+                "ttft_count": self._ttft_count,
+                "cache_len": self._cache_len}
 
     def _grow_cache(self, need: int) -> None:
         """Double the per-slot KV length (bucketed) until >= need,
@@ -143,7 +194,6 @@ class LLMEngine:
             k, v = jax.device_put(k, s), jax.device_put(v, s)
         self._cache = {"k": k, "v": v, "length": c["length"]}
         self._cache_len = new_len
-        self.stats["cache_len"] = new_len
 
     # --- public API -----------------------------------------------------
 
@@ -241,7 +291,7 @@ class LLMEngine:
                      top_p=float(top_p), top_k=int(top_k), stop=stop,
                      prefilled=prefilled)
         self._waiting.put_nowait(r)
-        self.stats["requests"] += 1
+        self._requests += 1
         self._ensure_loop()
         return r
 
@@ -327,9 +377,13 @@ class LLMEngine:
                     temps[i] = self._slots[i].temperature
                     top_ps[i] = self._slots[i].top_p
                     top_ks[i] = self._slots[i].top_k
+                t_dec = time.monotonic()
                 out = await loop.run_in_executor(
                     None, self._decode_sync, tokens, temps, top_ps,
                     top_ks, block)
+                self._m["batch"].observe(len(active))
+                self._m["tpot"].observe(
+                    (time.monotonic() - t_dec) / block)
                 for step in range(block):
                     for i in active:
                         r = self._slots[i]
@@ -354,8 +408,11 @@ class LLMEngine:
         Returns the first sampled token. Remotely-prefilled requests
         skip the forward pass: their shipped KV is written straight
         into the slot."""
+        import jax
         import jax.numpy as jnp
         n = len(r.tokens)
+        r.admitted_at = time.monotonic()
+        self._m["queue"].observe(r.admitted_at - r.submitted)
         # Bucketed growth runs HERE (executor thread): padding and
         # re-uploading a multi-GB cache on the event loop would stall
         # every in-flight stream. Admits and decode blocks are awaited
@@ -387,12 +444,20 @@ class LLMEngine:
                 x.free()                # cache write below copies it
                 return arr
 
+            t0 = time.monotonic()
             kv = {"k": jnp.asarray(take(p["k"])),
                   "v": jnp.asarray(take(p["v"]))}
             self._cache = lm.write_prefill_to_cache(
                 self._cache, kv, slot, jnp.int32(n))
+            logits_np = np.asarray(take(p["logits"]))
+            # device TTFT for a disaggregated request is the handoff
+            # resolution + cache write on THIS engine (the prefill
+            # forward ran on the remote tier)
+            jax.block_until_ready(self._cache["k"])
+            r.prefill_device_s = time.monotonic() - t0
             self._slots[slot] = r
-            return self._sample_one(np.asarray(take(p["logits"])), r)
+            return self._sample_one(logits_np, r)
+        t0 = time.monotonic()
         if n <= self.buckets[-1]:
             b = self._bucket_for(n)
             padded = lm.pad_prompt(r.tokens, b)
@@ -403,8 +468,14 @@ class LLMEngine:
             logits, kv = self._chunked_prefill(r.tokens)
         self._cache = lm.write_prefill_to_cache(
             self._cache, kv, slot, jnp.int32(n))
+        # block_until_ready bounds the DEVICE portion of TTFT: dispatch
+        # above is async, so the wall clock alone can't attribute a slow
+        # first token to compute vs queueing (round-6 SERVE_BENCH ask)
+        logits_np = np.asarray(logits)
+        jax.block_until_ready(self._cache["k"])
+        r.prefill_device_s = time.monotonic() - t0
         self._slots[slot] = r
-        return self._sample_one(np.asarray(logits), r)
+        return self._sample_one(logits_np, r)
 
     def _chunked_prefill(self, tokens: List[int]):
         """Prompts past the largest bucket stream through
@@ -494,10 +565,15 @@ class LLMEngine:
         """Append one sampled token; finish the request if done."""
         if r.first_token_at is None:
             r.first_token_at = time.monotonic()
-            self.stats["ttft_sum"] += r.first_token_at - r.submitted
-            self.stats["ttft_count"] += 1
+            wall = r.first_token_at - r.submitted
+            self._ttft_sum += wall
+            self._ttft_count += 1
+            self._m["ttft_wall"].observe(wall)
+            # device time is a sub-interval of the wall interval; min()
+            # guards the invariant against clock jitter
+            self._m["ttft_device"].observe(min(r.prefill_device_s, wall))
         r.out.append(tok)
-        self.stats["tokens_generated"] += 1
+        self._tokens_generated += 1
         if r.stream is not None:
             r.stream.put_nowait(tok)
         if r.stop:

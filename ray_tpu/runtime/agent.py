@@ -135,6 +135,7 @@ class NodeAgent:
             "node_stats": self.node_stats,
             "node_timeline": self.node_timeline,
             "report_events": self.report_events,
+            "profile_worker": self.profile_worker,
             "ping": self.ping,
         }
 
@@ -343,6 +344,54 @@ class NodeAgent:
 
     async def ping(self):
         return "pong"
+
+    async def profile_worker(self, pid=None, worker_id=None,
+                             op: str = "profile", duration_s: float = 2.0,
+                             hz: int = 100):
+        """Profile one of this node's processes by pid or worker id —
+        the head fans a pid-targeted profile_target out here (it only
+        knows actors' addresses; agents own the pid -> worker mapping).
+        The agent process itself is profilable by its own pid (where a
+        stuck lease queue or object pull would show up)."""
+        from ray_tpu.util import profiling
+        if op not in ("profile", "dump_stacks"):
+            # defense in depth with the head's check: op is forwarded
+            # as the worker RPC method name
+            return {"found": False, "error": f"unknown profile op {op!r}"}
+        if pid is not None and int(pid) == os.getpid():
+            if op == "dump_stacks":
+                return {"found": True, "pid": os.getpid(),
+                        "stacks": profiling.dump_stacks()}
+            loop = asyncio.get_running_loop()
+            res = await loop.run_in_executor(
+                None, lambda: profiling.profile(duration_s, hz))
+            return {"found": True, "pid": os.getpid(), **res}
+        w = None
+        for cand in self.workers.values():
+            if cand.state == DEAD or cand.addr is None:
+                continue
+            if pid is not None and cand.proc is not None \
+                    and cand.proc.pid == int(pid):
+                w = cand
+                break
+            if worker_id is not None and \
+                    cand.worker_id.hex().startswith(str(worker_id)):
+                w = cand
+                break
+        if w is None:
+            return {"found": False}
+        kw = {} if op == "dump_stacks" else \
+            {"duration_s": duration_s, "hz": hz}
+        try:
+            r = await self.pool.call(w.addr, op,
+                                     timeout=float(duration_s) + 30.0,
+                                     **kw)
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            return {"found": True, "error": f"profile RPC failed: {e}"}
+        r["found"] = True
+        r["worker_id"] = w.worker_id.hex()
+        r["node_id"] = self.node_id.hex()
+        return r
 
     async def node_stats(self):
         return {"node_id": self.node_id,

@@ -185,6 +185,8 @@ class ControlService:
             "get_object_locations": self.get_object_locations,
             "poll_events": self.poll_events,
             "cluster_view": self.cluster_view,
+            "report_metrics": self.report_metrics,
+            "profile_target": self.profile_target,
             "ping": self.ping,
         }
 
@@ -1032,6 +1034,111 @@ class ControlService:
                                   size: int):
         self.object_locations.setdefault(oid, {})[node_id] = size
         return {"ok": True}
+
+    async def report_metrics(self, source: str, text: str) -> dict:
+        """Workers push labelled metric snapshots here (util/metrics.py
+        push_loop); merged into this process's /metrics endpoint so the
+        head serves cluster-wide series."""
+        from ray_tpu.util import metrics as _m
+        _m.merge_remote(str(source), str(text))
+        return {"ok": True}
+
+    # --- cluster-wide profiling -------------------------------------------
+
+    def _resolve_profile_actor(self, target: str):
+        """An actor by name (any namespace) or id-hex prefix. Returns
+        (actor_or_None, error_or_None) — ambiguity is an error, never a
+        silent first-match (profiling the wrong actor misattributes a
+        perf problem)."""
+        named = [self.actors.get(aid)
+                 for (_ns, name), aid in self.named_actors.items()
+                 if name == target]
+        named = [a for a in named if a is not None]
+        if len(named) > 1:
+            return None, (f"actor name {target!r} exists in multiple "
+                          "namespaces — profile by actor id instead")
+        if named:
+            return named[0], None
+        t = target.lower()
+        hits = [a for aid, a in self.actors.items()
+                if t and aid.hex().startswith(t)]
+        if len(hits) > 1:
+            ids = ", ".join(a.actor_id.hex()[:12] for a in hits[:4])
+            return None, (f"actor id prefix {target!r} is ambiguous "
+                          f"({ids}) — use a longer prefix")
+        return (hits[0] if hits else None), None
+
+    async def profile_target(self, target, op: str = "profile",
+                             duration_s: float = 2.0, hz: int = 100):
+        """Profile any live worker/actor from the driver (reference
+        capability: the dashboard's py-spy stack/flamegraph buttons,
+        dashboard/modules/reporter/reporter_agent.py). ``target`` is an
+        actor name, an actor-id hex prefix, or a worker/agent pid;
+        ``op`` is "profile" (sampled folded stacks, util/profiling.py)
+        or "dump_stacks" (one-shot thread dump). The request routes
+        head -> hosting worker directly for actors, head -> every agent
+        for pids."""
+        import math
+        target = str(target)
+        if op not in ("profile", "dump_stacks"):
+            # op becomes the worker RPC method name — never let the
+            # profiling entry point invoke arbitrary handlers
+            return {"error": f"unknown profile op {op!r}"}
+        duration_s = float(duration_s)
+        if not math.isfinite(duration_s):
+            return {"error": f"bad duration {duration_s!r}"}
+        duration_s = min(max(duration_s, 0.0), 120.0)
+        a, amb_err = self._resolve_profile_actor(target)
+        if amb_err is not None:
+            return {"error": amb_err}
+        if a is not None:
+            if a.state != ALIVE or not a.addr:
+                return {"error": f"actor {target!r} is {a.state}, "
+                                 "not profilable"}
+            kw = {} if op == "dump_stacks" else \
+                {"duration_s": duration_s, "hz": hz}
+            try:
+                r = await self.pool.call(tuple(a.addr), op,
+                                         timeout=duration_s + 30.0, **kw)
+            except Exception as e:  # noqa: BLE001 — surface, don't crash
+                return {"error": f"profile RPC to actor failed: {e}"}
+            r["target"] = {
+                "actor_id": a.actor_id.hex(), "name": a.name,
+                "class_name": a.class_name,
+                "node_id": a.node_id.hex() if a.node_id else None}
+            return r
+        try:
+            pid = int(target)
+        except ValueError:
+            return {"error": f"no live actor named {target!r} (and not "
+                             "a pid)"}
+
+        # Concurrent fan-out to every agent: pids are per-host, so the
+        # same number can exist on several nodes (containers restart
+        # pids low) — an ambiguous match must error, not silently
+        # profile whichever node answered first.
+        async def probe(n):
+            try:
+                return n, await self.pool.call(
+                    n.addr, "profile_worker", pid=pid, op=op,
+                    duration_s=duration_s, hz=hz,
+                    timeout=duration_s + 30.0)
+            except Exception:
+                return n, {"found": False}
+
+        alive = [n for n in self.nodes.values() if n.alive]
+        results = await asyncio.gather(*[probe(n) for n in alive])
+        hits = [(n, r) for n, r in results if r.get("found")]
+        if not hits:
+            return {"error": f"no live worker or agent with pid {pid}"}
+        if len(hits) > 1:
+            nodes = ", ".join(n.node_id.hex()[:12] for n, _ in hits)
+            return {"error": f"pid {pid} exists on multiple nodes "
+                             f"({nodes}) — profile by actor id instead"}
+        n, r = hits[0]
+        r.pop("found", None)
+        r.setdefault("target", {"pid": pid, "node_id": n.node_id.hex()})
+        return r
 
     async def report_node_events(self, events: list) -> dict:
         """A stopping node archives its span buffer here so the cluster

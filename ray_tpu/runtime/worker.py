@@ -94,6 +94,27 @@ class WorkerExecutor:
         ctx.server.add_handler("actor_call_batch", self.actor_call_batch)
         ctx.server.add_handler("cancel_task", self.cancel_task)
         ctx.server.add_handler("shutdown_worker", self.shutdown_worker)
+        ctx.server.add_handler("dump_stacks", self.dump_stacks)
+        ctx.server.add_handler("profile", self.profile)
+
+    # --- live profiling (util/profiling.py over the control plane) ----
+
+    async def dump_stacks(self):
+        """One-shot thread dump of this worker process (the driver
+        reaches it via the head's profile_target; reference capability:
+        py-spy dump through dashboard/modules/reporter/)."""
+        from ray_tpu.util import profiling
+        return {"pid": os.getpid(), "stacks": profiling.dump_stacks()}
+
+    async def profile(self, duration_s: float = 2.0, hz: int = 100):
+        """Sample this process's stacks for duration_s at hz; returns
+        folded stacks. Runs on an executor thread so the event loop
+        (and the actors it hosts) keeps serving while being observed."""
+        from ray_tpu.util import profiling
+        loop = asyncio.get_running_loop()
+        res = await loop.run_in_executor(
+            None, lambda: profiling.profile(duration_s, hz))
+        return {"pid": os.getpid(), **res}
 
     # --- common result packaging -----------------------------------------
 
@@ -681,6 +702,21 @@ async def _amain():
     # ray_tpu.get/put from user code).
     from ray_tpu import api
     api._attach_existing(ctx)
+
+    # Head-aggregated metrics: ship this worker's registry (llm/serve
+    # request histograms etc.) to the control service every export
+    # interval, labelled with node/worker identity, so the head
+    # /metrics endpoint serves cluster-wide series (util/metrics.py
+    # push_loop -> control report_metrics -> merge_remote).
+    from ray_tpu.util import metrics as _metrics
+
+    async def _head_call(method, **kw):
+        return await ctx.pool.call(head, method, timeout=10.0, **kw)
+
+    asyncio.ensure_future(_metrics.push_loop(
+        _head_call, source=f"worker:{wid.hex()[:12]}",
+        labels={"node": node_id.hex()[:12], "worker": wid.hex()[:12]},
+        interval_s=ctx.config.metrics_export_interval_s))
 
     await ctx.pool.call(agent, "worker_ready", worker_id=wid, addr=ctx.addr)
     await asyncio.Event().wait()  # serve forever; agent kills us

@@ -326,6 +326,57 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    """One-shot thread dump of a live worker/actor (py-spy-dump
+    analog): resolves the target on the head (actor name, actor-id hex
+    prefix, or worker/agent pid) and prints every thread's stack."""
+    addr = _resolve_address(args)
+    r = _call_head(addr, "profile_target", target=args.target,
+                   op="dump_stacks", timeout=30.0)
+    if not isinstance(r, dict) or r.get("error"):
+        err = r.get("error") if isinstance(r, dict) else repr(r)
+        print(f"stack dump failed: {err}", file=sys.stderr)
+        return 1
+    from ray_tpu.util.profiling import format_stacks
+    tgt = r.get("target") or {}
+    desc = f"pid {r.get('pid', '?')}"
+    if tgt.get("actor_id"):
+        desc += (f"  actor={tgt.get('name') or tgt['actor_id'][:12]}"
+                 f"  class={tgt.get('class_name') or '?'}")
+    print(f"target: {args.target}  ({desc})\n")
+    print(format_stacks(r.get("stacks", [])))
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Sample a live worker/actor's stacks over the control plane and
+    write folded stacks (flamegraph.pl input) or speedscope JSON."""
+    addr = _resolve_address(args)
+    r = _call_head(addr, "profile_target", target=args.target,
+                   op="profile", duration_s=args.duration, hz=args.hz,
+                   timeout=args.duration + 60.0)
+    if not isinstance(r, dict) or r.get("error"):
+        err = r.get("error") if isinstance(r, dict) else repr(r)
+        print(f"profile failed: {err}", file=sys.stderr)
+        return 1
+    from ray_tpu.util import profiling
+    if args.format == "speedscope":
+        doc = profiling.to_speedscope(
+            r, name=f"ray-tpu {args.target} ({args.duration:g}s)")
+        out = json.dumps(doc)
+    else:
+        out = profiling.folded_text(r)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out + "\n")
+        print(f"wrote {args.output}: {r.get('samples', 0)} samples, "
+              f"{len(r.get('folded', {}))} unique stacks "
+              f"(pid {r.get('pid', '?')})")
+    else:
+        print(out)
+    return 0
+
+
 def cmd_timeline(args) -> int:
     """Collect the cluster-wide task/span timeline; write a
     chrome://tracing / Perfetto JSON file (reference: `ray timeline`)."""
@@ -431,6 +482,30 @@ def main(argv=None) -> int:
     pm = sub.add_parser("metrics", help="dump a node's /metrics")
     pm.add_argument("--endpoint", help="host:port (default: latest local)")
     pm.set_defaults(fn=cmd_metrics)
+
+    pk = sub.add_parser("stack",
+                        help="dump a live worker/actor's thread stacks "
+                             "(actor name, actor-id prefix, or pid)")
+    pk.add_argument("target", help="actor name / actor-id hex prefix / "
+                                   "worker pid")
+    pk.add_argument("--address")
+    pk.set_defaults(fn=cmd_stack)
+
+    pp = sub.add_parser("profile",
+                        help="stack-sample a live worker/actor; write "
+                             "folded stacks or speedscope JSON")
+    pp.add_argument("target", help="actor name / actor-id hex prefix / "
+                                   "worker pid")
+    pp.add_argument("--address")
+    pp.add_argument("--duration", type=float, default=5.0,
+                    help="sampling window in seconds")
+    pp.add_argument("--hz", type=int, default=100,
+                    help="samples per second")
+    pp.add_argument("--format", choices=["folded", "speedscope"],
+                    default="folded")
+    pp.add_argument("-o", "--output",
+                    help="write to a file instead of stdout")
+    pp.set_defaults(fn=cmd_profile)
 
     pt = sub.add_parser("timeline",
                         help="dump the cluster task timeline "

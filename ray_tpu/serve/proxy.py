@@ -31,6 +31,22 @@ class _BadRequest(Exception):
         self.code = code
 
 
+def proxy_metrics() -> dict:
+    """Get-or-create the proxy's request-phase histograms (same queue/
+    handler split the llm engine records — see engine_metrics())."""
+    from ray_tpu.util import metrics as m
+    return {
+        "queue": m.Histogram(
+            "serve_proxy_queue_s",
+            "Route refresh + handle submission time before the "
+            "deployment call is in flight", tag_keys=("deployment",)),
+        "handler": m.Histogram(
+            "serve_proxy_handler_s",
+            "Time awaiting the deployment handler's result",
+            tag_keys=("deployment",)),
+    }
+
+
 class HTTPProxy:
     """Actor. Call ``start(host, port)`` once; serves until killed."""
 
@@ -40,6 +56,7 @@ class HTTPProxy:
         self._routes_fetched = 0.0
         self._requests = 0
         self._errors = 0
+        self._m = proxy_metrics()
 
     async def start(self, host: str = "127.0.0.1", port: int = 8000) -> dict:
         self._server = await asyncio.start_server(self._on_conn, host, port)
@@ -216,6 +233,7 @@ class HTTPProxy:
 
     async def _dispatch(self, writer, method, path, headers, body):
         self._requests += 1
+        t_arrive = time.monotonic()
         if path == "/-/healthz":
             return self._respond(writer, 200, {"status": "ok"})
         try:
@@ -252,8 +270,10 @@ class HTTPProxy:
             # SSE token streaming (reference: serve streams LLM responses
             # over HTTP; here the proxy drives the replica's cursor-poll
             # protocol and emits one `data:` event per token)
-            return await self._dispatch_stream(writer, dep, arg)
+            return await self._dispatch_stream(writer, dep, arg,
+                                               t_arrive)
         loop = asyncio.get_running_loop()
+        tags = {"deployment": dep}
         try:
             # Handle routing + submission is the sync caller API — run it on
             # a thread; await the result object on this loop.
@@ -262,14 +282,24 @@ class HTTPProxy:
             ref = await loop.run_in_executor(
                 None, lambda: h.remote(arg) if arg is not None
                 else h.remote())
-            result = await api.get_async(ref, timeout=120.0)
+            t_sent = time.monotonic()
+            # queue: parse + routing + submission; handler: replica time
+            self._m["queue"].observe(t_sent - t_arrive, tags)
+            try:
+                result = await api.get_async(ref, timeout=120.0)
+            finally:
+                # failures and 120s timeouts are the tail the histogram
+                # exists to show — record them, then surface the error
+                self._m["handler"].observe(
+                    time.monotonic() - t_sent, tags)
         except BaseException as e:  # noqa: BLE001
             self._errors += 1
             return self._respond(writer, 500,
                                  {"error": f"{type(e).__name__}: {e}"})
         self._respond(writer, 200, result)
 
-    async def _dispatch_stream(self, writer, dep: str, arg) -> str:
+    async def _dispatch_stream(self, writer, dep: str, arg,
+                               t_arrive: Optional[float] = None) -> str:
         """Server-sent events over the core streaming-return path: one
         streaming call on the deployment's generate_stream generator;
         each produced token is pushed replica -> proxy through the
@@ -302,6 +332,9 @@ class HTTPProxy:
             self._respond(writer, 500,
                           {"error": f"{type(e).__name__}: {e}"})
             return "close"
+        tags = {"deployment": dep}
+        t_sent = time.monotonic()
+        self._m["queue"].observe(t_sent - (t_arrive or t_sent), tags)
         writer.write(b"HTTP/1.1 200 OK\r\n"
                      b"Content-Type: text/event-stream\r\n"
                      b"Cache-Control: no-cache\r\n"
@@ -330,6 +363,9 @@ class HTTPProxy:
                 await writer.drain()
             except (ConnectionResetError, BrokenPipeError):
                 pass
+        finally:
+            # a stream's handler span covers the whole generation
+            self._m["handler"].observe(time.monotonic() - t_sent, tags)
         return "close"
 
     def _respond(self, writer, code: int, payload, close: bool = False):

@@ -18,6 +18,23 @@ from typing import Any, Dict, Optional
 import cloudpickle
 
 
+def replica_metrics() -> dict:
+    """Get-or-create the replica-side request-phase histograms: queue
+    (arrival at the replica -> user code starts, i.e. event-loop /
+    thread-pool scheduling delay) vs handler (user code execution) —
+    the replica half of the proxy's queue/handler split."""
+    from ray_tpu.util import metrics as m
+    return {
+        "queue": m.Histogram(
+            "serve_replica_queue_s",
+            "Delay from request arrival at the replica to user-code "
+            "start", tag_keys=("deployment",)),
+        "handler": m.Histogram(
+            "serve_replica_handler_s",
+            "User handler execution time", tag_keys=("deployment",)),
+    }
+
+
 class Replica:
     """Created by the ServeController with max_concurrency > 1."""
 
@@ -41,6 +58,7 @@ class Replica:
         self._processed = 0
         self._errors = 0
         self._started_at = time.time()
+        self._m = replica_metrics()
         # multiplexed-model loaders push loaded-set changes to the
         # controller so handles can route model-affine (serve/multiplex.py);
         # classes that reject new attributes (__slots__ etc.) just serve
@@ -67,6 +85,8 @@ class Replica:
 
         from ray_tpu.serve.multiplex import _current_model_id
         self._ongoing += 1
+        t_arrive = time.monotonic()
+        tags = {"deployment": self.deployment_name}
         token = None
         mid = (meta or {}).get("multiplexed_model_id")
         if mid:
@@ -77,12 +97,32 @@ class Replica:
         try:
             fn = getattr(self.instance, method)
             if inspect.iscoroutinefunction(fn):
-                out = await fn(*args, **kwargs)
+                t_run = time.monotonic()
+                self._m["queue"].observe(t_run - t_arrive, tags)
+                try:
+                    out = await fn(*args, **kwargs)
+                finally:
+                    # errored/timed-out requests are exactly the
+                    # latencies worth keeping (the sync path's finally
+                    # below keeps them too)
+                    self._m["handler"].observe(
+                        time.monotonic() - t_run, tags)
             else:
                 loop = asyncio.get_running_loop()
                 ctx = contextvars.copy_context()
-                out = await loop.run_in_executor(
-                    None, lambda: ctx.run(fn, *args, **kwargs))
+
+                def _run():
+                    # queue includes the thread-pool hop; timed on the
+                    # worker thread so a saturated pool shows up here
+                    t_run = time.monotonic()
+                    self._m["queue"].observe(t_run - t_arrive, tags)
+                    try:
+                        return ctx.run(fn, *args, **kwargs)
+                    finally:
+                        self._m["handler"].observe(
+                            time.monotonic() - t_run, tags)
+
+                out = await loop.run_in_executor(None, _run)
             self._processed += 1
             return out
         except BaseException:
@@ -108,6 +148,8 @@ class Replica:
         serve/_private/replica.py streaming call path)."""
         from ray_tpu.serve.multiplex import _current_model_id
         self._ongoing += 1
+        t_run = time.monotonic()
+        tags = {"deployment": self.deployment_name}
         token = None
         mid = (meta or {}).get("multiplexed_model_id")
         if mid:
@@ -135,6 +177,9 @@ class Replica:
             self._errors += 1
             raise
         finally:
+            # a stream's "handler" span covers the whole generation —
+            # the stream IS the call
+            self._m["handler"].observe(time.monotonic() - t_run, tags)
             if token is not None:
                 _current_model_id.reset(token)
                 n = self._model_active.get(mid, 1) - 1

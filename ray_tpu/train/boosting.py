@@ -97,7 +97,9 @@ def _grow_tree(hist_fn, depth: int, lam: float, min_child_weight: float,
             g_tot = G[j, 0].sum()
             h_tot = H[j, 0].sum()
             if h_tot < 2 * min_child_weight:
-                value[node] = -g_tot / (h_tot + lam)
+                # empty node (no rows reach it): 0/0 with reg_lambda=0
+                # would silently seed NaN into every prediction
+                value[node] = -g_tot / (h_tot + lam) if h_tot > 0 else 0.0
                 continue
             parent_score = g_tot * g_tot / (h_tot + lam)
             best_gain, best_f, best_t = 1e-12, -1, -1
@@ -116,7 +118,7 @@ def _grow_tree(hist_fn, depth: int, lam: float, min_child_weight: float,
                 if n_bins[f] > 1 and gain[b] > best_gain:
                     best_gain, best_f, best_t = float(gain[b]), f, b
             if best_f < 0:
-                value[node] = -g_tot / (h_tot + lam)
+                value[node] = -g_tot / (h_tot + lam) if h_tot > 0 else 0.0
             else:
                 feature[node] = best_f
                 threshold[node] = best_t
@@ -134,7 +136,8 @@ def _grow_tree(hist_fn, depth: int, lam: float, min_child_weight: float,
             continue
         g_tot = G[j, 0].sum()
         h_tot = H[j, 0].sum()
-        value[node] = -g_tot / (h_tot + lam)
+        # empty frontier nodes get 0.0, not 0/0 (see the level loop)
+        value[node] = -g_tot / (h_tot + lam) if h_tot > 0 else 0.0
     return _Tree(feature, threshold, value)
 
 

@@ -38,6 +38,7 @@ _NAV = ("<nav><a href='/'>overview</a><a href='/nodes'>nodes</a>"
         "<a href='/actors'>actors</a><a href='/jobs'>jobs</a>"
         "<a href='/pgs'>placement groups</a><a href='/serve'>serve</a>"
         "<a href='/tasks'>tasks</a><a href='/history'>history</a>"
+        "<a href='/profile'>profile</a>"
         "<a href='/metrics'>metrics</a></nav>")
 
 
@@ -45,10 +46,13 @@ def _esc(v) -> str:
     return html.escape(str(v))
 
 
-def _page(title: str, body: str) -> bytes:
+def _page(title: str, body: str, refresh: bool = True) -> bytes:
+    # refresh=False for pages whose render has side effects (a profile
+    # sample) — a forgotten tab must not re-trigger them every 5s
+    meta = "<meta http-equiv='refresh' content='5'>" if refresh else ""
     return (f"<!doctype html><html><head><title>ray-tpu: {_esc(title)}"
             f"</title><style>{_STYLE}</style>"
-            f"<meta http-equiv='refresh' content='5'></head>"
+            f"{meta}</head>"
             f"<body><h1>ray-tpu &mdash; {_esc(title)}</h1>{_NAV}"
             f"{body}</body></html>").encode()
 
@@ -80,7 +84,7 @@ def _state(s, good=("ALIVE", "CREATED", "RUNNING", "SUCCEEDED")) -> str:
 # --- pages -------------------------------------------------------------
 
 
-async def _overview(fetch: Fetch) -> bytes:
+async def _overview(fetch: Fetch, query: str = "") -> bytes:
     nodes = await fetch("get_nodes")
     actors = await fetch("list_actors")
     jobs = await fetch("list_jobs")
@@ -107,7 +111,7 @@ async def _overview(fetch: Fetch) -> bytes:
     return _page("overview", body)
 
 
-async def _nodes(fetch: Fetch) -> bytes:
+async def _nodes(fetch: Fetch, query: str = "") -> bytes:
     nodes = await fetch("get_nodes")
     rows = []
     for n in sorted(nodes, key=lambda x: not x["alive"]):
@@ -127,7 +131,7 @@ async def _nodes(fetch: Fetch) -> bytes:
         rows))
 
 
-async def _actors(fetch: Fetch) -> bytes:
+async def _actors(fetch: Fetch, query: str = "") -> bytes:
     actors = [a for a in await fetch("list_actors") if a]
     rows = []
     order = {"ALIVE": 0, "RESTARTING": 1, "PENDING": 2, "DEAD": 3}
@@ -146,7 +150,7 @@ async def _actors(fetch: Fetch) -> bytes:
          "death cause"), rows))
 
 
-async def _jobs(fetch: Fetch) -> bytes:
+async def _jobs(fetch: Fetch, query: str = "") -> bytes:
     jobs = await fetch("list_jobs")
     sub = await fetch("list_submitted_jobs")
     rows = [(_esc(_hex(j["job_id"])[:12]), _state(j["state"]),
@@ -165,7 +169,7 @@ async def _jobs(fetch: Fetch) -> bytes:
     return _page("jobs", body)
 
 
-async def _pgs(fetch: Fetch) -> bytes:
+async def _pgs(fetch: Fetch, query: str = "") -> bytes:
     pgs = await fetch("list_pgs")
     rows = []
     for p in pgs:
@@ -185,7 +189,7 @@ async def _pgs(fetch: Fetch) -> bytes:
         ("pg", "name", "state", "strategy", "bundles", "nodes"), rows))
 
 
-async def _serve(fetch: Fetch) -> bytes:
+async def _serve(fetch: Fetch, query: str = "") -> bytes:
     """Serve view derived from the actor table: deployments are the
     SERVE_REPLICA:<dep>:<rid> groups, the control plane is the
     SERVE_CONTROLLER/SERVE_PROXY actors."""
@@ -218,7 +222,7 @@ async def _serve(fetch: Fetch) -> bytes:
     return _page("serve", body)
 
 
-async def _tasks(fetch: Fetch) -> bytes:
+async def _tasks(fetch: Fetch, query: str = "") -> bytes:
     """Recent task/actor spans from the cluster timeline (tracing
     archive + live node buffers) — the `ray list tasks` analog."""
     from ray_tpu.util.state import tasks_from_events
@@ -332,7 +336,7 @@ def _rate(samples: List[dict], name: str) -> List[Optional[float]]:
     return out[1:]
 
 
-async def _history(fetch: Fetch) -> bytes:
+async def _history(fetch: Fetch, query: str = "") -> bytes:
     samples = list(_HISTORY)
     if len(samples) >= 2:
         mins = (samples[-1]["ts"] - samples[0]["ts"]) / 60.0
@@ -352,12 +356,86 @@ async def _history(fetch: Fetch) -> bytes:
     return _page("history", body)
 
 
+# --- live profiler -----------------------------------------------------
+
+
+async def _profile(fetch: Fetch, query: str = "") -> bytes:
+    """Stack-sampling profiler UI: the index lists live actors with
+    profile/stack links; with ?target=... the page runs the sample over
+    the control plane (head profile_target -> worker profile RPC,
+    util/profiling.py) and renders the folded stacks."""
+    from urllib.parse import parse_qs
+    q = parse_qs(query or "")
+    target = (q.get("target") or [""])[0]
+    if target:
+        op = (q.get("op") or ["profile"])[0]
+        # dashboard fetches carry a fixed 10s RPC timeout: keep the
+        # sample window safely inside it (long profiles go via the CLI)
+        duration = min(max(float((q.get("duration") or ["1.0"])[0]),
+                           0.1), 5.0)
+        hz = min(max(int((q.get("hz") or ["100"])[0]), 1), 1000)
+        if op == "stack":
+            r = await fetch("profile_target", target=target,
+                            op="dump_stacks")
+        else:
+            r = await fetch("profile_target", target=target, op="profile",
+                            duration_s=duration, hz=hz)
+        if not isinstance(r, dict) or r.get("error"):
+            err = r.get("error") if isinstance(r, dict) else repr(r)
+            return _page(f"profile: {target}",
+                         f"<p class=bad>{_esc(err)}</p>",
+                         refresh=False)
+        tgt = r.get("target") or {}
+        who = (f"pid {r.get('pid', '?')}"
+               + (f" &middot; actor {_esc(str(tgt.get('name') or tgt.get('actor_id', ''))[:16])}"
+                  f" ({_esc(tgt.get('class_name') or '?')})" if tgt else ""))
+        if op == "stack":
+            from ray_tpu.util.profiling import format_stacks
+            body = (f"<p class=dim>{who} &mdash; one-shot thread dump"
+                    f"</p><pre>{_esc(format_stacks(r.get('stacks', [])))}"
+                    f"</pre>")
+        else:
+            folded = sorted((r.get("folded") or {}).items(),
+                            key=lambda kv: (-kv[1], kv[0]))
+            rows = "\n".join(f"{c:8d}  {_esc(s)}" for s, c in folded)
+            body = (f"<p class=dim>{who} &mdash; {r.get('samples', 0)} "
+                    f"samples over {duration:g}s at {hz} Hz (folded "
+                    f"stacks, heaviest first; `ray-tpu profile` writes "
+                    f"speedscope JSON)</p><pre>{rows or '(no samples)'}"
+                    f"</pre>")
+        return _page(f"profile: {target}", body, refresh=False)
+    actors = [a for a in await fetch("list_actors")
+              if a and a["state"] == "ALIVE"]
+    rows = []
+    for a in sorted(actors, key=lambda x: (x.get("name") or "",
+                                           _hex(x["actor_id"]))):
+        aid = _hex(a["actor_id"])
+        rows.append((
+            f"<a href='/profile?target={aid}&duration=1'>{_esc(aid[:12])}"
+            f"</a>",
+            _esc(a.get("name") or "-"),
+            _esc(a.get("class_name") or "-"),
+            _esc(_hex(a["node_id"])[:12] if a.get("node_id") else "-"),
+            f"<a href='/profile?target={aid}&op=stack'>stack</a> "
+            f"<a href='/profile?target={aid}&duration=1'>1s</a> "
+            f"<a href='/profile?target={aid}&duration=5'>5s</a>",
+        ))
+    body = ("<p class=dim>sample a live actor's stacks over the "
+            "control plane; CLI: <code>ray-tpu stack &lt;actor|pid&gt;"
+            "</code> / <code>ray-tpu profile &lt;actor|pid&gt;</code>"
+            "</p>"
+            + _table(("actor", "name", "class", "node", "profile"),
+                     rows))
+    return _page("profile", body)
+
+
 _PAGES = {"/": _overview, "/overview": _overview, "/nodes": _nodes,
           "/actors": _actors, "/jobs": _jobs, "/pgs": _pgs,
-          "/serve": _serve, "/tasks": _tasks, "/history": _history}
+          "/serve": _serve, "/tasks": _tasks, "/history": _history,
+          "/profile": _profile}
 
 
-async def render(path: str, fetchers) -> Optional[bytes]:
+async def render(path: str, fetchers, query: str = "") -> Optional[bytes]:
     """Render a dashboard page, or None if `path` isn't one.
     `fetchers`: candidate fetch callables, preferred first (a stale one
     from a dead agent is skipped when a later candidate works). With
@@ -375,7 +453,7 @@ async def render(path: str, fetchers) -> Optional[bytes]:
     err: Optional[Exception] = None
     for fetch in fetchers:
         try:
-            return await page(fetch)
+            return await page(fetch, query)
         except Exception as e:  # noqa: BLE001 — try the next candidate
             err = e
     return _page("error", f"<p class=bad>{_esc(type(err).__name__)}: "

@@ -13,11 +13,16 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 _LOCK = threading.Lock()
 _REGISTRY: Dict[str, "Metric"] = {}
 _COLLECTORS: List[Callable[[], str]] = []
+# Remote snapshots pushed by worker processes (push_loop -> control
+# "report_metrics" -> merge_remote): source -> (received_at, text).
+_REMOTE: Dict[str, Tuple[float, str]] = {}
+_REMOTE_TTL_S = 60.0   # a dead worker's last snapshot ages out
 
 
 def _labels_key(labels: Optional[dict]) -> Tuple[Tuple[str, str], ...]:
@@ -61,13 +66,15 @@ class Metric:
         with _LOCK:
             self._values[key] = self._values.get(key, 0.0) + delta
 
-    def render(self) -> str:
+    def render(self, extra: Tuple[Tuple[str, str], ...] = ()) -> str:
+        """Prometheus text. ``extra`` label pairs are merged into every
+        sample (the push path stamps node/worker identity this way)."""
         lines = [f"# HELP {self.name} {self.description}",
                  f"# TYPE {self.name} {self.kind}"]
         with _LOCK:
             items = list(self._values.items())
         for key, v in items:
-            lines.append(f"{self.name}{_fmt_labels(key)} {v:g}")
+            lines.append(f"{self.name}{_fmt_labels(extra + key)} {v:g}")
         return "\n".join(lines)
 
 
@@ -122,13 +129,14 @@ class Histogram(Metric):
             counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
 
-    def render(self) -> str:
+    def render(self, extra: Tuple[Tuple[str, str], ...] = ()) -> str:
         lines = [f"# HELP {self.name} {self.description}",
                  f"# TYPE {self.name} histogram"]
         with _LOCK:
             items = [(k, list(c), self._sums.get(k, 0.0))
                      for k, c in self._counts.items()]
         for key, counts, total in items:
+            key = extra + key
             cum = 0
             for b, c in zip(self.boundaries, counts):
                 cum += c
@@ -187,13 +195,77 @@ def render_all() -> str:
     with _LOCK:
         metrics = list(_REGISTRY.values())
         collectors = list(_COLLECTORS)
+        now = time.time()
+        remote = [(src, text) for src, (ts, text) in
+                  sorted(_REMOTE.items()) if now - ts < _REMOTE_TTL_S]
     parts = [m.render() for m in metrics]
     for fn in collectors:
         try:
             parts.append(fn())
         except Exception as e:  # noqa: BLE001 — one bad collector
             parts.append(f"# collector error: {e!r}")
+    for src, text in remote:
+        parts.append(f"# pushed from {src}\n{text}")
     return "\n".join(p for p in parts if p) + "\n"
+
+
+# --- head aggregation (push path) -------------------------------------
+# Worker processes have no scrape endpoint of their own; instead each
+# runs push_loop, periodically shipping its registry (samples labelled
+# with node/worker identity) to the control service, which stores the
+# text via merge_remote — the head /metrics endpoint then serves
+# cluster-wide series (the reference ships OpenCensus points from every
+# worker to the per-node metrics agent the same way,
+# _private/metrics_agent.py).
+
+
+def render_labeled(labels: Optional[dict]) -> str:
+    """This process's registry rendered with ``labels`` merged into
+    every sample. Samples only — no HELP/TYPE comment lines and no
+    collectors: the receiving head renders its own comments, and
+    collector text already carries node identity."""
+    extra = _labels_key(labels)
+    with _LOCK:
+        metrics = list(_REGISTRY.values())
+    parts = []
+    for m in metrics:
+        body = "\n".join(line for line in m.render(extra).splitlines()
+                         if not line.startswith("#"))
+        if body:
+            parts.append(body)
+    return "\n".join(parts)
+
+
+def merge_remote(source: str, text: str) -> None:
+    """Store one pushed snapshot (latest wins per source). Called by
+    the control service's ``report_metrics`` handler. Expired sources
+    are evicted here so worker churn can't grow the head's map
+    unboundedly (render only filters; this is the reclaim)."""
+    now = time.time()
+    with _LOCK:
+        _REMOTE[source] = (now, text)
+        dead = [s for s, (ts, _) in _REMOTE.items()
+                if now - ts >= _REMOTE_TTL_S]
+        for s in dead:
+            del _REMOTE[s]
+
+
+async def push_loop(call, source: str, labels: Optional[dict],
+                    interval_s: float = 5.0) -> None:
+    """Periodically push this process's metric samples to the head.
+    ``call`` is an async fn(method, **kw) that issues a control RPC
+    (workers pass a pool.call closure bound to the head address)."""
+    interval_s = max(0.25, float(interval_s))
+    while True:
+        await asyncio.sleep(interval_s)
+        try:
+            text = render_labeled(labels)
+            if text:
+                await call("report_metrics", source=source, text=text)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass  # head briefly unreachable: next tick retries
 
 
 def snapshot() -> Dict[str, float]:
@@ -216,6 +288,7 @@ def reset() -> None:
     with _LOCK:
         _REGISTRY.clear()
         _COLLECTORS.clear()
+        _REMOTE.clear()
     from ray_tpu.util import dashboard
     dashboard.clear_history()
 
@@ -288,9 +361,12 @@ class MetricsServer:
                 pass
             self._sampler = None
             # this server's cluster is going away: a later cluster in
-            # the same process must not inherit its history
+            # the same process must not inherit its history or its
+            # workers' pushed snapshots
             from ray_tpu.util import dashboard
             dashboard.clear_history()
+            with _LOCK:
+                _REMOTE.clear()
         if self._server is not None:
             self._server.close()
             try:
@@ -303,7 +379,7 @@ class MetricsServer:
         try:
             req = await asyncio.wait_for(reader.readline(), 10.0)
             path = req.split()[1].decode() if len(req.split()) > 1 else "/"
-            path = path.split("?", 1)[0]
+            path, _, query = path.partition("?")
             while True:  # drain headers
                 line = await asyncio.wait_for(reader.readline(), 10.0)
                 if line in (b"\r\n", b"\n", b""):
@@ -321,7 +397,8 @@ class MetricsServer:
                 # server-rendered cluster dashboard (nodes/actors/jobs/
                 # pgs/serve/tasks off the control-plane state API)
                 from ray_tpu.util import dashboard
-                page = await dashboard.render(path, _state_fetchers())
+                page = await dashboard.render(path, _state_fetchers(),
+                                              query)
                 if page is not None:
                     body, ctype, code = page, "text/html", "200 OK"
                 else:

@@ -1,0 +1,101 @@
+"""Metric-name lint: keep the metrics catalog consistent and greppable.
+
+Rules (run over the process-global registry in ray_tpu/util/metrics.py
+after importing every instrumented module):
+
+  1. names are snake_case: ``^[a-z][a-z0-9_]*$``;
+  2. every metric carries a unit suffix — ``_s`` (seconds), ``_total``
+     (monotonic count), ``_bytes`` — EXCEPT unitless gauges (a level,
+     e.g. ``queue_depth``) and dimensionless count *distributions*
+     ending in ``_size`` (e.g. ``llm_batch_size``);
+  3. no duplicate names, including case-insensitive collisions (the
+     registry keys by exact name, so ``Foo``/``foo`` could otherwise
+     coexist and split a series).
+
+Usage: ``python scripts/check_metrics_lint.py`` (exits 1 on findings).
+tests/test_metrics_lint.py runs the same lint as a tier-1 test.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable as `python scripts/check_metrics_lint.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+UNIT_SUFFIXES = ("_s", "_total", "_bytes")
+COUNT_SUFFIXES = ("_size",)
+
+
+def lint(registry: dict) -> list:
+    """Return a list of human-readable violations for a {name: Metric}
+    registry (anything with a ``kind`` attribute works)."""
+    errors = []
+    seen_lower = {}
+    for name, metric in registry.items():
+        kind = getattr(metric, "kind", "untyped")
+        if not _NAME_RE.match(name):
+            errors.append(
+                f"{name}: not snake_case (expected ^[a-z][a-z0-9_]*$)")
+        if not name.endswith(UNIT_SUFFIXES):
+            if kind == "gauge":
+                pass        # unitless gauge (a level) is fine
+            elif name.endswith(COUNT_SUFFIXES):
+                pass        # dimensionless count distribution
+            else:
+                errors.append(
+                    f"{name}: {kind} without a unit suffix "
+                    f"({'/'.join(UNIT_SUFFIXES)}; unitless gauges and "
+                    f"*_size distributions are exempt)")
+        low = name.lower()
+        if low in seen_lower and seen_lower[low] != name:
+            errors.append(
+                f"{name}: case-insensitive duplicate of "
+                f"{seen_lower[low]}")
+        seen_lower.setdefault(low, name)
+    return sorted(errors)
+
+
+def instantiate_all() -> dict:
+    """Import every instrumented module and force its metric
+    registrations; returns {name: Metric} for exactly the metrics the
+    framework itself registers (tests lint this dict so metrics created
+    by other tests in the same process can't contaminate the run)."""
+    out = {}
+
+    def take(metrics):
+        for m in (metrics.values() if isinstance(metrics, dict)
+                  else [metrics]):
+            out[m.name] = m
+
+    from ray_tpu.runtime import core
+    take(core._M_TASKS())
+    from ray_tpu.llm import engine
+    take(engine.engine_metrics())
+    from ray_tpu.serve import proxy, replica
+    take(proxy.proxy_metrics())
+    take(replica.replica_metrics())
+    return out
+
+
+def main() -> int:
+    instantiate_all()
+    from ray_tpu.util import metrics
+    errors = lint(metrics._REGISTRY)
+    if errors:
+        print(f"{len(errors)} metric lint violation(s):")
+        for e in errors:
+            print(f"  {e}")
+        return 1
+    print(f"metrics lint ok: {len(metrics._REGISTRY)} registered "
+          f"metric(s) pass")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,17 +31,19 @@ def main():
 
     async def run(n_prompt, n_new=32):
         prompt = [int(x) for x in rng.integers(1, 2047, n_prompt)]
+        s0 = eng.stats                    # stats is a snapshot property
         t0 = time.monotonic()
         out = await eng.generate(prompt, max_new_tokens=n_new,
                                  temperature=0.0)
         total = time.monotonic() - t0
-        ttft = eng.stats["ttft_sum"] / max(eng.stats["ttft_count"], 1)
+        s1 = eng.stats
+        ttft = (s1["ttft_sum"] - s0["ttft_sum"]) / max(
+            s1["ttft_count"] - s0["ttft_count"], 1)
         return out, total, ttft
 
     async def bench():
         for n in (512, 2048, 8100):
             await run(n, 8)               # warm compiles
-            eng.stats.update(ttft_sum=0.0, ttft_count=0)
             out, total, ttft = await run(n, 32)
             dec = 32 / max(total - ttft, 1e-9)
             print(json.dumps({

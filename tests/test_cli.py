@@ -105,6 +105,14 @@ def test_cli_cluster_end_to_end(cli_cluster):
     r = _cli(env, "list", "actors", "--address", address, "--json")
     assert r.returncode == 0 and "holder" in r.stdout
 
+    # live thread dump of the named actor over the control plane
+    r = _cli(env, "stack", "holder", "--address", address)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "MainThread" in r.stdout and "class=Holder" in r.stdout
+    # unknown target: clean failure, not a hang
+    r = _cli(env, "stack", "not_an_actor", "--address", address)
+    assert r.returncode == 1 and "no live actor" in r.stderr
+
 
 def test_cli_stop_kills_nodes(cli_cluster):
     address, env = cli_cluster

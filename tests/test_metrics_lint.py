@@ -1,0 +1,55 @@
+"""Tier-1 metric naming lint: every metric the framework registers is
+snake_case and unit-suffixed (scripts/check_metrics_lint.py)."""
+
+import importlib.util
+import os
+
+
+def _load_linter():
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "scripts", "check_metrics_lint.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_lint", os.path.abspath(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_framework_metrics_pass_lint():
+    mod = _load_linter()
+    # lint exactly what the framework registers (other tests may park
+    # arbitrarily-named metrics in the shared process registry)
+    registry = mod.instantiate_all()
+    assert len(registry) >= 10, sorted(registry)
+    for name in ("llm_ttft_device_s", "llm_ttft_wall_s", "llm_tpot_s",
+                 "llm_queue_s", "llm_batch_size",
+                 "serve_proxy_queue_s", "serve_proxy_handler_s",
+                 "serve_replica_queue_s", "serve_replica_handler_s",
+                 "ray_tpu_tasks_submitted_total"):
+        assert name in registry, name
+    errors = mod.lint(registry)
+    assert errors == []
+
+
+def test_lint_flags_violations():
+    mod = _load_linter()
+
+    class _Fake:
+        def __init__(self, kind):
+            self.kind = kind
+
+    errs = mod.lint({
+        "BadName_s": _Fake("counter"),          # not snake_case
+        "no_unit": _Fake("histogram"),          # missing unit suffix
+        "queue_depth": _Fake("gauge"),          # unitless gauge: ok
+        "batch_size": _Fake("histogram"),       # count distribution: ok
+        "ok_latency_s": _Fake("histogram"),     # ok
+        "dup_total": _Fake("counter"),
+        "DUP_total": _Fake("counter"),          # case-insensitive dup
+    })
+    assert any("BadName_s" in e for e in errs)
+    assert any("no_unit" in e for e in errs)
+    assert any("duplicate" in e for e in errs)
+    assert not any("queue_depth" in e for e in errs)
+    assert not any("batch_size" in e for e in errs)
+    assert not any("ok_latency_s" in e for e in errs)

@@ -1,0 +1,243 @@
+"""Stack-sampling profiler: self-profiling, speedscope export, remote
+actor profiling over the control plane, and head-aggregated metrics.
+
+Reference shape: the dashboard's py-spy integration
+(dashboard/modules/reporter/reporter_agent.py) rebuilt in-process over
+sys._current_frames() (ray_tpu/util/profiling.py), plus the worker ->
+head metric push path (util/metrics.py push_loop / merge_remote).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import profiling
+
+
+def _busy_probe(stop):
+    """A recognizable frame that burns CPU until told to stop."""
+    x = 0
+    while not stop[0]:
+        x = (x + 1) % 1000003
+    return x
+
+
+def test_self_profile_folded_contains_busy_function():
+    stop = [False]
+    t = threading.Thread(target=_busy_probe, args=(stop,),
+                         name="busy-probe", daemon=True)
+    t.start()
+    try:
+        res = profiling.profile(duration_s=0.6, hz=200)
+    finally:
+        stop[0] = True
+        t.join()
+    assert res["samples"] > 5
+    assert res["folded"], "no stacks sampled"
+    assert all(isinstance(c, int) and c > 0
+               for c in res["folded"].values())
+    busy = [k for k in res["folded"] if "_busy_probe" in k]
+    assert busy, f"busy function never sampled: {list(res['folded'])[:5]}"
+    # the probe thread's stacks are keyed by its thread name
+    assert any(k.startswith("thread:busy-probe;") for k in busy)
+    # folded text renders heaviest-first, "stack count" per line
+    text = profiling.folded_text(res)
+    first = text.splitlines()[0]
+    assert first.rsplit(" ", 1)[1].isdigit()
+    counts = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()]
+    assert counts == sorted(counts, reverse=True)
+
+
+def test_dump_stacks_sees_threads():
+    stacks = profiling.dump_stacks()
+    names = {s["thread"] for s in stacks}
+    assert "MainThread" in names
+    assert all(s["frames"] for s in stacks)
+    # this very test function is on the MainThread stack
+    main = next(s for s in stacks if s["thread"] == "MainThread")
+    assert any("test_dump_stacks_sees_threads" in fr
+               for fr in main["frames"])
+    text = profiling.format_stacks(stacks)
+    assert 'Thread "MainThread"' in text
+
+
+def test_speedscope_json_validates():
+    stop = [False]
+    t = threading.Thread(target=_busy_probe, args=(stop,),
+                         name="scope-probe", daemon=True)
+    t.start()
+    try:
+        res = profiling.profile(duration_s=0.3, hz=100)
+    finally:
+        stop[0] = True
+        t.join()
+    doc = json.loads(json.dumps(profiling.to_speedscope(res, name="t")))
+    assert doc["$schema"].endswith("file-format-schema.json")
+    nframes = len(doc["shared"]["frames"])
+    assert nframes > 0
+    assert all("name" in f for f in doc["shared"]["frames"])
+    prof = doc["profiles"][doc["activeProfileIndex"]]
+    assert prof["type"] == "sampled" and prof["unit"] == "seconds"
+    assert len(prof["samples"]) == len(prof["weights"]) > 0
+    assert all(0 <= i < nframes for s in prof["samples"] for i in s)
+    assert all(w > 0 for w in prof["weights"])
+    assert abs(sum(prof["weights"]) - prof["endValue"]) < 1e-9
+
+
+def test_remote_actor_profile_over_control_plane():
+    """The acceptance path: driver -> head profile_target -> hosting
+    worker's profile RPC returns folded stacks from a LIVE actor."""
+    from ray_tpu import scripts
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote
+        class Burner:
+            def burn(self, sec):
+                end = time.monotonic() + sec
+                x = 0
+                while time.monotonic() < end:
+                    x = (x + 1) % 1000003
+                return x
+
+        b = Burner.options(name="prof_burner").remote()
+        # make sure the actor is alive before profiling
+        assert ray_tpu.get(b.burn.remote(0.01), timeout=60) >= 0
+        fut = b.burn.remote(8.0)   # keep it busy while we sample
+
+        from ray_tpu import api
+        host, port = api._g.ctx.head_addr
+        addr = f"{host}:{port}"
+        r = scripts._call_head(addr, "profile_target",
+                               target="prof_burner", op="profile",
+                               duration_s=0.7, hz=100, timeout=40.0)
+        assert isinstance(r, dict) and not r.get("error"), r
+        assert r["samples"] > 0 and r["folded"], r
+        assert r["target"]["class_name"] == "Burner"
+        assert any("burn" in k for k in r["folded"]), \
+            list(r["folded"])[:5]
+
+        # one-shot dump on the same actor, by actor-id prefix this time
+        aid = r["target"]["actor_id"]
+        r2 = scripts._call_head(addr, "profile_target",
+                                target=aid[:12], op="dump_stacks",
+                                timeout=30.0)
+        assert isinstance(r2, dict) and not r2.get("error"), r2
+        assert r2["stacks"] and all(s["frames"] for s in r2["stacks"])
+
+        # unknown targets fail cleanly, not with a hang or a crash
+        r3 = scripts._call_head(addr, "profile_target",
+                                target="no_such_actor",
+                                op="dump_stacks", timeout=30.0)
+        assert r3.get("error")
+        # op is an RPC method name downstream: only the two profile
+        # ops are accepted (never e.g. shutdown_worker)
+        r4 = scripts._call_head(addr, "profile_target",
+                                target="prof_burner",
+                                op="shutdown_worker", timeout=30.0)
+        assert "unknown profile op" in r4.get("error", "")
+        # NaN duration must not pin a worker thread sampling forever
+        r5 = scripts._call_head(addr, "profile_target",
+                                target="prof_burner", op="profile",
+                                duration_s=float("nan"), timeout=30.0)
+        assert "duration" in r5.get("error", "")
+        assert ray_tpu.get(fut, timeout=60) >= 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_worker_metrics_pushed_to_head(monkeypatch):
+    """Head aggregation: a metric observed inside a (non-head) worker
+    process appears on the head /metrics endpoint with node/worker
+    labels, shipped by the worker's push_loop."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.config import Config
+    monkeypatch.setenv("RAY_TPU_METRICS_EXPORT_INTERVAL_S", "0.3")
+    cfg = Config.from_env(metrics_port=0,
+                          metrics_export_interval_s=0.3)
+    c = Cluster(config=cfg)
+    agent = c.add_node(num_cpus=2)
+    try:
+        ray_tpu.init(address=c.address, config=cfg)
+
+        @ray_tpu.remote
+        def observe():
+            import os
+
+            from ray_tpu.util.metrics import Counter
+            Counter("push_probe_total", "pushed from a worker").inc(3)
+            return os.getpid()
+
+        import os
+        wpid = ray_tpu.get(observe.remote(), timeout=60)
+        assert wpid != os.getpid(), "probe must run in a worker process"
+
+        addr = agent.metrics_addr
+        deadline = time.monotonic() + 30
+        line = None
+        while time.monotonic() < deadline and line is None:
+            with urllib.request.urlopen(
+                    f"http://{addr[0]}:{addr[1]}/metrics",
+                    timeout=10) as resp:
+                text = resp.read().decode()
+            for ln in text.splitlines():
+                if ln.startswith("push_probe_total{") \
+                        and 'worker="' in ln and 'node="' in ln:
+                    line = ln
+                    break
+            time.sleep(0.3)
+        assert line is not None, "worker snapshot never reached head"
+        assert float(line.rsplit(" ", 1)[1]) == 3.0
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+        from ray_tpu.util import metrics as m
+        m.reset()
+
+
+def test_dashboard_profile_page():
+    """/profile index lists live actors; ?target= renders folded
+    stacks sampled over the control plane."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.config import Config
+    cfg = Config.from_env(metrics_port=0)
+    c = Cluster(config=cfg)
+    agent = c.add_node(num_cpus=2)
+    try:
+        ray_tpu.init(address=c.address, config=cfg)
+
+        @ray_tpu.remote
+        class Idler:
+            def pingo(self):
+                return "ok"
+
+        h = Idler.options(name="dash_idler").remote()
+        assert ray_tpu.get(h.pingo.remote(), timeout=60) == "ok"
+
+        addr = agent.metrics_addr
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://{addr[0]}:{addr[1]}{path}",
+                    timeout=15) as r:
+                assert r.status == 200
+                return r.read().decode()
+
+        index = get("/profile")
+        assert "dash_idler" in index and "Idler" in index
+
+        page = get("/profile?target=dash_idler&duration=0.4")
+        assert "samples over" in page
+        # the worker's event loop is parked in epoll — its stack shows
+        assert "thread:" in page
+
+        dump = get("/profile?target=dash_idler&op=stack")
+        assert "MainThread" in dump
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+        from ray_tpu.util import metrics as m
+        m.reset()
