@@ -83,13 +83,26 @@ class AllReduceNode:
 _REDUCE_OPS = ("sum", "mean", "max", "min")
 
 
-def allreduce(nodes, op: str = "sum"):
+def allreduce(nodes, op: str = "sum", *, quantize: Optional[str] = None,
+              chunk_bytes: Optional[int] = None,
+              impl: Optional[str] = None):
     """Bind an allreduce across DAG actors (reference:
     dag/collective_node.py:252 + experimental/collective/operations.py —
-    which lower to NCCL; here the collective rides the host object plane:
-    a star reduce over the same placement-aware channels as data edges,
-    shm when co-located, TCP across nodes. Within one process holding a
-    mesh, tensor reductions belong to jit'd psum over ICI, not the DAG).
+    which lower to NCCL; here the collective rides the host object plane
+    over the same placement-aware channels as data edges, shm when
+    co-located, TCP across nodes. Within one process holding a mesh,
+    tensor reductions belong to jit'd psum over ICI, not the DAG).
+
+    Groups of more than two participants compile to a chunked ring
+    reduce-scatter + allgather (dag/ring.py): per-participant bandwidth
+    is O(S) independent of group size, and segments pipeline around the
+    ring. Two-participant groups keep the star reduce (same traffic,
+    fewer hops). ``quantize="int8"`` ships chunks block-quantized
+    (~26% of the fp32 wire bytes; float32 accumulation, per-round error
+    bound exported as the ``allreduce_quant_error`` gauge).
+    ``chunk_bytes`` tunes the pipeline granularity (default 1 MB,
+    clamped to the channel slot size). ``impl`` forces "star" or
+    "ring" (benchmarks / tests; the default picks per group size).
 
     Takes one upstream MethodNode per participant actor; returns one
     AllReduceNode per participant, each carrying the reduced value. The
@@ -100,13 +113,23 @@ def allreduce(nodes, op: str = "sum"):
         raise ValueError("allreduce needs at least 2 participants")
     if op not in _REDUCE_OPS:
         raise ValueError(f"op must be one of {_REDUCE_OPS}, got {op!r}")
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize must be None or 'int8', "
+                         f"got {quantize!r}")
+    if impl not in (None, "star", "ring"):
+        raise ValueError(f"impl must be None, 'star' or 'ring', "
+                         f"got {impl!r}")
+    if impl == "star" and quantize is not None:
+        raise ValueError("the star reduce does not support quantize; "
+                         "use impl='ring' (or leave impl unset)")
     for n in nodes:
         if not isinstance(n, MethodNode):
             raise TypeError(
                 "allreduce participants must be bound method nodes")
     import uuid as _uuid
     group = {"id": _uuid.uuid4().hex[:16], "op": op, "size": len(nodes),
-             "members": []}
+             "quantize": quantize, "chunk_bytes": chunk_bytes,
+             "impl": impl, "members": []}
     out = [AllReduceNode(n, group, rank) for rank, n in enumerate(nodes)]
     group["members"] = out
     return out
@@ -311,10 +334,29 @@ class CompiledDag:
                     self._templates[i].append(("chan", None))
                 else:
                     self._templates[i].append(("const", dumps_oob(a)))
-        # collective star wiring: rank 0 hosts the reduce, every other
-        # participant sends up / receives the reduced value down
+        # collective wiring. Ring (N>2, and every quantized group): one
+        # directed edge rank r -> rank (r+1)%N; chunked reduce-scatter +
+        # allgather makes per-participant traffic O(S) independent of N
+        # (dag/ring.py). Star (N<=2 fallback): rank 0 hosts the reduce,
+        # every other participant sends up / receives the result down.
         for g in self._groups:
             idxs = [idx[id(m.parent)] for m in g["members"]]
+            impl = g.get("impl") or (
+                "ring" if g["size"] > 2 or g.get("quantize") else "star")
+            if impl == "ring":
+                n = g["size"]
+                edges = [self._new_edge(idxs[r], idxs[(r + 1) % n])
+                         for r in range(n)]
+                for r, i in enumerate(idxs):
+                    self._coll_spec[i] = {
+                        "role": "ring", "rank": r, "size": n,
+                        "op": g["op"],
+                        "timeout_s": self._coll_timeout,
+                        "quantize": g.get("quantize"),
+                        "chunk_bytes": g.get("chunk_bytes"),
+                        "to_next": edges[r],
+                        "from_prev": edges[(r - 1) % n]}
+                continue
             root = idxs[0]
             root_spec = {"role": "root", "op": g["op"], "size": g["size"],
                          "timeout_s": self._coll_timeout,

@@ -37,6 +37,20 @@ class ChannelTimeout(Exception):
     pass
 
 
+def _as_u8(payload) -> memoryview:
+    """A flat uint8 memoryview of any bytes-like / buffer-protocol
+    payload, without copying when the buffer is C-contiguous (numpy
+    array views, bytearrays, bytes)."""
+    mv = payload if isinstance(payload, memoryview) \
+        else memoryview(payload)
+    if mv.ndim != 1 or mv.format != "B":
+        try:
+            mv = mv.cast("B")
+        except TypeError:            # non-contiguous: pay one copy
+            mv = memoryview(bytes(mv))
+    return mv
+
+
 class ShmRingChannel:
     """One direction, one producer process, one consumer process."""
 
@@ -97,25 +111,38 @@ class ShmRingChannel:
 
     def write(self, payload, kind: int = DATA,
               timeout: Optional[float] = None):
-        """payload: bytes-like, or an object with (frame_nbytes,
+        """payload: bytes-like / any C-contiguous buffer (numpy views —
+        e.g. ring-allreduce chunk slices — are written without an
+        intermediate bytes() copy), or an object with (frame_nbytes,
         write_into) — ray_tpu Serialized — written zero-copy."""
+        mv = None
         if hasattr(payload, "write_into"):
             n = payload.frame_nbytes
         else:
-            n = len(payload)
+            mv = _as_u8(payload)
+            n = mv.nbytes
         if n > self.slot_bytes:
             raise ValueError(
                 f"frame of {n} B exceeds channel slot size "
                 f"{self.slot_bytes} B; compile the dag with a larger "
                 f"slot_bytes")
         native = self._lib is not None and self._cbase is not None
-        if native and not hasattr(payload, "write_into"):
-            data = bytes(payload)  # n re-derived: a memoryview's len()
-            n = len(data)          # counts items, not bytes
-            if n > self.slot_bytes:
-                raise ValueError(
-                    f"frame of {n} B exceeds channel slot size "
-                    f"{self.slot_bytes} B")
+        if native and mv is not None:
+            import ctypes
+            if isinstance(payload, bytes):
+                data = payload           # ctypes takes bytes directly
+            elif mv.readonly:
+                # from_buffer refuses readonly views (e.g. staged
+                # jax arrays); borrow the raw pointer via numpy — mv
+                # stays referenced across the synchronous rb_write, so
+                # the buffer cannot move or be freed under the copy
+                import numpy as _np
+                data = ctypes.cast(ctypes.c_void_p(
+                    _np.frombuffer(mv, dtype=_np.uint8).ctypes.data
+                    if n else 0), ctypes.c_char_p)
+            else:
+                data = ctypes.cast((ctypes.c_char * n).from_buffer(mv),
+                                   ctypes.c_char_p)
             rc = self._lib.rb_write(
                 self._cbase, self.nslots, self.slot_bytes,
                 data, n, kind,
@@ -142,7 +169,7 @@ class ShmRingChannel:
         if hasattr(payload, "write_into"):
             payload.write_into(buf[off + SLOT_HDR:off + SLOT_HDR + n])
         else:
-            buf[off + SLOT_HDR:off + SLOT_HDR + n] = bytes(payload)
+            buf[off + SLOT_HDR:off + SLOT_HDR + n] = mv
         buf[off:off + 4] = n.to_bytes(4, "little")
         buf[off + 4] = kind
         if native:
@@ -478,10 +505,14 @@ class TcpChannel:
             n = payload.frame_nbytes
             data = bytearray(n)
             payload.write_into(memoryview(data))
-        else:
-            data = payload if isinstance(payload, (bytes, bytearray)) \
-                else bytes(payload)
+        elif isinstance(payload, (bytes, bytearray)):
+            data = payload
             n = len(data)
+        else:
+            # buffer-protocol payloads (numpy chunk views) go to
+            # sendmsg/enqueue without an intermediate bytes() copy
+            data = _as_u8(payload)
+            n = data.nbytes
         if n > self.slot_bytes:
             raise ValueError(
                 f"frame of {n} B exceeds channel slot size "
@@ -596,9 +627,19 @@ def attach_channel(spec: dict, role: str, timeout: float = 60.0):
         return TcpChannel(spec, role)
     if spec.get("lazy"):
         if role == "consumer":
-            ch = ShmRingChannel(spec["name"], nslots=spec["nslots"],
-                                slot_bytes=spec["slot_bytes"],
-                                create=True)
+            try:
+                ch = ShmRingChannel(spec["name"], nslots=spec["nslots"],
+                                    slot_bytes=spec["slot_bytes"],
+                                    create=True)
+            except FileExistsError:
+                # The consumer OWNS this name; an existing segment is a
+                # stale leak from a crashed previous incarnation (names
+                # are incarnation-unique) — reclaim it, don't fail.
+                from multiprocessing import shared_memory as _shm
+                _shm.SharedMemory(name=spec["name"]).unlink()
+                ch = ShmRingChannel(spec["name"], nslots=spec["nslots"],
+                                    slot_bytes=spec["slot_bytes"],
+                                    create=True)
             ch._lazy_owner = True
             return ch
         deadline = time.monotonic() + timeout

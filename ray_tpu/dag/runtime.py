@@ -33,16 +33,49 @@ from ray_tpu.runtime.serialization import dumps_oob, loads_oob, serialize
 _MAX_TIMED_ITEMS = 512   # per-item windows kept for overlap analysis
 
 
+_JAX_ARRAY_T = None     # cached jax.Array type (import hoisted: resolved
+                        # once per process instead of per staged value)
+
+
 def _stage_to_host(value):
-    """Bare jax.Arrays are host-staged into the channel; a method that
-    returns TensorRefs (runtime/device_store.py put_device) opts into
-    the device transport instead — only the small handle rides the
-    channel and the tensor moves on first resolution (zero-copy within
-    a process)."""
-    if "jax" in sys.modules:
+    """jax.Array leaves — bare or inside dict/list/tuple/NamedTuple
+    results — are host-staged into the channel; a method that returns
+    TensorRefs (runtime/device_store.py put_device) opts into the
+    device transport instead — only the small handle rides the channel
+    and the tensor moves on first resolution (zero-copy within a
+    process)."""
+    global _JAX_ARRAY_T
+    if _JAX_ARRAY_T is None:
+        if "jax" not in sys.modules:
+            return value     # no jax in-process: nothing to stage
         import jax
-        if isinstance(value, jax.Array):
-            return np.asarray(value)
+        _JAX_ARRAY_T = jax.Array
+    return _stage_tree(value)
+
+
+def _stage_tree(value):
+    if isinstance(value, _JAX_ARRAY_T):
+        return np.asarray(value)
+    if isinstance(value, dict):
+        staged = {k: _stage_tree(v) for k, v in value.items()}
+        if any(staged[k] is not value[k] for k in staged):
+            if type(value) is dict:
+                return staged
+            try:
+                return type(value)(staged)
+            except TypeError:    # subclass ctor isn't mapping-shaped
+                return value     # (defaultdict etc.): leave unstaged
+        return value
+    if isinstance(value, (list, tuple)):
+        staged = [_stage_tree(v) for v in value]
+        if any(s is not v for s, v in zip(staged, value)):
+            try:
+                if isinstance(value, tuple) and hasattr(value, "_fields"):
+                    return type(value)(*staged)     # NamedTuple
+                return type(value)(staged)
+            except TypeError:
+                return value     # exotic sequence ctor: leave unstaged
+        return value
     return value
 
 
@@ -72,7 +105,12 @@ def _tree_reduce(op: str, vals: list):
     """Elementwise reduce over matching pytrees of arrays/scalars. Host
     plane: numpy, no jax import (reference lowers collective nodes to
     NCCL allreduce, dag/collective_node.py:252; within one process
-    holding a mesh, jit'd psum over ICI is the right tool instead)."""
+    holding a mesh, jit'd psum over ICI is the right tool instead).
+    Low-precision leaves accumulate wide (the policy shared with the
+    ring path: dag/ring.py accumulation_dtype) and cast back to the
+    input dtype at the end — except integer means, which stay float64
+    like a stepwise numpy division would."""
+    from ray_tpu.dag.ring import _keeps_wide, accumulation_dtype
     v0 = vals[0]
     if isinstance(v0, dict):
         return {k: _tree_reduce(op, [v[k] for v in vals]) for k in v0}
@@ -85,16 +123,19 @@ def _tree_reduce(op: str, vals: list):
             _tree_reduce(op, [v[i] for v in vals])
             for i in range(len(v0)))
     arrs = [np.asarray(v) for v in vals]
-    out = arrs[0]
+    acc = accumulation_dtype(arrs[0].dtype, op)
+    out = arrs[0] if acc is None else arrs[0].astype(acc)
     for a in arrs[1:]:
         if op in ("sum", "mean"):
-            out = out + a
+            out = out + (a if acc is None else a.astype(acc))
         elif op == "max":
             out = np.maximum(out, a)
         else:
             out = np.minimum(out, a)
     if op == "mean":
         out = out / len(arrs)
+    if acc is not None and not _keeps_wide(arrs[0].dtype, op):
+        out = out.astype(arrs[0].dtype)
     return out
 
 
@@ -105,13 +146,22 @@ class _Collective:
     blocking in a reduce because one participant failed. Reads are
     bounded by `timeout_s` (shm rings carry no peer-death signal): a
     dead/killed peer surfaces as a terminal stall instead of pinning
-    this actor's executor thread forever."""
+    this actor's executor thread forever.
+
+    Two wire topologies share these semantics: the chunked ring
+    (role "ring", N>2 and all quantized groups — per-participant
+    bandwidth O(S), see dag/ring.py) and the star (roles "root"/"leaf",
+    the N<=2 fallback — root ingress+egress O(N*S))."""
 
     def __init__(self, spec: dict):
         self.role = spec["role"]
         self.op = spec["op"]
         self.timeout_s = float(spec.get("timeout_s", 600.0))
-        if self.role == "root":
+        self._ring = None
+        if self.role == "ring":
+            from ray_tpu.dag.ring import RingReducer
+            self._ring = RingReducer.from_spec(spec)
+        elif self.role == "root":
             self.up = [attach_channel(s, "consumer") for s in spec["up"]]
             self.down = [attach_channel(s, "producer")
                          for s in spec["down"]]
@@ -120,6 +170,8 @@ class _Collective:
             self.down = [attach_channel(spec["down"], "consumer")]
 
     def channels(self) -> list:
+        if self._ring is not None:
+            return self._ring.channels()
         return self.up + self.down
 
     def round(self, kind: int, value, err_frame: Optional[bytes]):
@@ -127,6 +179,15 @@ class _Collective:
         value travels onward as the already-encoded frame — participants
         forward it downstream without a second serialize/deserialize."""
         from ray_tpu.dag.channel import ChannelClosed, ChannelTimeout
+        if self._ring is not None:
+            from ray_tpu.dag.ring import RingPeerDead
+            try:
+                k, out = self._ring.round(kind, value, err_frame)
+            except RingPeerDead as e:
+                raise _ReaderDead(e.cause)
+            if k == ERROR:
+                return (ERROR, out)
+            return (DATA, serialize(out))
         try:
             if self.role == "leaf":
                 if kind == DATA:

@@ -13,6 +13,7 @@ from ray_tpu.train.api import (Checkpoint, CheckpointConfig, FailureConfig,
                                ensure_jax_distributed, get_context, report)
 from ray_tpu.train.boosting import (BoostingConfig, BoostingModel,
                                     BoostingTrainer)
+from ray_tpu.train.collective import allreduce_gradients
 from ray_tpu.train.trainer import (JaxTrainer, SklearnTrainer,
                                    TorchTrainer,
                                    get_controller)
@@ -21,6 +22,6 @@ __all__ = [
     "BoostingConfig", "BoostingModel", "BoostingTrainer",
     "Checkpoint", "CheckpointConfig", "FailureConfig", "Result",
     "RunConfig", "ScalingConfig", "SklearnTrainer",
-    "ensure_jax_distributed", "get_context", "report",
-    "JaxTrainer", "TorchTrainer", "get_controller",
+    "allreduce_gradients", "ensure_jax_distributed", "get_context",
+    "report", "JaxTrainer", "TorchTrainer", "get_controller",
 ]

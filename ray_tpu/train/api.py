@@ -169,7 +169,8 @@ class TrainContext:
                  node_rank: int, resume_checkpoint: Optional[Checkpoint],
                  dataset_shards: Optional[Dict[str, Any]] = None,
                  storage_path: Optional[str] = None,
-                 group_id: str = ""):
+                 group_id: str = "",
+                 grad_sync: Optional[dict] = None):
         self.rank = rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -184,6 +185,12 @@ class TrainContext:
         self._seq = 0
         self._dataset_shards = dataset_shards or {}
         self._storage_path = storage_path
+        # Controller-built ring channel spec for host-plane gradient
+        # sync (train.allreduce_gradients): rank r -> rank (r+1)%N over
+        # shm (same node) / TCP (cross node). Attached lazily — groups
+        # that never allreduce host gradients pay nothing.
+        self._grad_sync = grad_sync
+        self._grad_ring = None
 
     # -- user API --
     def get_world_size(self) -> int:
@@ -200,6 +207,28 @@ class TrainContext:
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self._resume
+
+    def gradient_sync_ring(self):
+        """The lazily-attached chunked ring for host-plane gradient
+        allreduce across the group (dag/ring.py RingReducer); raises
+        when the controller didn't wire one (world_size == 1 groups
+        short-circuit in allreduce_gradients before reaching here)."""
+        if self._grad_ring is None:
+            if self._grad_sync is None:
+                raise RuntimeError(
+                    "host-plane gradient sync is not wired for this "
+                    "worker group (controller predates it, or "
+                    "world_size == 1)")
+            from ray_tpu.dag.ring import RingReducer
+            self._grad_ring = RingReducer.from_spec(self._grad_sync)
+        return self._grad_ring
+
+    def close_gradient_sync(self) -> None:
+        """Release the ring's channels (worker teardown; shm segments
+        must not outlive the group incarnation that named them)."""
+        ring, self._grad_ring = self._grad_ring, None
+        if ring is not None:
+            ring.close()
 
     def get_dataset_shard(self, name: str = "train"):
         shard = self._dataset_shards.get(name)

@@ -1,9 +1,16 @@
-"""Control-plane collectives for train_fn user code: barrier + broadcast.
+"""Collectives for train_fn user code: barrier, broadcast, and
+host-plane gradient allreduce.
 
-Reference: train/collective/collectives.py:16,59 — these are CONTROL
-collectives (rendezvous, config exchange) riding the actor plane. Tensor
-collectives belong to XLA over ICI inside jit (ray_tpu.parallel), never
-here.
+Reference: train/collective/collectives.py:16,59 — barrier/broadcast are
+CONTROL collectives (rendezvous, config exchange) riding the actor
+plane. WITHIN one jax.distributed process group, tensor collectives
+belong to XLA over ICI inside jit (ray_tpu.parallel). Between that and
+the actor plane sits allreduce_gradients: a chunked ring reduce-scatter
++ allgather over shm/TCP channels (dag/ring.py) for host-resident
+gradient pytrees — data-parallel groups that do NOT share a jax
+process group (CPU data-parallel, per-worker independent meshes,
+sklearn/torch backends) sync gradients at O(S) per worker instead of
+shipping full tensors through the rendezvous actor.
 """
 
 from __future__ import annotations
@@ -53,6 +60,62 @@ def _rendezvous_handle():
 
 
 _epochs: dict = {}
+
+
+def allreduce_gradients(value: Any, op: str = "mean", *,
+                        quantize: Optional[str] = None,
+                        timeout_s: Optional[float] = None) -> Any:
+    """Elementwise allreduce of a host gradient pytree (dict / list /
+    tuple / NamedTuple of numpy-compatible arrays) across the train
+    worker group, over the controller-wired chunked ring (dag/ring.py:
+    per-worker traffic is O(S) independent of group size, segments
+    pipeline around the ring, accumulation is float32-or-wider).
+
+    ``quantize="int8"`` ships chunks block-quantized — ~26% of the fp32
+    wire bytes; the per-round elementwise error bound
+    (world_size * max_block_scale / 2) is exported as the
+    ``allreduce_quant_error`` gauge. All results are bitwise identical
+    across workers, so SPMD state cannot diverge.
+
+    Every worker must call this the same number of times with matching
+    layouts and options; a worker that dies mid-ring surfaces as a
+    RuntimeError on every survivor within the ring timeout."""
+    ctx = get_context()
+    if ctx.get_world_size() == 1:
+        # validate like the multi-worker path would: a bad op/quantize
+        # (or quantize over non-float leaves) must not pass on 1
+        # worker and only explode at scale
+        if op not in ("sum", "mean", "max", "min"):
+            raise ValueError(f"unknown op {op!r}")
+        if quantize not in (None, "int8"):
+            raise ValueError(f"quantize must be None or 'int8', "
+                             f"got {quantize!r}")
+        if quantize == "int8":
+            from ray_tpu.dag.ring import _flatten, _wire_dtype
+            leaves, _, _ = _flatten(value)
+            for leaf in leaves:
+                w = _wire_dtype([leaf.dtype], op)
+                if w.kind != "f":
+                    raise TypeError(
+                        "int8 block quantization requires floating-"
+                        f"point values (wire dtype would be {w})")
+        return value
+    from ray_tpu.dag.ring import RingPeerDead, _UNSET
+    try:
+        ring = ctx.gradient_sync_ring()
+        saved = ring.timeout_s
+        if timeout_s is not None:
+            ring.timeout_s = float(timeout_s)
+        try:
+            return ring.reduce(value, op=op,
+                               quantize=quantize if quantize is not None
+                               else _UNSET)
+        finally:
+            ring.timeout_s = saved      # per-call override, not sticky
+    except RingPeerDead as e:
+        raise RuntimeError(
+            f"gradient sync peer lost (worker died mid-ring?): "
+            f"{e.cause}") from e
 
 
 def barrier(tag: str = "default", timeout: float = 120.0) -> None:

@@ -210,20 +210,53 @@ class TrainController:
             self.ckpt_manager.register(
                 Checkpoint(path=path), data.get("metrics", {}))
 
+    def _grad_sync_specs(self, group_id: str):
+        """Ring channel specs for host-plane gradient sync
+        (train.allreduce_gradients — the dag collective plane's chunked
+        ring, dag/ring.py): one directed edge rank r -> rank (r+1)%N.
+        Ranks are already topology-sorted (_create_group), so adjacent
+        ranks are co-located whenever possible: same-node pairs get a
+        lazily-created shm ring (consumer creates at attach), only
+        genuinely cross-node pairs pay TCP (endpoint negotiated via the
+        control KV). Workers attach lazily on their first allreduce."""
+        n = len(self._workers)
+        if n < 2:
+            return [None] * n
+        from ray_tpu.dag.channel import new_tcp_spec
+        # 4 MB slots (the dag compiler's default): chunk frames are
+        # clamped to the slot, and header/error frames (layout sig
+        # scales with leaf count) need headroom beyond one chunk
+        nslots, slot_bytes = 4, 4 << 20
+        edges = []
+        for r in range(n):
+            if self._infos[r]["node_id"] == \
+                    self._infos[(r + 1) % n]["node_id"]:
+                edges.append({"name": f"rtgs-{group_id[:12]}-{r}",
+                              "nslots": nslots,
+                              "slot_bytes": slot_bytes, "lazy": True})
+            else:
+                edges.append(new_tcp_spec(nslots, slot_bytes))
+        return [{"rank": r, "size": n, "op": "mean", "timeout_s": 300.0,
+                 "to_next": edges[r], "from_prev": edges[(r - 1) % n]}
+                for r in range(n)]
+
     def _start_train(self):
         self._recover_latest_checkpoint()
         shards = self._split_datasets(len(self._workers))
         # Fresh generation id per group incarnation: restarted groups must
         # not see rendezvous state (barriers/broadcasts) left behind by the
-        # previous incarnation in the detached __train_rendezvous actor.
+        # previous incarnation in the detached __train_rendezvous actor —
+        # and gradient-sync shm segment names must be unique per
+        # incarnation so a restarted ring never attaches a stale segment.
         import uuid
         group_id = uuid.uuid4().hex
+        sync = self._grad_sync_specs(group_id)
         refs = []
         for i, w in enumerate(self._workers):
             refs.append(w.start_train_fn.remote(
                 self.train_fn_payload, self.train_loop_config,
                 self.ckpt_manager.latest, shards[i],
-                self.run_config.storage_path, group_id))
+                self.run_config.storage_path, group_id, sync[i]))
         ray_tpu.get(refs, timeout=120)
 
     def _split_datasets(self, n: int) -> List[Optional[dict]]:

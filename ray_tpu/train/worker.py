@@ -82,7 +82,8 @@ class TrainWorker:
                        resume_checkpoint: Optional[Checkpoint],
                        dataset_shards: Optional[dict] = None,
                        storage_path: Optional[str] = None,
-                       group_id: str = "") -> bool:
+                       group_id: str = "",
+                       grad_sync: Optional[dict] = None) -> bool:
         fn = cloudpickle.loads(fn_payload)
         self.ctx = TrainContext(
             rank=self.rank, world_size=self.world_size,
@@ -90,7 +91,8 @@ class TrainWorker:
             resume_checkpoint=resume_checkpoint,
             dataset_shards=dataset_shards,
             storage_path=storage_path,
-            group_id=group_id)
+            group_id=group_id,
+            grad_sync=grad_sync)
 
         def run():
             set_context(self.ctx)
@@ -102,6 +104,12 @@ class TrainWorker:
             except BaseException as e:  # noqa: BLE001
                 self._error = "".join(traceback.format_exception(e))
             finally:
+                # gradient-sync ring channels must not outlive the
+                # train_fn — a restarted incarnation wires fresh ones
+                try:
+                    self.ctx.close_gradient_sync()
+                except Exception:
+                    pass
                 self._done.set()
 
         self._thread = threading.Thread(target=run, daemon=True)

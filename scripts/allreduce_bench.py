@@ -1,0 +1,196 @@
+"""A/B the dag collective plane: star reduce vs chunked ring vs
+ring + int8 block quantization, over shm channels on one box.
+
+Each participant is a real process running the real _Collective round
+(ray_tpu/dag/runtime.py) — the same code a compiled dag's pinned loop
+executes — so serialize/channel/reduce costs are all in the numbers.
+Sizes 1 MB - 256 MB, 2 - 8 participants. Run:
+
+    python scripts/allreduce_bench.py [--quick]
+
+Prints progress per config to stderr and ONE JSON line to stdout:
+
+    {"bench": "allreduce", "results": [...],
+     "ring_vs_star_64mb_4p": <speedup>,
+     "int8_wire_fraction_64mb_4p": <ring+int8 bytes / ring fp32 bytes>,
+     "int8_max_err_64mb_4p": <max elementwise error vs exact>}
+
+``algbw_gbps`` is algorithm bandwidth: payload_bytes / round_s — the
+number that should stay flat as participants grow for the ring and
+collapse ~1/N for the star (root ingress+egress is O(N*S)).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+MB = 1 << 20
+
+
+def _participant(mode: str, spec: dict, rank: int, nbytes: int,
+                 rounds: int, out_q):
+    """One process, one collective participant: `rounds` timed rounds
+    of a float32 allreduce through the real _Collective."""
+    from ray_tpu.dag.channel import DATA
+    from ray_tpu.dag.ring import allreduce_metrics
+    from ray_tpu.dag.runtime import _Collective
+
+    n = nbytes // 4
+    rng = np.random.default_rng(rank)
+    value = rng.standard_normal(n).astype(np.float32)
+    coll = _Collective(spec)
+    metrics = allreduce_metrics()
+
+    def one_round():
+        kind, frame = coll.round(DATA, value, None)
+        assert kind == DATA, "error frame in bench round"
+        return frame
+
+    one_round()                      # warmup (attach, allocations)
+    wire0 = sum(metrics["bytes"]._values.values())
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        frame = one_round()
+    elapsed = time.perf_counter() - t0
+    if mode == "star":
+        # the star path doesn't meter itself; its traffic is exact by
+        # construction: every edge carries one full serialized value
+        nparts = spec["size"]
+        edges = 2 * (nparts - 1) if spec["role"] == "root" else 2
+        wire = float(edges * nbytes) * rounds
+    else:
+        wire = sum(metrics["bytes"]._values.values()) - wire0
+
+    max_err = None
+    if rank == 0:
+        # exact result is the sum of every rank's seeded value
+        exact = np.zeros(n, np.float64)
+        for r in range(spec.get("size", len(spec.get("up", [])) + 1)):
+            exact += np.random.default_rng(r).standard_normal(n)
+        from ray_tpu.runtime.serialization import loads_oob
+        got = np.asarray(loads_oob(frame.to_bytes()), np.float64)
+        max_err = float(np.abs(got - exact).max())
+    out_q.put({"rank": rank, "elapsed_s": elapsed,
+               "wire_bytes": wire / rounds, "max_err": max_err})
+    for ch in coll.channels():   # quiet exit: no exported-buffer GC noise
+        ch.close()
+
+
+def run_config(mode: str, size_mb: int, nparts: int, rounds: int) -> dict:
+    from ray_tpu.dag.channel import ShmRingChannel
+
+    nbytes = size_mb * MB
+    channels = []
+
+    def shm(nslots, slot_bytes):
+        ch = ShmRingChannel(create=True, nslots=nslots,
+                            slot_bytes=slot_bytes)
+        channels.append(ch)
+        return ch.spec()
+
+    specs = []
+    if mode == "star":
+        # full-frame slots: the star ships whole serialized values
+        slot = nbytes + MB
+        root = {"role": "root", "op": "sum", "size": nparts,
+                "timeout_s": 120.0, "up": [], "down": []}
+        for _ in range(nparts - 1):
+            up, down = shm(1, slot), shm(1, slot)
+            root["up"].append(up)
+            root["down"].append(down)
+            specs.append({"role": "leaf", "op": "sum", "size": nparts,
+                          "timeout_s": 120.0, "up": up, "down": down})
+        specs.insert(0, root)
+    else:
+        edges = [shm(8, 2 * MB) for _ in range(nparts)]
+        for r in range(nparts):
+            specs.append({"role": "ring", "rank": r, "size": nparts,
+                          "op": "sum", "timeout_s": 120.0,
+                          "quantize": "int8" if mode == "ring_int8"
+                          else None,
+                          "to_next": edges[r],
+                          "from_prev": edges[(r - 1) % nparts]})
+
+    ctx = mp.get_context("fork")
+    out_q = ctx.Queue()
+    procs = [ctx.Process(target=_participant,
+                         args=(mode, specs[r], r, nbytes, rounds, out_q))
+             for r in range(nparts)]
+    for p in procs:
+        p.start()
+    outs = [out_q.get(timeout=600) for _ in range(nparts)]
+    for p in procs:
+        p.join(timeout=60)
+    for ch in channels:
+        ch.close()
+        ch.unlink()
+
+    round_s = max(o["elapsed_s"] for o in outs) / rounds
+    max_err = next(o["max_err"] for o in outs if o["max_err"] is not None)
+    # per-participant wire bytes: the ring's is uniform; the star's is
+    # asymmetric (the root moves 2(N-1)S) — report the max, which is
+    # what the bottleneck link carries
+    wire = max(o["wire_bytes"] for o in outs)
+    return {"mode": mode, "size_mb": size_mb, "participants": nparts,
+            "rounds": rounds, "round_s": round(round_s, 4),
+            "algbw_gbps": round(nbytes / round_s / 1e9, 3),
+            "wire_bytes_per_participant": int(wire),
+            "max_elementwise_err": max_err}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="cap sizes at 64 MB and skip the 8-way sweep")
+    args = ap.parse_args()
+
+    modes = ("star", "ring", "ring_int8")
+    sizes = (1, 8, 64) if args.quick else (1, 8, 64, 256)
+    results = []
+    for size_mb in sizes:                       # size sweep at 4 parts
+        for mode in modes:
+            rounds = 5 if size_mb <= 8 else 3
+            r = run_config(mode, size_mb, 4, rounds)
+            results.append(r)
+            print(json.dumps(r), file=sys.stderr, flush=True)
+    part_sweep = (2,) if args.quick else (2, 8)
+    for nparts in part_sweep:                   # participant sweep, 64 MB
+        for mode in modes:
+            r = run_config(mode, 64, nparts, 3)
+            results.append(r)
+            print(json.dumps(r), file=sys.stderr, flush=True)
+
+    def pick(mode, size_mb, nparts):
+        return next(r for r in results if r["mode"] == mode
+                    and r["size_mb"] == size_mb
+                    and r["participants"] == nparts)
+
+    star = pick("star", 64, 4)
+    ring = pick("ring", 64, 4)
+    ring8 = pick("ring_int8", 64, 4)
+    print(json.dumps({
+        "bench": "allreduce",
+        "transport": "shm",
+        "results": results,
+        "ring_vs_star_64mb_4p": round(
+            star["round_s"] / ring["round_s"], 2),
+        "int8_wire_fraction_64mb_4p": round(
+            ring8["wire_bytes_per_participant"]
+            / ring["wire_bytes_per_participant"], 3),
+        "int8_max_err_64mb_4p": ring8["max_elementwise_err"],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,8 @@ def instantiate_all() -> dict:
     from ray_tpu.serve import proxy, replica
     take(proxy.proxy_metrics())
     take(replica.replica_metrics())
+    from ray_tpu.dag import ring
+    take(ring.allreduce_metrics())
     return out
 
 

@@ -193,3 +193,44 @@ def test_cross_node_overlap_recv_under_compute():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_cross_node_ring_allreduce_over_tcp_channels():
+    """Ring allreduce whose edges cross cluster nodes: both directions
+    of the ring ride credit-windowed TCP channels (the gradient-sync
+    path for multi-host groups)."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.config import Config
+    from ray_tpu.dag import MultiOutputNode, allreduce
+    cfg = Config.from_env(num_workers_prestart=0)
+    c = Cluster(config=cfg)
+    c.add_node(num_cpus=2, resources={"left": 2.0})
+    c.add_node(num_cpus=2, resources={"right": 2.0})
+    ray_tpu.init(address=c.address, num_cpus=0, config=cfg)
+    try:
+        @ray_tpu.remote
+        class W:
+            def __init__(self, k):
+                self.k = k
+
+            def grad(self, x):
+                return {"g": np.full(2048, float(x) * self.k,
+                                     np.float32)}
+
+        w1 = W.options(resources={"left": 1.0}).remote(1.0)
+        w2 = W.options(resources={"right": 1.0}).remote(10.0)
+        with InputNode() as inp:
+            out = MultiOutputNode(
+                allreduce([w.grad.bind(inp) for w in (w1, w2)],
+                          op="sum", impl="ring"))
+        cd = compile(out, nslots=4)
+        try:
+            for i in range(1, 4):
+                vals = cd.execute(i).get(timeout=120)
+                for v in vals:
+                    assert np.allclose(v["g"], i * 11.0)
+        finally:
+            cd.teardown()
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()

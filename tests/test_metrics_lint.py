@@ -25,7 +25,9 @@ def test_framework_metrics_pass_lint():
                  "llm_queue_s", "llm_batch_size",
                  "serve_proxy_queue_s", "serve_proxy_handler_s",
                  "serve_replica_queue_s", "serve_replica_handler_s",
-                 "ray_tpu_tasks_submitted_total"):
+                 "ray_tpu_tasks_submitted_total",
+                 "allreduce_round_s", "allreduce_bytes_total",
+                 "allreduce_quant_error"):
         assert name in registry, name
     errors = mod.lint(registry)
     assert errors == []
