@@ -59,6 +59,13 @@ class Config:
     object_spill_dir: str = ""              # "" = <session>/spill
     stream_backpressure_window: int = 64    # unconsumed items per stream
     stream_producer_inflight: int = 8       # unacked pushes per producer
+    # Collective plane: dag allreduce(impl="auto") picks the star reduce
+    # for payloads at or below this and the chunked ring above it — the
+    # measured crossover on shm channels (ALLREDUCE_BENCH: the star wins
+    # under ~4 MB because a ring round is 3(N-1) sequential hops and hop
+    # latency dominates small frames; above it the root's O(N*S)
+    # ingress/egress collapses).
+    allreduce_star_max_bytes: int = 4 * 1024 * 1024
 
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0

@@ -85,7 +85,8 @@ _REDUCE_OPS = ("sum", "mean", "max", "min")
 
 def allreduce(nodes, op: str = "sum", *, quantize: Optional[str] = None,
               chunk_bytes: Optional[int] = None,
-              impl: Optional[str] = None):
+              impl: Optional[str] = None,
+              payload_bytes: Optional[int] = None):
     """Bind an allreduce across DAG actors (reference:
     dag/collective_node.py:252 + experimental/collective/operations.py —
     which lower to NCCL; here the collective rides the host object plane
@@ -101,8 +102,18 @@ def allreduce(nodes, op: str = "sum", *, quantize: Optional[str] = None,
     (~26% of the fp32 wire bytes; float32 accumulation, per-round error
     bound exported as the ``allreduce_quant_error`` gauge).
     ``chunk_bytes`` tunes the pipeline granularity (default 1 MB,
-    clamped to the channel slot size). ``impl`` forces "star" or
-    "ring" (benchmarks / tests; the default picks per group size).
+    clamped to the channel slot size).
+
+    ``impl`` defaults to "auto": with a ``payload_bytes`` hint (the
+    approximate serialized size of ONE participant's value), the
+    topology is chosen by the measured crossover — star at or below
+    ``Config.allreduce_star_max_bytes`` (default 4 MB: a ring round is
+    3(N-1) sequential hops, and hop latency beats the root's O(N·S)
+    traffic on small frames — ALLREDUCE_BENCH's 1 MB/4p row has the
+    star at 0.8x the ring), ring above it. Without a hint the choice
+    falls back to group size (ring for N>2). Explicit "star"/"ring"
+    always win; ``quantize`` forces the ring (the star has no wire
+    codec).
 
     Takes one upstream MethodNode per participant actor; returns one
     AllReduceNode per participant, each carrying the reduced value. The
@@ -116,12 +127,14 @@ def allreduce(nodes, op: str = "sum", *, quantize: Optional[str] = None,
     if quantize not in (None, "int8"):
         raise ValueError(f"quantize must be None or 'int8', "
                          f"got {quantize!r}")
-    if impl not in (None, "star", "ring"):
-        raise ValueError(f"impl must be None, 'star' or 'ring', "
-                         f"got {impl!r}")
+    if impl not in (None, "auto", "star", "ring"):
+        raise ValueError(f"impl must be None, 'auto', 'star' or "
+                         f"'ring', got {impl!r}")
     if impl == "star" and quantize is not None:
         raise ValueError("the star reduce does not support quantize; "
                          "use impl='ring' (or leave impl unset)")
+    if payload_bytes is not None and payload_bytes < 0:
+        raise ValueError("payload_bytes must be >= 0")
     for n in nodes:
         if not isinstance(n, MethodNode):
             raise TypeError(
@@ -129,10 +142,33 @@ def allreduce(nodes, op: str = "sum", *, quantize: Optional[str] = None,
     import uuid as _uuid
     group = {"id": _uuid.uuid4().hex[:16], "op": op, "size": len(nodes),
              "quantize": quantize, "chunk_bytes": chunk_bytes,
-             "impl": impl, "members": []}
+             "impl": impl, "payload_bytes": payload_bytes,
+             "members": []}
     out = [AllReduceNode(n, group, rank) for rank, n in enumerate(nodes)]
     group["members"] = out
     return out
+
+
+def _resolve_impl(group: dict) -> str:
+    """Star vs ring for one collective group, resolved at compile time
+    (the two topologies wire different channels, so the choice cannot
+    move per-round). Explicit impl wins; quantize forces the ring; a
+    payload hint picks by the benchmarked size crossover
+    (Config.allreduce_star_max_bytes); otherwise group size decides."""
+    impl = group.get("impl")
+    if impl in ("star", "ring"):
+        return impl
+    if group["size"] < 2:
+        return "star"            # a ring needs two ranks to exist
+    if group.get("quantize"):
+        return "ring"
+    pb = group.get("payload_bytes")
+    if pb is not None:
+        from ray_tpu.config import get_config
+        thr = getattr(get_config(), "allreduce_star_max_bytes",
+                      4 * 1024 * 1024)
+        return "star" if pb <= thr else "ring"
+    return "ring" if group["size"] > 2 else "star"
 
 
 class DagFuture:
@@ -341,8 +377,7 @@ class CompiledDag:
         # every other participant sends up / receives the result down.
         for g in self._groups:
             idxs = [idx[id(m.parent)] for m in g["members"]]
-            impl = g.get("impl") or (
-                "ring" if g["size"] > 2 or g.get("quantize") else "star")
+            impl = _resolve_impl(g)
             if impl == "ring":
                 n = g["size"]
                 edges = [self._new_edge(idxs[r], idxs[(r + 1) % n])

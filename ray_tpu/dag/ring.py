@@ -41,6 +41,17 @@ per reduce-scatter hop and the final value once, so a round's total
 bound is (N*max_scale)/2 — exported per round as the
 ``allreduce_quant_error`` gauge. Accumulators stay float32/float64, so
 the error does not compound across rounds.
+
+Phases 2 and 3 are ALSO standalone collective ops
+(``RingReducer.reduce_scatter`` / ``RingReducer.allgather``, surfaced
+through ``_Collective`` and the train plane): reduce-scatter hands each
+rank its owned contiguous shard of the flat reduced value — the ZeRO-1
+unit (arxiv 2004.13336: shard the weight update and optimizer state
+across replicas) — and allgather reassembles shards into the full
+pytree, with an opt-in ``wire_dtype="bfloat16"`` cast codec (half the
+fp32 bytes, one rounding event, owner round-tripped so results stay
+bitwise identical across ranks). The fused allreduce round is exactly
+these two phases back to back over one buffer.
 """
 
 from __future__ import annotations
@@ -81,12 +92,16 @@ def allreduce_metrics() -> dict:
     util/metrics.push_loop, so the head /metrics serves cluster-wide
     allreduce telemetry like the other PR-2 aggregated series).
 
-      allreduce_round_s      wall time of one full allreduce round
-      allreduce_bytes_total  wire bytes this participant wrote
-      allreduce_quant_error  elementwise error bound of the last
-                             quantized round: (N * max_block_scale) / 2
-                             where scale = max|block|/127 (0 when the
-                             round was unquantized)
+      allreduce_round_s       wall time of one full allreduce round
+      reduce_scatter_round_s  wall time of one STANDALONE
+                              reduce-scatter round (headers + N-1 steps)
+      allgather_round_s       wall time of one STANDALONE allgather
+                              round (headers + N-1 steps)
+      allreduce_bytes_total   wire bytes this participant wrote
+      allreduce_quant_error   elementwise error bound of the last
+                              quantized round: (N * max_block_scale) / 2
+                              where scale = max|block|/127 (0 when the
+                              round was unquantized)
     """
     from ray_tpu.util import metrics as m
     return {
@@ -94,10 +109,21 @@ def allreduce_metrics() -> dict:
             "allreduce_round_s",
             "Wall time of one collective-plane allreduce round "
             "(header relay + reduce-scatter + allgather)"),
+        "rs_round": m.Histogram(
+            "reduce_scatter_round_s",
+            "Wall time of one standalone reduce-scatter round "
+            "(header relay + N-1 pipelined chunk steps; the ZeRO "
+            "gradient-shard sync)"),
+        "ag_round": m.Histogram(
+            "allgather_round_s",
+            "Wall time of one standalone allgather round (header "
+            "relay + N-1 pipelined chunk steps; the ZeRO parameter "
+            "reassembly)"),
         "bytes": m.Counter(
             "allreduce_bytes_total",
-            "Wire bytes written by this participant across allreduce "
-            "rounds (headers + chunk frames)"),
+            "Wire bytes written by this participant across collective "
+            "rounds (headers + chunk frames; allreduce, reduce-scatter "
+            "and allgather all meter here)"),
         "quant_err": m.Gauge(
             "allreduce_quant_error",
             "Elementwise error bound of the last quantized round over "
@@ -276,6 +302,101 @@ def _scales_max(frame, n: int) -> float:
     return m if np.isfinite(m) else float("inf")
 
 
+# --- wire codecs ---------------------------------------------------------
+#
+# A codec transforms chunk frames on the wire while accumulation stays
+# in the float32-or-wider buffer dtype: `encode` turns a buffer slice
+# into the frame that ships, `decode` turns a received frame back into
+# the accumulation dtype. Two codecs exist: int8 block quantization
+# (above) and a plain low-precision cast (bfloat16/float16 — half the
+# fp32 bytes, no per-block scales). The allgather phase forwards
+# ENCODED frames verbatim and the segment owner round-trips its own
+# copy, so every rank reconstructs bitwise identical results whichever
+# codec is active.
+
+
+class _Int8Codec:
+    tag = "int8"
+
+    def __init__(self):
+        self.max_scale = 0.0     # feeds the allreduce_quant_error gauge
+
+    def encode(self, arr: np.ndarray) -> bytes:
+        frame, smax = _quantize(arr)
+        self.max_scale = max(self.max_scale, smax)
+        return bytes(frame)
+
+    def decode(self, frame, n: int, wire: np.dtype) -> np.ndarray:
+        self.max_scale = max(self.max_scale, _scales_max(frame, n))
+        out = _dequantize(frame, n)
+        return out if wire == np.float32 else out.astype(wire)
+
+
+class _CastCodec:
+    """Ship chunks cast to a narrower float dtype (bfloat16: half the
+    fp32 wire bytes, ~2^-8 relative rounding per cast event); received
+    frames cast back up into the accumulation dtype."""
+
+    max_scale = 0.0              # cast codecs don't report a quant bound
+
+    def __init__(self, wdt: np.dtype):
+        self.wdt = wdt
+        self.tag = wdt.str
+
+    def encode(self, arr: np.ndarray) -> bytes:
+        return arr.astype(self.wdt, copy=False).tobytes()
+
+    def decode(self, frame, n: int, wire: np.dtype) -> np.ndarray:
+        return np.frombuffer(frame, self.wdt, n).astype(wire)
+
+
+def resolve_wire_dtype(wire_dtype) -> Optional[np.dtype]:
+    """Map the user-facing ``wire_dtype`` option to a numpy dtype.
+    Accepts None, "bfloat16", "float16" (or their dtype objects)."""
+    if wire_dtype is None:
+        return None
+    if isinstance(wire_dtype, str) and wire_dtype == "bfloat16" \
+            or getattr(wire_dtype, "name", None) == "bfloat16":
+        try:
+            import ml_dtypes
+        except ImportError:
+            raise ValueError(
+                "wire_dtype='bfloat16' needs the ml_dtypes package "
+                "(ships with jax)")
+        return np.dtype(ml_dtypes.bfloat16)
+    try:
+        dt = np.dtype(wire_dtype)
+    except TypeError:
+        dt = None
+    if dt == np.float16:
+        return dt
+    raise ValueError(
+        f"wire_dtype must be None, 'bfloat16' or 'float16', "
+        f"got {wire_dtype!r}")
+
+
+def _make_codec(quantize: Optional[str], wdt: Optional[np.dtype]):
+    if quantize == "int8":
+        return _Int8Codec()
+    if wdt is not None:
+        return _CastCodec(wdt)
+    return None
+
+
+def rebuild_from_layout(flat: np.ndarray, layout: dict):
+    """Reassemble a flat vector into the pytree a reduce-scatter-style
+    layout describes: {"rebuild": closure, "leaves": [(shape, size,
+    out_dtype)]}. THE single flat->pytree path — ring.allgather, the
+    train world_size==1 twin, and ShardedOptimizer all rebuild through
+    here so the cast-back policy cannot drift between them."""
+    outs, off = [], 0
+    for shape, size, dt in layout["leaves"]:
+        outs.append(flat[off:off + size].reshape(shape)
+                    .astype(dt, copy=False))
+        off += size
+    return layout["rebuild"](iter(outs))
+
+
 # --- the ring ------------------------------------------------------------
 
 
@@ -289,7 +410,8 @@ class RingReducer:
     def __init__(self, to_next, from_prev, *, rank: int, size: int,
                  op: str = "sum", timeout_s: float = 600.0,
                  quantize: Optional[str] = None,
-                 chunk_bytes: Optional[int] = None):
+                 chunk_bytes: Optional[int] = None,
+                 wire_dtype=None, own: Optional[int] = None):
         if size < 2:
             raise ValueError("ring allreduce needs at least 2 ranks")
         if quantize not in _QUANTIZE_MODES:
@@ -301,6 +423,17 @@ class RingReducer:
         self.op = op
         self.timeout_s = float(timeout_s)
         self.quantize = quantize
+        self.wire_dtype = resolve_wire_dtype(wire_dtype)
+        # The flat value space is split into `size` contiguous segments
+        # (total*i//n .. total*(i+1)//n); this rank OWNS segment `own`
+        # after a reduce-scatter — the shard the ZeRO optimizer updates.
+        # Ring consistency requires ownership to be a rotation:
+        # own(r) = (r + shift) % n with the SAME shift on every rank —
+        # validated in the header phase via the shift tag.
+        self.own = self.rank if own is None else int(own)
+        if not 0 <= self.own < self.size:
+            raise ValueError(
+                f"own segment {self.own} out of range for {size} ranks")
         slot = min(to_next.slot_bytes, from_prev.slot_bytes)
         # floor at 4096 (tiny chunks drown in per-frame overhead) but
         # NEVER exceed the slot — a chunk that can't fit its channel
@@ -309,6 +442,7 @@ class RingReducer:
             4096, min(chunk_bytes or DEFAULT_CHUNK_BYTES, slot)))
         self._m = allreduce_metrics()
         self._wrote = 0           # wire bytes this round (batched inc)
+        self._layout = None       # cached by reduce_scatter for allgather
 
     @classmethod
     def from_spec(cls, spec: Dict[str, Any]) -> "RingReducer":
@@ -351,7 +485,9 @@ class RingReducer:
                    op=spec.get("op", "sum"),
                    timeout_s=timeout_s,
                    quantize=spec.get("quantize"),
-                   chunk_bytes=spec.get("chunk_bytes"))
+                   chunk_bytes=spec.get("chunk_bytes"),
+                   wire_dtype=spec.get("wire_dtype"),
+                   own=spec.get("own"))
 
     def channels(self) -> list:
         return [self.to_next, self.from_prev]
@@ -415,33 +551,73 @@ class RingReducer:
         return [(p, min(p + step, hi)) for p in range(lo, hi, step)]
 
     def _send_chunk(self, arr: np.ndarray):
-        if self._q == "int8":
-            frame, smax = _quantize(arr)
-            self._qmax = max(self._qmax, smax)
-            self._write(frame)
+        if self._codec is not None:
+            self._write(self._codec.encode(arr))
         else:
             self._write(arr.data.cast("B"))
 
+    def _begin(self, op: Optional[str], quantize, wire_dtype):
+        """Resolve + validate per-round options BEFORE any frame moves
+        (a bad option discovered mid-phase would waste a collective
+        round on every rank). Returns the resolved op; sets the round's
+        codec. The shift tag ((own - rank) % size) rides every header
+        sig: segment ownership must be the same rotation on all ranks
+        or reduce-scatter results would interleave garbage.
+
+        Safe defaults land FIRST so _finish (in the caller's finally)
+        works even when validation raises — the standalone ops call
+        this inside their error-frame try, turning a rank-local option
+        failure (e.g. one host missing ml_dtypes) into an error frame
+        every peer sees in one relay instead of a ring-timeout stall."""
+        self._q = None
+        self._codec = None
+        self._shift = (self.own - self.rank) % self.size
+        self._qmax = 0.0
+        self._wrote = 0
+        op = op or self.op
+        if op not in ("sum", "mean", "max", "min"):
+            raise ValueError(f"unknown op {op!r}")
+        q = self.quantize if quantize is _UNSET else quantize
+        if q not in _QUANTIZE_MODES:
+            raise ValueError(f"quantize must be one of {_QUANTIZE_MODES}")
+        wdt = self.wire_dtype if wire_dtype is _UNSET \
+            else resolve_wire_dtype(wire_dtype)
+        if q is not None and wdt is not None:
+            raise ValueError(
+                "quantize and wire_dtype are both wire codecs — pass "
+                "at most one")
+        self._q = q
+        self._codec = _make_codec(q, wdt)
+        return op
+
+    def _finish(self, key: str, t0: float):
+        if self._codec is not None:
+            self._qmax = max(self._qmax, self._codec.max_scale)
+        self._m["bytes"].inc(self._wrote)
+        self._m["quant_err"].set(
+            0.5 * self._qmax * self.size if self._q else 0.0)
+        self._m[key].observe(time.monotonic() - t0)
+
+    def _check_codec_wire(self, wire: np.dtype):
+        if self._codec is not None and wire.kind != "f":
+            name = ("int8 block quantization" if self._q
+                    else f"wire_dtype={self._codec.tag!r}")
+            raise TypeError(
+                f"{name} requires floating-point values "
+                f"(wire dtype would be {wire})")
+
     def round(self, kind: int, value, err_frame: Optional[bytes], *,
               op: Optional[str] = None,
-              quantize=_UNSET) -> Tuple[int, Any]:
+              quantize=_UNSET, wire_dtype=_UNSET) -> Tuple[int, Any]:
         """One collective round. Returns (DATA, reduced_value) or
         (ERROR, frame) — the frame is an already-encoded exception every
         participant agrees on. Raises RingPeerDead when a neighbor stops
-        responding (terminal for the group). ``op``/``quantize``
-        override the constructor defaults for this round (all ranks
-        must pass the same values — validated in the header phase)."""
-        op = op or self.op
-        if op not in ("sum", "mean", "max", "min"):
-            # validate BEFORE any frame moves: a bad op discovered
-            # mid-phase would waste a collective round on every rank
-            raise ValueError(f"unknown op {op!r}")
-        self._q = self.quantize if quantize is _UNSET else quantize
-        if self._q not in _QUANTIZE_MODES:
-            raise ValueError(f"quantize must be one of {_QUANTIZE_MODES}")
+        responding (terminal for the group). ``op``/``quantize``/
+        ``wire_dtype`` override the constructor defaults for this round
+        (all ranks must pass the same values — validated in the header
+        phase)."""
+        op = self._begin(op, quantize, wire_dtype)
         t0 = time.monotonic()
-        self._qmax = 0.0
-        self._wrote = 0
         leaves = rebuild = wires = None
         hdr: Dict[str, Any] = {"origin": self.rank}
         if kind != DATA and err_frame is None:
@@ -456,14 +632,11 @@ class RingReducer:
                 # grads to float64 nor round-trip the counter through
                 # a float)
                 wires = [_wire_dtype([l.dtype], op) for l in leaves]
-                bad = next((w for w in wires if self._q
-                            and w.kind != "f"), None)
-                if bad is not None:
-                    raise TypeError(
-                        "int8 block quantization requires floating-"
-                        f"point values (wire dtype would be {bad})")
+                for w in wires:
+                    self._check_codec_wire(w)
                 hdr["sig"] = (sig, tuple(w.str for w in wires), op,
-                              self._q)
+                              self._codec.tag if self._codec else None,
+                              self._shift)
             except BaseException as e:  # noqa: BLE001 — enters as error
                 try:
                     err_frame = dumps_oob(e)
@@ -474,37 +647,211 @@ class RingReducer:
             hdr["err"] = bytes(err_frame)
         try:
             headers = self._exchange_headers(hdr)
-            err_origins = sorted(o for o, h in headers.items()
-                                 if h.get("err") is not None)
-            if err_origins:
-                # everyone deterministically agrees on the same frame
-                return ERROR, headers[err_origins[0]]["err"]
-            sigs = {o: h["sig"] for o, h in headers.items()}
-            if len(set(sigs.values())) != 1:
-                lines = "; ".join(
-                    f"rank {o}: {sigs[o]!r}" for o in sorted(sigs))
-                return ERROR, dumps_oob(RuntimeError(
-                    "ring allreduce value layouts differ across "
-                    f"participants — {lines}"))
+            agreed = self._agree(headers, "allreduce")
+            if agreed is not None:
+                return ERROR, agreed
             out = self._data_phases(leaves, rebuild, wires, op)
             return DATA, out
         finally:
-            self._m["bytes"].inc(self._wrote)
-            self._m["quant_err"].set(
-                0.5 * self._qmax * self.size if self._q else 0.0)
-            self._m["round"].observe(time.monotonic() - t0)
+            self._finish("round", t0)
+
+    def _agree(self, headers: Dict[int, dict],
+               what: str) -> Optional[bytes]:
+        """Post-header agreement: returns the ERROR frame every rank
+        deterministically settles on (lowest-origin error, or a layout
+        mismatch), or None when the round is clean."""
+        err_origins = sorted(o for o, h in headers.items()
+                             if h.get("err") is not None)
+        if err_origins:
+            return headers[err_origins[0]]["err"]
+        sigs = {o: h["sig"] for o, h in headers.items()}
+        if len(set(sigs.values())) != 1:
+            lines = "; ".join(
+                f"rank {o}: {sigs[o]!r}" for o in sorted(sigs))
+            return dumps_oob(RuntimeError(
+                f"ring {what} value layouts differ across "
+                f"participants — {lines}"))
+        return None
 
     def reduce(self, value, *, op: Optional[str] = None,
-               quantize=_UNSET):
+               quantize=_UNSET, wire_dtype=_UNSET):
         """Convenience wrapper: reduced value, or raises the group's
         agreed error (the train gradient-sync entrypoint)."""
         kind, out = self.round(DATA, value, None, op=op,
-                               quantize=quantize)
+                               quantize=quantize, wire_dtype=wire_dtype)
         if kind == ERROR:
             err = loads_oob(out)
             raise err if isinstance(err, BaseException) \
                 else RuntimeError(str(err))
         return out
+
+    @staticmethod
+    def _raise(frame):
+        err = loads_oob(frame)
+        raise err if isinstance(err, BaseException) \
+            else RuntimeError(str(err))
+
+    # --- standalone collective ops (the ZeRO building blocks) -----------
+
+    def seg_bounds(self, total: int, seg: Optional[int] = None) -> \
+            Tuple[int, int]:
+        """(lo, hi) of segment ``seg`` (default: this rank's OWNED
+        segment) in a flat length-``total`` value space — the canonical
+        contiguous N-way split every collective op here uses."""
+        s = self.own if seg is None else seg
+        n = self.size
+        return total * s // n, total * (s + 1) // n
+
+    def reduce_scatter(self, value, *, op: Optional[str] = None,
+                       quantize=_UNSET):
+        """Standalone reduce-scatter: one header relay (layout/option
+        validation + error propagation, exactly like a fused round)
+        then the N-1 pipelined chunk steps — and NO allgather. Returns
+        this rank's owned flat shard of the elementwise reduction: a
+        1-D array, ``seg_bounds(total)`` of the flattened value space,
+        mean already divided.
+
+        Unlike the fused allreduce (which reduces per-leaf wire-dtype
+        groups), the whole pytree is flattened into ONE wire dtype
+        (numpy promotion over the leaves, low-precision floats widened
+        to float32) — the flat shard is the unit the ZeRO optimizer
+        updates. The layout is cached so a following allgather() can
+        reassemble the full pytree. Raises the group's agreed error on
+        layout mismatch / participant failure, RingPeerDead on a dead
+        neighbor."""
+        t0 = time.monotonic()
+        leaves = rebuild = wire = None
+        hdr: Dict[str, Any] = {"origin": self.rank}
+        err_frame = None
+        try:
+            # option resolution INSIDE the try: a rank-local failure
+            # ships as an error frame and reaches every peer in one
+            # header relay instead of stalling them to ring timeout
+            op = self._begin(op, quantize, _UNSET)
+            leaves, rebuild, sig = _flatten(value)
+            wire = _wire_dtype([l.dtype for l in leaves], op) \
+                if leaves else np.dtype(np.float32)
+            self._check_codec_wire(wire)
+            hdr["sig"] = ("rs", sig, wire.str, op,
+                          self._codec.tag if self._codec else None,
+                          self._shift)
+        except BaseException as e:  # noqa: BLE001 — enters as error
+            try:
+                err_frame = dumps_oob(e)
+            except Exception:
+                err_frame = dumps_oob(RuntimeError(
+                    f"{type(e).__name__}: {e}"))
+        if err_frame is not None:
+            hdr["err"] = bytes(err_frame)
+        try:
+            headers = self._exchange_headers(hdr)
+            agreed = self._agree(headers, "reduce_scatter")
+            if agreed is not None:
+                self._raise(agreed)
+            src, total = self._flat_src(leaves, wire)
+            buf = np.empty(total, wire)
+            bounds = [self.seg_bounds(total, i) for i in range(self.size)]
+            self._rs_phase(src, buf, bounds, wire, op)
+            lo, hi = bounds[self.own]
+            if op == "mean":
+                buf[lo:hi] /= self.size
+            self._layout = {
+                "rebuild": rebuild, "total": total, "wire": wire,
+                "leaves": [(l.shape, l.size,
+                            wire if _keeps_wide(l.dtype, op)
+                            else l.dtype) for l in leaves]}
+            return buf[lo:hi].copy()
+        finally:
+            self._finish("rs_round", t0)
+
+    def allgather(self, shard, *, wire_dtype=_UNSET, total_hint=None,
+                  rebuild: bool = True):
+        """Standalone allgather: every rank contributes its owned flat
+        shard; after the header relay (shard lengths + dtype/option
+        validation) and N-1 verbatim-forwarded chunk steps, every rank
+        holds the full flat vector — reassembled into the cached
+        reduce_scatter pytree layout when one matches (leaves cast back
+        to their input dtypes), else returned flat.
+
+        ``wire_dtype="bfloat16"`` ships every frame cast to bfloat16 —
+        half the fp32 wire bytes, one ~2^-8-relative rounding event per
+        element (the owner round-trips its own shard through the cast so
+        all ranks stay bitwise identical). That is the ZeRO parameter
+        reassembly: updated shards out, full (optionally bf16-shipped)
+        parameters back.
+
+        The cached layout is matched by ``total_hint`` when given, else
+        by owned-slice length — a coincidental length match on an
+        unrelated allgather reuses the stale layout; pass
+        ``rebuild=False`` to ignore the cache entirely (flat vector
+        back, wire dtype taken from the shard itself — what a caller
+        that reassembles its own pytree wants, e.g. ShardedOptimizer
+        rebuilding with PARAMETER leaf dtypes, not gradient ones)."""
+        t0 = time.monotonic()
+        hdr: Dict[str, Any] = {"origin": self.rank}
+        err_frame = None
+        layout = wire = None
+        try:
+            # everything that can fail on THIS rank's inputs — option
+            # resolution included — happens inside the try: the failure
+            # ships as an error frame and reaches every peer in one
+            # header relay, instead of leaving them blocked for the
+            # full ring timeout
+            self._begin(None, _UNSET, wire_dtype)
+            shard = np.ascontiguousarray(np.asarray(shard)).reshape(-1)
+            layout = self._layout if rebuild else None
+            if layout is not None:
+                # use the cached reduce_scatter layout only when this
+                # shard plausibly IS that round's owned slice (explicit
+                # total_hint, or matching owned-segment length) — a
+                # stale layout must not silently recast an unrelated
+                # allgather's wire dtype
+                lo, hi = self.seg_bounds(layout["total"])
+                if (layout["total"] != total_hint
+                        if total_hint is not None
+                        else hi - lo != shard.size):
+                    layout = None
+            wire = layout["wire"] if layout is not None else shard.dtype
+            shard = np.ascontiguousarray(shard, dtype=wire)
+            self._check_codec_wire(wire)
+            hdr["n"] = shard.size
+            hdr["sig"] = ("ag", wire.str,
+                          self._codec.tag if self._codec else None,
+                          self._shift)
+        except BaseException as e:  # noqa: BLE001
+            try:
+                err_frame = dumps_oob(e)
+            except Exception:
+                err_frame = dumps_oob(RuntimeError(
+                    f"{type(e).__name__}: {e}"))
+        if err_frame is not None:
+            hdr["err"] = bytes(err_frame)
+        try:
+            headers = self._exchange_headers(hdr)
+            agreed = self._agree(headers, "allgather")
+            if agreed is not None:
+                self._raise(agreed)
+            total = sum(h["n"] for h in headers.values())
+            bounds = [self.seg_bounds(total, i) for i in range(self.size)]
+            bad = sorted(
+                o for o, h in headers.items()
+                if h["n"] != (lambda b: b[1] - b[0])(
+                    bounds[(o + self._shift) % self.size]))
+            if bad:
+                raise RuntimeError(
+                    f"allgather shard lengths do not tile the flat "
+                    f"value space: total {total}, offending rank(s) "
+                    f"{bad} of {self.size} (every rank must pass "
+                    f"exactly its seg_bounds(total) slice)")
+            buf = np.empty(total, wire)
+            lo, hi = bounds[self.own]
+            buf[lo:hi] = shard
+            self._ag_phase(buf, bounds, wire)
+            if layout is None or layout["total"] != total:
+                return buf
+            return rebuild_from_layout(buf, layout)
+        finally:
+            self._finish("ag_round", t0)
 
     # --- data movement --------------------------------------------------
 
@@ -533,35 +880,40 @@ class RingReducer:
                 outs[i] = seg
         return rebuild(iter(outs))
 
-    def _reduce_group(self, leaves, wire, op) -> List[np.ndarray]:
-        """One reduce-scatter + allgather pass over leaves sharing one
-        wire dtype; returns the reduced leaves (wire dtype, original
-        shapes)."""
-        rank, n = self.rank, self.size
-        sizes = [l.size for l in leaves]
-        total = int(sum(sizes))
+    def _flat_src(self, leaves, wire) -> Tuple[np.ndarray, int]:
+        """Concatenate leaves into one flat wire-dtype vector (zero-copy
+        when a single C-contiguous leaf already matches)."""
+        total = int(sum(l.size for l in leaves))
         if len(leaves) == 1 and leaves[0].dtype == wire \
                 and leaves[0].flags.c_contiguous:
-            src = leaves[0].reshape(-1)     # zero-copy fast path
-        else:
-            src = np.empty(total, wire)
-            off = 0
-            for l in leaves:
-                src[off:off + l.size] = np.asarray(
-                    l, dtype=wire).reshape(-1)
-                off += l.size
-        buf = np.empty(total, wire)         # filled by RS + AG below
-        bounds = [(total * i // n, total * (i + 1) // n)
-                  for i in range(n)]
+            return leaves[0].reshape(-1), total
+        src = np.empty(total, wire)
+        off = 0
+        for l in leaves:
+            src[off:off + l.size] = np.asarray(
+                l, dtype=wire).reshape(-1)
+            off += l.size
+        return src, total
+
+    def _rs_phase(self, src, buf, bounds, wire, op):
+        """The reduce-scatter phase: N-1 pipelined chunk steps; after
+        them this rank holds the complete reduction of segment
+        ``self.own`` in buf (NOT mean-divided — the caller owns that,
+        it differs between the fused and standalone paths only in
+        where it happens). Accumulation is fused
+        (fuse(src, incoming, out=buf)) so buf needs no pre-fill, and
+        always in the float32-or-wider wire dtype."""
+        n, own = self.size, self.own
         itemsize = wire.itemsize
         fuse = {"sum": np.add, "mean": np.add,
                 "max": np.maximum, "min": np.minimum}[op]
-
-        # reduce-scatter: after N-1 steps this rank owns the complete
-        # reduction of segment (rank+1)%N in buf
+        # first-sent segment a0 = own - 1: each rank starts one segment
+        # "behind" its owned one, so after N-1 accumulate-and-forward
+        # steps the segment that lands complete is exactly `own`
+        a0 = (own - 1) % n
         for s in range(n - 1):
-            send_seg = (rank - s) % n
-            recv_seg = (rank - s - 1) % n
+            send_seg = (a0 - s) % n
+            recv_seg = (a0 - s - 1) % n
             frm = src if s == 0 else buf    # step 0 ships pristine input
             send_chunks = self._chunks(*bounds[send_seg], itemsize)
             recv_chunks = self._chunks(*bounds[recv_seg], itemsize)
@@ -577,37 +929,35 @@ class RingReducer:
                             raise RingProtocolError(
                                 f"unexpected frame kind {kind} in ring "
                                 f"reduce-scatter")
-                        if self._q == "int8":
-                            inc = _dequantize(mv, hi - lo)
-                            self._qmax = max(self._qmax,
-                                             _scales_max(mv, hi - lo))
+                        if self._codec is not None:
+                            inc = self._codec.decode(mv, hi - lo, wire)
                         else:
                             inc = np.frombuffer(mv, wire)
                         # fused init+accumulate: buf needs no pre-fill
                         fuse(src[lo:hi], inc, out=buf[lo:hi])
                     self._read_with(apply)
 
-        own = (rank + 1) % n
-        own_lo, own_hi = bounds[own]
-        if op == "mean":
-            buf[own_lo:own_hi] /= n
-
-        # allgather: broadcast the owned segment; received frames are
-        # forwarded VERBATIM so quantized payloads are encoded exactly
-        # once and every rank reconstructs identical bytes
+    def _ag_phase(self, buf, bounds, wire):
+        """The allgather phase: this rank broadcasts its owned segment
+        (complete in buf); received frames are forwarded VERBATIM, so
+        codec payloads (int8 / bf16) are encoded exactly once — by the
+        segment owner, which round-trips its own copy — and every rank
+        reconstructs bitwise identical results."""
+        n, own = self.size, self.own
+        itemsize = wire.itemsize
+        codec = self._codec
         outgoing: Optional[List[bytes]] = None
-        if self._q == "int8":
+        if codec is not None:
             outgoing = []
-            for lo, hi in self._chunks(own_lo, own_hi, itemsize):
-                frame, smax = _quantize(buf[lo:hi])
-                self._qmax = max(self._qmax, smax)
-                # the owner applies its own quantization roundtrip so
-                # its result matches what everyone else dequantizes
-                buf[lo:hi] = _dequantize(frame, hi - lo)
-                outgoing.append(bytes(frame))
+            for lo, hi in self._chunks(*bounds[own], itemsize):
+                frame = codec.encode(buf[lo:hi])
+                # the owner applies its own encode/decode roundtrip so
+                # its result matches what everyone else decodes
+                buf[lo:hi] = codec.decode(frame, hi - lo, wire)
+                outgoing.append(frame)
         for s in range(n - 1):
-            send_seg = (rank + 1 - s) % n
-            recv_seg = (rank - s) % n
+            send_seg = (own - s) % n
+            recv_seg = (own - s - 1) % n
             send_chunks = self._chunks(*bounds[send_seg], itemsize)
             recv_chunks = self._chunks(*bounds[recv_seg], itemsize)
             incoming: List[bytes] = []
@@ -626,9 +976,7 @@ class RingReducer:
                             raise RingProtocolError(
                                 f"unexpected frame kind {kind} in ring "
                                 f"allgather")
-                        buf[lo:hi] = _dequantize(frame, hi - lo)
-                        self._qmax = max(self._qmax,
-                                         _scales_max(frame, hi - lo))
+                        buf[lo:hi] = codec.decode(frame, hi - lo, wire)
                         incoming.append(frame)
                     else:
                         def apply(kind, mv, lo=lo, hi=hi):
@@ -641,6 +989,21 @@ class RingReducer:
             if outgoing is not None:
                 outgoing = incoming
 
+    def _reduce_group(self, leaves, wire, op) -> List[np.ndarray]:
+        """One reduce-scatter + allgather pass over leaves sharing one
+        wire dtype; returns the reduced leaves (wire dtype, original
+        shapes). This IS the fused allreduce: the same two standalone
+        phases back to back over one flat buffer — no duplicated
+        phase logic."""
+        n = self.size
+        src, total = self._flat_src(leaves, wire)
+        buf = np.empty(total, wire)         # filled by RS + AG below
+        bounds = [self.seg_bounds(total, i) for i in range(n)]
+        self._rs_phase(src, buf, bounds, wire, op)
+        own_lo, own_hi = bounds[self.own]
+        if op == "mean":
+            buf[own_lo:own_hi] /= n
+        self._ag_phase(buf, bounds, wire)
         # split back into per-leaf views of buf (cast-back to input
         # dtype happens in _data_phases, which knows the leaf policy)
         outs = []

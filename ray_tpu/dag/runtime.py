@@ -174,6 +174,51 @@ class _Collective:
             return self._ring.channels()
         return self.up + self.down
 
+    def _require_ring(self, what: str):
+        if self._ring is None:
+            raise RuntimeError(
+                f"{what} needs a ring collective group (role "
+                f"{self.role!r} is the N<=2 star topology — compile "
+                f"the group with impl='ring' or grow it past 2 "
+                f"participants)")
+        return self._ring
+
+    def reduce_scatter(self, value, *, op: Optional[str] = None,
+                       quantize=None):
+        """Standalone reduce-scatter over the group's ring: returns
+        this rank's owned flat shard of the elementwise reduction (see
+        dag/ring.py RingReducer.reduce_scatter — the ZeRO-1 gradient
+        sync). Raises the group's agreed error; a dead neighbor
+        surfaces as _ReaderDead like any other collective stall."""
+        from ray_tpu.dag.ring import RingPeerDead, _UNSET
+        ring = self._require_ring("reduce_scatter")
+        try:
+            return ring.reduce_scatter(
+                value, op=op,
+                quantize=_UNSET if quantize is None else quantize)
+        except RingPeerDead as e:
+            raise _ReaderDead(e.cause)
+
+    def allgather(self, shard, *, wire_dtype=None,
+                  total_hint: Optional[int] = None,
+                  rebuild: bool = True):
+        """Standalone allgather over the group's ring: every rank
+        contributes its owned flat shard, every rank receives the
+        reassembled value (the cached reduce_scatter pytree layout when
+        one matches — pin the match with ``total_hint``, or skip the
+        cache entirely with ``rebuild=False`` — else the flat vector).
+        ``wire_dtype="bfloat16"`` halves the wire bytes (see
+        RingReducer.allgather)."""
+        from ray_tpu.dag.ring import RingPeerDead, _UNSET
+        ring = self._require_ring("allgather")
+        try:
+            return ring.allgather(
+                shard,
+                wire_dtype=_UNSET if wire_dtype is None else wire_dtype,
+                total_hint=total_hint, rebuild=rebuild)
+        except RingPeerDead as e:
+            raise _ReaderDead(e.cause)
+
     def round(self, kind: int, value, err_frame: Optional[bytes]):
         """Returns (DATA, reduced_frame) or (ERROR, frame). The reduced
         value travels onward as the already-encoded frame — participants

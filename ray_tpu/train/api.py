@@ -230,6 +230,26 @@ class TrainContext:
         if ring is not None:
             ring.close()
 
+    def shard_bounds(self, total: int,
+                     rank: Optional[int] = None) -> Tuple[int, int]:
+        """The (lo, hi) slice of a flat length-``total`` parameter
+        space owned by ``rank`` (default: this worker) under the
+        collective plane's contiguous N-way split — exactly the shard
+        ``reduce_scatter_gradients`` returns and ``allgather_params``
+        expects, and the slice a ZeRO-1 ``ShardedOptimizer`` keeps
+        moments for. Ownership follows the controller's shard map in
+        the ring spec (the ``own`` rotation, identity by default);
+        world_size == 1 owns everything."""
+        n = self.world_size
+        r = self.rank if rank is None else int(rank)
+        if not 0 <= r < n:
+            raise ValueError(f"rank {r} out of range for {n} workers")
+        if n == 1:
+            return 0, total
+        own_self = (self._grad_sync or {}).get("own", self.rank)
+        seg = (r + (own_self - self.rank)) % n
+        return total * seg // n, total * (seg + 1) // n
+
     def get_dataset_shard(self, name: str = "train"):
         shard = self._dataset_shards.get(name)
         if shard is None:

@@ -62,8 +62,55 @@ def _rendezvous_handle():
 _epochs: dict = {}
 
 
+def _validate_codec_opts(value: Any, op: str, quantize: Optional[str],
+                         wire_dtype) -> None:
+    """The single-worker paths still validate like the ring would: a
+    bad op/quantize/wire_dtype (or a codec over non-float leaves) must
+    not pass on 1 worker and only explode at scale."""
+    from ray_tpu.dag.ring import _flatten, _wire_dtype, resolve_wire_dtype
+    if op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unknown op {op!r}")
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize must be None or 'int8', "
+                         f"got {quantize!r}")
+    wdt = resolve_wire_dtype(wire_dtype)
+    if quantize is not None and wdt is not None:
+        raise ValueError("quantize and wire_dtype are both wire codecs "
+                         "— pass at most one")
+    if quantize == "int8" or wdt is not None:
+        name = ("int8 block quantization" if quantize
+                else f"wire_dtype={wire_dtype!r}")
+        leaves, _, _ = _flatten(value)
+        for leaf in leaves:
+            w = _wire_dtype([leaf.dtype], op)
+            if w.kind != "f":
+                raise TypeError(
+                    f"{name} requires floating-point values "
+                    f"(wire dtype would be {w})")
+
+
+def _ring_call(ctx, timeout_s: Optional[float], fn):
+    """Run one collective on the controller-wired ring with an optional
+    per-call timeout override; RingPeerDead surfaces as RuntimeError."""
+    from ray_tpu.dag.ring import RingPeerDead
+    try:
+        ring = ctx.gradient_sync_ring()
+        saved = ring.timeout_s
+        if timeout_s is not None:
+            ring.timeout_s = float(timeout_s)
+        try:
+            return fn(ring)
+        finally:
+            ring.timeout_s = saved      # per-call override, not sticky
+    except RingPeerDead as e:
+        raise RuntimeError(
+            f"gradient sync peer lost (worker died mid-ring?): "
+            f"{e.cause}") from e
+
+
 def allreduce_gradients(value: Any, op: str = "mean", *,
                         quantize: Optional[str] = None,
+                        wire_dtype: Optional[str] = None,
                         timeout_s: Optional[float] = None) -> Any:
     """Elementwise allreduce of a host gradient pytree (dict / list /
     tuple / NamedTuple of numpy-compatible arrays) across the train
@@ -74,48 +121,115 @@ def allreduce_gradients(value: Any, op: str = "mean", *,
     ``quantize="int8"`` ships chunks block-quantized — ~26% of the fp32
     wire bytes; the per-round elementwise error bound
     (world_size * max_block_scale / 2) is exported as the
-    ``allreduce_quant_error`` gauge. All results are bitwise identical
-    across workers, so SPMD state cannot diverge.
+    ``allreduce_quant_error`` gauge. ``wire_dtype="bfloat16"`` instead
+    ships chunks cast to bfloat16 — half the fp32 bytes, ~2^-8 relative
+    rounding per hop, still accumulating in float32 per the
+    accumulation_dtype rules (bf16 gradient sync for groups that do not
+    shard the optimizer — ZeRO users get the same lever per phase via
+    ShardedOptimizer). All results are bitwise identical across
+    workers, so SPMD state cannot diverge.
 
     Every worker must call this the same number of times with matching
     layouts and options; a worker that dies mid-ring surfaces as a
     RuntimeError on every survivor within the ring timeout."""
     ctx = get_context()
     if ctx.get_world_size() == 1:
-        # validate like the multi-worker path would: a bad op/quantize
-        # (or quantize over non-float leaves) must not pass on 1
-        # worker and only explode at scale
-        if op not in ("sum", "mean", "max", "min"):
-            raise ValueError(f"unknown op {op!r}")
-        if quantize not in (None, "int8"):
-            raise ValueError(f"quantize must be None or 'int8', "
-                             f"got {quantize!r}")
-        if quantize == "int8":
-            from ray_tpu.dag.ring import _flatten, _wire_dtype
-            leaves, _, _ = _flatten(value)
-            for leaf in leaves:
-                w = _wire_dtype([leaf.dtype], op)
-                if w.kind != "f":
-                    raise TypeError(
-                        "int8 block quantization requires floating-"
-                        f"point values (wire dtype would be {w})")
+        _validate_codec_opts(value, op, quantize, wire_dtype)
         return value
-    from ray_tpu.dag.ring import RingPeerDead, _UNSET
-    try:
-        ring = ctx.gradient_sync_ring()
-        saved = ring.timeout_s
-        if timeout_s is not None:
-            ring.timeout_s = float(timeout_s)
-        try:
-            return ring.reduce(value, op=op,
-                               quantize=quantize if quantize is not None
-                               else _UNSET)
-        finally:
-            ring.timeout_s = saved      # per-call override, not sticky
-    except RingPeerDead as e:
-        raise RuntimeError(
-            f"gradient sync peer lost (worker died mid-ring?): "
-            f"{e.cause}") from e
+    from ray_tpu.dag.ring import _UNSET
+    return _ring_call(ctx, timeout_s, lambda ring: ring.reduce(
+        value, op=op,
+        quantize=quantize if quantize is not None else _UNSET,
+        wire_dtype=wire_dtype if wire_dtype is not None else _UNSET))
+
+
+def reduce_scatter_gradients(value: Any, op: str = "mean", *,
+                             quantize: Optional[str] = None,
+                             timeout_s: Optional[float] = None):
+    """Reduce-scatter a host gradient pytree across the train worker
+    group: each worker receives ONLY its owned contiguous shard of the
+    flat elementwise reduction (``get_context().shard_bounds(total)``
+    of the flattened value space, mean already divided) — half an
+    allreduce's wire bytes, and the input to a sharded (ZeRO-1)
+    optimizer update (train/zero.py wraps this + allgather_params into
+    ``ShardedOptimizer``). The flat layout is cached ring-side so a
+    following ``allgather_params`` reassembles the full pytree.
+
+    world_size == 1 returns the whole flattened vector (the "shard" is
+    everything)."""
+    ctx = get_context()
+    if ctx.get_world_size() == 1:
+        _validate_codec_opts(value, op, quantize, None)
+        import numpy as np
+        from ray_tpu.dag.ring import _flatten, _keeps_wide, _wire_dtype
+        from ray_tpu.train.zero import _flat
+        leaves0, _, _ = _flatten(value)
+        wire = _wire_dtype([l.dtype for l in leaves0], op) \
+            if leaves0 else np.dtype(np.float32)
+        flat, rebuild, total, leaves = _flat(value, wire)
+        # same cast-back policy as the ring: integer MEANS stay in the
+        # wide wire dtype (a cast back to int would truncate)
+        ctx._local_rs_layout = {
+            "rebuild": rebuild, "total": total, "wire": wire,
+            "leaves": [(l.shape, l.size,
+                        wire if _keeps_wide(l.dtype, op) else l.dtype)
+                       for l in leaves]}
+        return flat
+    from ray_tpu.dag.ring import _UNSET
+    return _ring_call(ctx, timeout_s, lambda ring: ring.reduce_scatter(
+        value, op=op,
+        quantize=quantize if quantize is not None else _UNSET))
+
+
+def allgather_params(shard, *, wire_dtype: Optional[str] = None,
+                     timeout_s: Optional[float] = None,
+                     total_hint: Optional[int] = None):
+    """Allgather each worker's owned flat shard back into the full
+    value: the ZeRO-1 parameter reassembly. When the ring holds a
+    layout cached by a previous ``reduce_scatter_gradients``, the full
+    PYTREE comes back (leaves cast to their input dtypes); otherwise
+    the flat vector. The cached layout is matched by owned-slice
+    length — pass ``total_hint`` (the flat element count you expect to
+    reassemble) to pin the match exactly when gathering something
+    other than the last reduce-scatter's result.
+    ``wire_dtype="bfloat16"`` ships frames in bf16 —
+    half the fp32 wire bytes, one rounding event, bitwise identical on
+    every rank (the shard owner round-trips its own copy).
+
+    world_size == 1 rebuilds locally — applying the same single
+    wire-dtype rounding, so 1-worker runs reproduce the sharded
+    numerics."""
+    ctx = get_context()
+    if ctx.get_world_size() == 1:
+        import numpy as np
+        from ray_tpu.dag.ring import resolve_wire_dtype
+        wdt = resolve_wire_dtype(wire_dtype)
+        flat = np.ascontiguousarray(np.asarray(shard)).reshape(-1)
+        layout = getattr(ctx, "_local_rs_layout", None)
+        if layout is not None and (
+                layout["total"] != total_hint if total_hint is not None
+                else layout["total"] != flat.size):
+            layout = None
+        if layout is not None:
+            flat = np.asarray(flat, dtype=layout["wire"])
+        if wdt is not None and flat.dtype.kind != "f":
+            # same refusal the ring's _check_codec_wire issues: a bf16
+            # cast of integers must not pass on 1 worker and only
+            # explode at scale
+            raise TypeError(
+                f"wire_dtype={wire_dtype!r} requires floating-point "
+                f"values (wire dtype would be {flat.dtype})")
+        if wdt is not None:
+            flat = flat.astype(wdt).astype(flat.dtype)
+        if layout is None or layout["total"] != flat.size:
+            return flat
+        from ray_tpu.dag.ring import rebuild_from_layout
+        return rebuild_from_layout(flat, layout)
+    from ray_tpu.dag.ring import _UNSET
+    return _ring_call(ctx, timeout_s, lambda ring: ring.allgather(
+        shard,
+        wire_dtype=wire_dtype if wire_dtype is not None else _UNSET,
+        total_hint=total_hint))
 
 
 def barrier(tag: str = "default", timeout: float = 120.0) -> None:

@@ -218,7 +218,15 @@ class TrainController:
         ranks are co-located whenever possible: same-node pairs get a
         lazily-created shm ring (consumer creates at attach), only
         genuinely cross-node pairs pay TCP (endpoint negotiated via the
-        control KV). Workers attach lazily on their first allreduce."""
+        control KV). Workers attach lazily on their first allreduce.
+
+        Each spec also carries the incarnation's SHARD MAP: ``own`` is
+        the contiguous segment of the flat parameter space this rank
+        owns after a reduce-scatter (the ZeRO-1 optimizer-state shard
+        — train/zero.py), identity rotation rank->segment today.
+        TrainContext.shard_bounds and the ring validate against it, so
+        a restarted/resized incarnation re-derives a consistent
+        ownership split from its own spec instead of assuming one."""
         n = len(self._workers)
         if n < 2:
             return [None] * n
@@ -237,6 +245,7 @@ class TrainController:
             else:
                 edges.append(new_tcp_spec(nslots, slot_bytes))
         return [{"rank": r, "size": n, "op": "mean", "timeout_s": 300.0,
+                 "own": r,
                  "to_next": edges[r], "from_prev": edges[(r - 1) % n]}
                 for r in range(n)]
 

@@ -82,6 +82,8 @@ def instantiate_all() -> dict:
     take(replica.replica_metrics())
     from ray_tpu.dag import ring
     take(ring.allreduce_metrics())
+    from ray_tpu.train import zero
+    take(zero.zero_metrics())
     return out
 
 

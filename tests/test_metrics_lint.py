@@ -27,7 +27,9 @@ def test_framework_metrics_pass_lint():
                  "serve_replica_queue_s", "serve_replica_handler_s",
                  "ray_tpu_tasks_submitted_total",
                  "allreduce_round_s", "allreduce_bytes_total",
-                 "allreduce_quant_error"):
+                 "allreduce_quant_error",
+                 "reduce_scatter_round_s", "allgather_round_s",
+                 "optim_shard_bytes"):
         assert name in registry, name
     errors = mod.lint(registry)
     assert errors == []
