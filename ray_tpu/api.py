@@ -739,15 +739,20 @@ def timeline(all_nodes: bool = False,
     """Task/actor event timeline (reference: _private/state.py:1010).
 
     ``all_nodes=True`` collects every node's worker span buffers through
-    the control service (submit edges + exec spans, util/tracing.py);
-    ``chrome_path=`` additionally writes a chrome://tracing / Perfetto
-    JSON file and the returned records are the chrome-trace events."""
+    the control service (submit edges + exec spans from
+    util/tracing.py, plus collective ring spans from dag/ring.py) and
+    the head's per-node clock-offset estimates; ``chrome_path=``
+    additionally writes a chrome://tracing / Perfetto JSON file — with
+    cross-node timestamps corrected by the offsets — and the returned
+    records are the chrome-trace events."""
     from ray_tpu.util import events
+    offsets = None
     if all_nodes:
         ctx = _require_init()
         r = _run(ctx.pool.call(ctx.head_addr, "collect_timeline",
                                timeout=45.0))
         evs = list(r.get("events", []))
+        offsets = r.get("clock_offsets")
         if _g.agent is None:
             # driver attached to an externally-started node: its local
             # buffer isn't behind any agent — append it. (With an
@@ -758,7 +763,8 @@ def timeline(all_nodes: bool = False,
         evs = events.dump()
     if chrome_path is not None:
         from ray_tpu.util import tracing
-        return tracing.to_chrome(evs, chrome_path)
+        return tracing.to_chrome(evs, chrome_path,
+                                 clock_offsets=offsets)
     return evs
 
 

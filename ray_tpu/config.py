@@ -92,6 +92,20 @@ class Config:
 
     # --- observability ---
     event_buffer_size: int = 65536
+    # Collective tracing (dag/ring.py): span granularity recorded into
+    # the "collective" event category. "off" = no timing at all (hot
+    # path untouched); "round" = one span + recv-wait/straggler
+    # attribution per collective round (default — a round moves MBs,
+    # the extra clock reads are noise); "chunk" = additionally one
+    # span per chunk send / recv-wait / reduce (post-mortem depth;
+    # bounded by the category's event-buffer sub-budget).
+    collective_trace_level: str = "round"
+    # Flight recorder: per-rank ring of the last K rounds' timing
+    # records, dumped to JSON when a collective raises (peer death,
+    # ERROR relay, protocol desync) — the dump path is attached to the
+    # raised exception. 0 disables.
+    collective_flight_rounds: int = 8
+    collective_flight_dir: str = ""         # "" = <tmp>/ray_tpu_flight
     metrics_export_interval_s: float = 5.0
     metrics_port: int = -1                  # -1 off, 0 ephemeral, >0 fixed
     log_dir: str = ""                       # "" = workers inherit stdio

@@ -386,6 +386,11 @@ class CompiledDag:
                     self._coll_spec[i] = {
                         "role": "ring", "rank": r, "size": n,
                         "op": g["op"],
+                        # distinct trace lane per collective group —
+                        # to_chrome keys flow edges by (group, cid),
+                        # so two rings sharing a label would get
+                        # cross-wired arrows
+                        "group": g["id"][:12],
                         "timeout_s": self._coll_timeout,
                         "quantize": g.get("quantize"),
                         "chunk_bytes": g.get("chunk_bytes"),

@@ -42,6 +42,16 @@ bound is (N*max_scale)/2 — exported per round as the
 ``allreduce_quant_error`` gauge. Accumulators stay float32/float64, so
 the error does not compound across rounds.
 
+Every round is traced (``_RingTrace``): round-level spans by default
+(collective id, op, bytes, codec, send/recv-wait/header timing, train
+step), per-chunk spans at ``collective_trace_level="chunk"`` — all in
+the bounded "collective" event category so ``timeline(all_nodes=True)``
+renders per-rank ring lanes with cross-rank flow edges. Straggler
+attribution piggybacks each rank's recv-wait on the next round's
+header relay (zero extra frames -> the ``allreduce_straggler_rank``
+gauge), and a bounded flight recorder dumps the last K rounds' timing
+to JSON when a round dies, attaching the path to the raised exception.
+
 Phases 2 and 3 are ALSO standalone collective ops
 (``RingReducer.reduce_scatter`` / ``RingReducer.allgather``, surfaced
 through ``_Collective`` and the train plane): reduce-scatter hands each
@@ -56,7 +66,11 @@ these two phases back to back over one buffer.
 
 from __future__ import annotations
 
+import json
+import os
+import tempfile
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -64,6 +78,7 @@ import numpy as np
 from ray_tpu.dag.channel import (DATA, ERROR, ChannelClosed, ChannelTimeout,
                                  attach_channel)
 from ray_tpu.runtime.serialization import dumps_oob, loads_oob
+from ray_tpu.util import events
 
 _UNSET = object()              # "use the constructor default" sentinel
 DEFAULT_CHUNK_BYTES = 1 << 20
@@ -97,6 +112,10 @@ def allreduce_metrics() -> dict:
                               reduce-scatter round (headers + N-1 steps)
       allgather_round_s       wall time of one STANDALONE allgather
                               round (headers + N-1 steps)
+      collective_recv_wait_s  per-round blocked-on-predecessor time
+                              (straggler attribution input; rank tag)
+      allreduce_straggler_rank  rank that dominated the previous
+                              round's critical path (see _RingTrace)
       allreduce_bytes_total   wire bytes this participant wrote
       allreduce_quant_error   elementwise error bound of the last
                               quantized round: (N * max_block_scale) / 2
@@ -124,6 +143,25 @@ def allreduce_metrics() -> dict:
             "Wire bytes written by this participant across collective "
             "rounds (headers + chunk frames; allreduce, reduce-scatter "
             "and allgather all meter here)"),
+        "recv_wait": m.Histogram(
+            "collective_recv_wait_s",
+            "Time this rank spent BLOCKED waiting on its "
+            "ring-predecessor per collective round: the first header "
+            "read (direct wait for the predecessor to enter) plus all "
+            "data-phase reads — header RELAY waits are excluded, they "
+            "smear a late entrant's delay over every rank. The "
+            "cross-rank argmax is the straggler signal: the rank "
+            "AFTER the straggler waits longest. Tagged with this "
+            "participant's rank",
+            tag_keys=("rank",)),
+        "straggler": m.Gauge(
+            "allreduce_straggler_rank",
+            "Rank whose slowness dominated the PREVIOUS collective "
+            "round's critical path — computed identically on every "
+            "rank from the recv-wait map each participant piggybacks "
+            "on the next round's header relay (zero extra frames). "
+            "-1 when no rank's wait dominated (healthy round); unset "
+            "until a full round of attribution data exists"),
         "quant_err": m.Gauge(
             "allreduce_quant_error",
             "Elementwise error bound of the last quantized round over "
@@ -397,6 +435,257 @@ def rebuild_from_layout(flat: np.ndarray, layout: dict):
     return layout["rebuild"](iter(outs))
 
 
+# --- collective tracing + flight recorder --------------------------------
+
+
+TRACE_LEVELS = ("off", "round", "chunk")
+
+
+class _RingTrace:
+    """Per-participant collective tracing and flight recorder.
+
+    Levels (Config.collective_trace_level, overridable per ring spec):
+
+      "round"  one structured span per collective round — collective
+               id, op, payload bytes, codec, send/recv-wait/header
+               timing — recorded into the bounded "collective" event
+               category (util/events) so it rides the existing
+               worker -> agent -> head collection into
+               ``timeline(all_nodes=True)`` / ``to_chrome`` as
+               per-rank ring lanes with cross-rank flow edges.
+      "chunk"  additionally one span per chunk send / recv-wait /
+               reduce-decode, tagged with phase, segment and round —
+               the depth that localizes a slow link to a specific
+               pipeline position.
+
+    **Straggler attribution** costs zero extra frames: each rank
+    piggybacks its previous round's recv-wait total on the header
+    relay (headers already reach every rank), so during round k+1
+    every rank holds every rank's round-k wait and computes the SAME
+    straggler — the rank *preceding* the argmax waiter, because a slow
+    rank starves its downstream neighbor's reads. Exported as the
+    head-aggregated ``allreduce_straggler_rank`` gauge plus per-rank
+    ``collective_recv_wait_s`` histograms.
+
+    The **flight recorder** keeps the last K rounds' timing records in
+    a bounded deque regardless of event-buffer pressure; when a round
+    dies (peer death, agreed ERROR frame, protocol desync) ``dump()``
+    writes them to a JSON file and ``attach()`` stitches the path into
+    the raised exception's message and ``flight_recorder_path``
+    attribute — the first hang in a 600 s-timeout job stays
+    diagnosable after the process is gone.
+    """
+
+    _KIND = {"round": "allreduce", "rs_round": "reduce_scatter",
+             "ag_round": "allgather"}
+
+    def __init__(self, rank: int, size: int, level: str, group: str,
+                 metrics: dict, flight_rounds: int, flight_dir: str):
+        self.rank, self.size = int(rank), int(size)
+        self.level = level
+        self.group = group or "ring"
+        self._m = metrics
+        self.flight: "deque" = deque(maxlen=max(1, int(flight_rounds or 1)))
+        self.flight_dir = flight_dir
+        self.round_no = -1
+        self.step: Optional[int] = None   # train-step tag (callers set)
+        self.prev_wait: Optional[float] = None
+        self.last_rw: Dict[int, float] = {}
+        self.last_straggler: Optional[int] = None
+        self.last_dump_path: Optional[str] = None
+        self._last_dump_ts = 0.0
+        self.cur: Optional[dict] = None
+
+    # -- round lifecycle --------------------------------------------------
+
+    def begin(self) -> None:
+        self.round_no += 1
+        self.cur = {"round": self.round_no, "t0": time.time(),
+                    "kind": None, "op": None, "codec": None,
+                    "step": self.step, "send_s": 0.0, "wait_s": 0.0,
+                    "apply_s": 0.0, "hdr_s": 0.0}
+        if self.level == "chunk":
+            self.cur["chunks"] = []
+
+    def options(self, op: str, codec: Optional[str]) -> None:
+        if self.cur is not None:
+            self.cur["op"] = op
+            self.cur["codec"] = codec
+
+    def io(self, what: str, dt: float, nbytes: int, phase: str,
+           seg: int, apply_s: float = 0.0) -> None:
+        """One wire operation: ``what`` is "send" or "recv", ``dt`` the
+        blocked time, ``apply_s`` the in-window decode/reduce time of a
+        read_with callback.
+
+        ``wait_s`` — the straggler-attribution signal — counts the
+        FIRST header read (the direct wait for the predecessor to
+        enter the round) plus every data-phase read. Later header
+        reads are RELAY forwards: a late entrant's delay reaches every
+        rank through them with nearly equal magnitude, which would
+        smear the argmax across innocent ranks — those land in
+        ``hdr_s`` instead."""
+        cur = self.cur
+        if cur is None:
+            return
+        if phase == "hdr":
+            if what == "recv" and not cur.get("_hdr0"):
+                cur["_hdr0"] = True
+                cur["wait_s"] += dt
+            else:
+                cur["hdr_s"] += dt + apply_s
+        elif what == "send":
+            cur["send_s"] += dt
+        else:
+            cur["wait_s"] += dt
+            cur["apply_s"] += apply_s
+        if "chunks" in cur and phase != "hdr":
+            cur["chunks"].append(
+                {"name": what, "ts": time.time() - dt - apply_s,
+                 "dur": dt, "apply_s": round(apply_s, 6),
+                 "phase": phase, "seg": seg, "bytes": nbytes})
+
+    def header_extra(self) -> dict:
+        ex: dict = {"rn": self.round_no}
+        if self.prev_wait is not None:
+            ex["rw"] = self.prev_wait
+        return ex
+
+    def on_headers(self, headers: Dict[int, dict]) -> None:
+        rw = {o: float(h["rw"]) for o, h in headers.items()
+              if h.get("rw") is not None}
+        if len(rw) != self.size:
+            return                     # first round: no prior data yet
+        self.last_rw = rw
+        waits = sorted(rw.values())
+        top = max(rw, key=lambda o: rw[o])
+        # significance gate: only attribute when one rank's wait
+        # DOMINATES (>= 5 ms absolute and >= 2x the median of the
+        # OTHER ranks' waits — overall median would equal the max for
+        # N=2 and block attribution there) — a healthy round's argmax
+        # is scheduler noise, and pinning a gauge to an innocent rank
+        # is worse than saying "none"
+        rest = waits[:-1]
+        med = rest[len(rest) // 2]
+        if rw[top] >= 0.005 and rw[top] >= 2.0 * med:
+            # everyone's reads stalled behind the rank BEFORE the
+            # longest waiter: that predecessor is the straggler
+            self.last_straggler = (top - 1) % self.size
+        else:
+            self.last_straggler = None
+        try:
+            self._m["straggler"].set(
+                -1 if self.last_straggler is None
+                else self.last_straggler)
+        except Exception:
+            pass
+
+    def end(self, key: str, wrote: int,
+            err: Optional[BaseException]) -> None:
+        cur, self.cur = self.cur, None
+        if cur is None:
+            return
+        kind = self._KIND.get(key, key)
+        cur.pop("_hdr0", None)
+        dur = time.time() - cur["t0"]
+        cur.update(kind=kind, dur=round(dur, 6), bytes=int(wrote),
+                   error=repr(err) if err is not None else None)
+        self.prev_wait = cur["wait_s"]
+        chunks = cur.pop("chunks", None)
+        self.flight.append(dict(cur, chunks=chunks) if chunks is not None
+                           else cur)
+        try:
+            self._m["recv_wait"].observe(
+                cur["wait_s"], tags={"rank": str(self.rank)})
+        except Exception:
+            pass
+        events.record(
+            "collective", "round", ph="X", ts=cur["t0"], dur=dur,
+            kind=kind, op=cur["op"], codec=cur["codec"],
+            group=self.group, cid=cur["round"], rank=self.rank,
+            size=self.size, step=cur["step"], bytes=cur["bytes"],
+            send_s=round(cur["send_s"], 6),
+            recv_wait_s=round(cur["wait_s"], 6),
+            headers_s=round(cur["hdr_s"], 6),
+            straggler=self.last_straggler,
+            error=err is not None, pid=os.getpid())
+        for c in chunks or ():
+            events.record(
+                "collective", c["name"], ph="X", ts=c["ts"],
+                dur=c["dur"] + c["apply_s"], phase=c["phase"],
+                seg=c["seg"], bytes=c["bytes"], group=self.group,
+                cid=cur["round"], rank=self.rank, pid=os.getpid())
+        if err is not None:
+            self.attach(err, self.dump(err))
+
+    # -- post-mortem ------------------------------------------------------
+
+    def summary(self) -> dict:
+        last = None
+        for r in reversed(self.flight):
+            last = {k: v for k, v in r.items() if k != "chunks"}
+            break
+        return {"rank": self.rank, "size": self.size,
+                "group": self.group,
+                "rounds_recorded": len(self.flight),
+                "last_straggler": self.last_straggler,
+                "recv_wait_by_rank": dict(self.last_rw),
+                "last_round": last}
+
+    def dump(self, err: Optional[BaseException]) -> Optional[str]:
+        """Write the flight records to a JSON file; returns the path.
+        Rate-limited (a dag loop relaying ERROR frames per item must
+        not write one file per item); never raises — post-mortem
+        bookkeeping must not mask the real failure."""
+        now = time.time()
+        if now - self._last_dump_ts < 5.0:
+            return self.last_dump_path
+        try:
+            d = self.flight_dir or os.path.join(
+                tempfile.gettempdir(), "ray_tpu_flight")
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"ring-{self.group}-r{self.rank}-{os.getpid()}-"
+                   f"{int(now * 1000)}.json")
+            rounds = list(self.flight)
+            if self.cur is not None:       # the in-flight failing round
+                rounds.append(dict(self.cur))
+            with open(path, "w") as f:
+                json.dump({"error": repr(err) if err else None,
+                           "ts": now, **self.summary(),
+                           "rounds": rounds}, f, default=str)
+            self._last_dump_ts = now
+            self.last_dump_path = path
+            return path
+        except Exception:
+            return None
+
+    def attach(self, err: Optional[BaseException],
+               path: Optional[str]) -> None:
+        """Stitch the dump path + a per-rank summary into the raised
+        exception (and its RingPeerDead ``cause``, whose message is
+        what train/dag error paths re-surface). The message is only
+        rewritten for rank-LOCAL terminal errors (peer death, protocol
+        desync — the path is per-rank anyway); agreed error frames
+        must stay byte-identical on every rank, so those carry the
+        path as attributes only."""
+        if err is None or path is None:
+            return
+        note = f" [collective flight recorder: {path}]"
+        local = isinstance(err, (RingPeerDead, RingProtocolError))
+        for e in (err, getattr(err, "cause", None)):
+            if not isinstance(e, BaseException):
+                continue
+            try:
+                e.flight_recorder_path = path
+                e.flight_recorder_summary = self.summary()
+                if local and e.args and isinstance(e.args[0], str) \
+                        and path not in e.args[0]:
+                    e.args = (e.args[0] + note,) + e.args[1:]
+            except Exception:
+                pass
+
+
 # --- the ring ------------------------------------------------------------
 
 
@@ -411,7 +700,8 @@ class RingReducer:
                  op: str = "sum", timeout_s: float = 600.0,
                  quantize: Optional[str] = None,
                  chunk_bytes: Optional[int] = None,
-                 wire_dtype=None, own: Optional[int] = None):
+                 wire_dtype=None, own: Optional[int] = None,
+                 trace_level: Optional[str] = None, group: str = ""):
         if size < 2:
             raise ValueError("ring allreduce needs at least 2 ranks")
         if quantize not in _QUANTIZE_MODES:
@@ -443,6 +733,24 @@ class RingReducer:
         self._m = allreduce_metrics()
         self._wrote = 0           # wire bytes this round (batched inc)
         self._layout = None       # cached by reduce_scatter for allgather
+        # Collective tracing + flight recorder (Config default, spec
+        # override). "off" skips every clock read on the hot path.
+        from ray_tpu.config import get_config
+        cfg = get_config()
+        level = trace_level if trace_level is not None \
+            else getattr(cfg, "collective_trace_level", "round")
+        if level not in TRACE_LEVELS:
+            raise ValueError(
+                f"collective trace level must be one of {TRACE_LEVELS}, "
+                f"got {level!r}")
+        self._tr = None if level == "off" else _RingTrace(
+            self.rank, self.size, level, group, self._m,
+            getattr(cfg, "collective_flight_rounds", 8),
+            getattr(cfg, "collective_flight_dir", ""))
+        self.step: Optional[int] = None   # train-step span tag
+        self._tr_err: Optional[BaseException] = None
+        self._ph = "hdr"                  # current phase for chunk spans
+        self._seg_tx = self._seg_rx = -1  # current segments in flight
 
     @classmethod
     def from_spec(cls, spec: Dict[str, Any]) -> "RingReducer":
@@ -487,7 +795,9 @@ class RingReducer:
                    quantize=spec.get("quantize"),
                    chunk_bytes=spec.get("chunk_bytes"),
                    wire_dtype=spec.get("wire_dtype"),
-                   own=spec.get("own"))
+                   own=spec.get("own"),
+                   trace_level=spec.get("trace_level"),
+                   group=spec.get("group", ""))
 
     def channels(self) -> list:
         return [self.to_next, self.from_prev]
@@ -506,23 +816,60 @@ class RingReducer:
     def _write(self, payload):
         mv = payload if isinstance(payload, memoryview) \
             else memoryview(payload)
+        tr = self._tr
+        t0 = time.monotonic() if tr is not None else 0.0
         try:
             self.to_next.write(mv, DATA, timeout=self.timeout_s)
         except (ChannelTimeout, ChannelClosed) as e:
+            if tr is not None:   # the stalled write IS the evidence
+                tr.io("send", time.monotonic() - t0, mv.nbytes,
+                      self._ph, self._seg_tx)
             raise RingPeerDead(RuntimeError(
                 f"ring allreduce peer (rank {(self.rank + 1) % self.size})"
                 f" unresponsive for {self.timeout_s}s "
                 f"(participant died?): {e}"))
+        if tr is not None:
+            tr.io("send", time.monotonic() - t0, mv.nbytes,
+                  self._ph, self._seg_tx)
         self._wrote += mv.nbytes
 
     def _read_with(self, fn):
+        tr = self._tr
+        if tr is None:
+            try:
+                return self.from_prev.read_with(fn, self.timeout_s)
+            except (ChannelTimeout, ChannelClosed) as e:
+                raise RingPeerDead(RuntimeError(
+                    f"ring allreduce peer "
+                    f"(rank {(self.rank - 1) % self.size})"
+                    f" unresponsive for {self.timeout_s}s "
+                    f"(participant died?): {e}"))
+        # split the window into WAIT (blocked on the predecessor — the
+        # straggler-attribution signal) and APPLY (fn: decode + reduce)
+        t0 = time.monotonic()
+        box = [t0, t0, 0]
+
+        def timed(kind, mv, fn=fn):
+            box[0] = time.monotonic()
+            box[2] = mv.nbytes
+            out = fn(kind, mv)
+            box[1] = time.monotonic()
+            return out
+
         try:
-            return self.from_prev.read_with(fn, self.timeout_s)
+            out = self.from_prev.read_with(timed, self.timeout_s)
         except (ChannelTimeout, ChannelClosed) as e:
+            # record the fatal wait: in the flight dump THIS is the
+            # row that shows where the round hung
+            tr.io("recv", time.monotonic() - t0, 0,
+                  self._ph, self._seg_rx)
             raise RingPeerDead(RuntimeError(
                 f"ring allreduce peer (rank {(self.rank - 1) % self.size})"
                 f" unresponsive for {self.timeout_s}s "
                 f"(participant died?): {e}"))
+        tr.io("recv", box[0] - t0, box[2], self._ph, self._seg_rx,
+              apply_s=box[1] - box[0])
+        return out
 
     def _read_bytes(self):
         return self._read_with(lambda k, mv: (k, bytes(mv)))
@@ -532,7 +879,12 @@ class RingReducer:
     def _exchange_headers(self, hdr: dict) -> Dict[int, dict]:
         """N-1 relay steps: send own header, forward what arrives.
         Every rank ends holding every rank's header — the ordered,
-        deadlock-free carrier for errors and layout validation."""
+        deadlock-free carrier for errors and layout validation. The
+        tracer piggybacks its previous-round recv-wait here (straggler
+        attribution rides frames that move anyway)."""
+        if self._tr is not None:
+            hdr.update(self._tr.header_extra())
+        self._ph = "hdr"
         headers = {self.rank: hdr}
         frame = dumps_oob(hdr)
         for _ in range(self.size - 1):
@@ -544,6 +896,8 @@ class RingReducer:
             got = loads_oob(data)
             headers[got["origin"]] = got
             frame = data
+        if self._tr is not None:
+            self._tr.on_headers(headers)
         return headers
 
     def _chunks(self, lo: int, hi: int, itemsize: int):
@@ -574,6 +928,12 @@ class RingReducer:
         self._shift = (self.own - self.rank) % self.size
         self._qmax = 0.0
         self._wrote = 0
+        self._tr_err = None
+        self._ph = "hdr"
+        self._seg_tx = self._seg_rx = -1
+        if self._tr is not None:
+            self._tr.step = self.step
+            self._tr.begin()
         op = op or self.op
         if op not in ("sum", "mean", "max", "min"):
             raise ValueError(f"unknown op {op!r}")
@@ -588,6 +948,8 @@ class RingReducer:
                 "at most one")
         self._q = q
         self._codec = _make_codec(q, wdt)
+        if self._tr is not None:
+            self._tr.options(op, self._codec.tag if self._codec else None)
         return op
 
     def _finish(self, key: str, t0: float):
@@ -597,6 +959,11 @@ class RingReducer:
         self._m["quant_err"].set(
             0.5 * self._qmax * self.size if self._q else 0.0)
         self._m[key].observe(time.monotonic() - t0)
+        if self._tr is not None:
+            try:            # tracing must never mask the round's error
+                self._tr.end(key, self._wrote, self._tr_err)
+            except Exception:
+                pass
 
     def _check_codec_wire(self, wire: np.dtype):
         if self._codec is not None and wire.kind != "f":
@@ -649,9 +1016,22 @@ class RingReducer:
             headers = self._exchange_headers(hdr)
             agreed = self._agree(headers, "allreduce")
             if agreed is not None:
+                # the frame is returned, not raised (the dag loop
+                # forwards it downstream), so _tr_err must be set by
+                # hand for the round span to record error=True; dump
+                # now while the round is still in flight — reduce()
+                # and other raisers attach last_dump_path
+                self._tr_err = RuntimeError(
+                    "collective round resolved to an agreed ERROR "
+                    "frame")
+                if self._tr is not None:
+                    self._tr.dump(self._tr_err)
                 return ERROR, agreed
             out = self._data_phases(leaves, rebuild, wires, op)
             return DATA, out
+        except BaseException as e:  # noqa: BLE001 — flight recorder
+            self._tr_err = e
+            raise
         finally:
             self._finish("round", t0)
 
@@ -681,8 +1061,11 @@ class RingReducer:
                                quantize=quantize, wire_dtype=wire_dtype)
         if kind == ERROR:
             err = loads_oob(out)
-            raise err if isinstance(err, BaseException) \
-                else RuntimeError(str(err))
+            if not isinstance(err, BaseException):
+                err = RuntimeError(str(err))
+            if self._tr is not None:
+                self._tr.attach(err, self._tr.last_dump_path)
+            raise err
         return out
 
     @staticmethod
@@ -761,6 +1144,9 @@ class RingReducer:
                             wire if _keeps_wide(l.dtype, op)
                             else l.dtype) for l in leaves]}
             return buf[lo:hi].copy()
+        except BaseException as e:  # noqa: BLE001 — flight recorder
+            self._tr_err = e
+            raise
         finally:
             self._finish("rs_round", t0)
 
@@ -850,6 +1236,9 @@ class RingReducer:
             if layout is None or layout["total"] != total:
                 return buf
             return rebuild_from_layout(buf, layout)
+        except BaseException as e:  # noqa: BLE001 — flight recorder
+            self._tr_err = e
+            raise
         finally:
             self._finish("ag_round", t0)
 
@@ -911,9 +1300,11 @@ class RingReducer:
         # "behind" its owned one, so after N-1 accumulate-and-forward
         # steps the segment that lands complete is exactly `own`
         a0 = (own - 1) % n
+        self._ph = "rs"
         for s in range(n - 1):
             send_seg = (a0 - s) % n
             recv_seg = (a0 - s - 1) % n
+            self._seg_tx, self._seg_rx = send_seg, recv_seg
             frm = src if s == 0 else buf    # step 0 ships pristine input
             send_chunks = self._chunks(*bounds[send_seg], itemsize)
             recv_chunks = self._chunks(*bounds[recv_seg], itemsize)
@@ -955,9 +1346,11 @@ class RingReducer:
                 # its result matches what everyone else decodes
                 buf[lo:hi] = codec.decode(frame, hi - lo, wire)
                 outgoing.append(frame)
+        self._ph = "ag"
         for s in range(n - 1):
             send_seg = (own - s) % n
             recv_seg = (own - s - 1) % n
+            self._seg_tx, self._seg_rx = send_seg, recv_seg
             send_chunks = self._chunks(*bounds[send_seg], itemsize)
             recv_chunks = self._chunks(*bounds[recv_seg], itemsize)
             incoming: List[bytes] = []

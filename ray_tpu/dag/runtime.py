@@ -151,7 +151,13 @@ class _Collective:
     Two wire topologies share these semantics: the chunked ring
     (role "ring", N>2 and all quantized groups — per-participant
     bandwidth O(S), see dag/ring.py) and the star (roles "root"/"leaf",
-    the N<=2 fallback — root ingress+egress O(N*S))."""
+    the N<=2 fallback — root ingress+egress O(N*S)).
+
+    Ring specs may carry ``trace_level`` ("off"/"round"/"chunk") and
+    ``group`` (a lane label): collective spans + the flight recorder
+    (dag/ring.py _RingTrace) ride through unchanged, and a ring that
+    dies mid-round stitches its flight-dump path into the cause that
+    _ReaderDead ships downstream."""
 
     def __init__(self, spec: dict):
         self.role = spec["role"]

@@ -91,9 +91,11 @@ class NodeAgent:
         self._lease_seq = 0
         self._worker_claims: Dict[str, int] = {}  # env_hash -> claims
         self._wait_queue: List[Tuple[dict, asyncio.Future]] = []
-        from collections import deque
-        # spans pushed by this node's workers (report_events)
-        self._worker_events: "deque" = deque(
+        from ray_tpu.util.events import CategoryBuffer
+        # spans pushed by this node's workers (report_events);
+        # per-category budgets so a chunk-level collective flood can't
+        # evict task exec spans at this aggregation point either
+        self._worker_events = CategoryBuffer(
             maxlen=self.config.event_buffer_size)
         self.cluster_view: Dict[NodeID, dict] = {}
         self._view_version = 0
@@ -134,6 +136,7 @@ class NodeAgent:
             "free_objects": self.free_objects,
             "node_stats": self.node_stats,
             "node_timeline": self.node_timeline,
+            "clock_probe": self.clock_probe,
             "report_events": self.report_events,
             "profile_worker": self.profile_worker,
             "ping": self.ping,
@@ -408,6 +411,16 @@ class NodeAgent:
         self._worker_events.extend(events)
         return {"ok": True, "count": len(events)}
 
+    async def clock_probe(self):
+        """This node's wall clock, read inside the RPC handler: the
+        head brackets the call with its own clock and estimates the
+        per-node offset as remote - midpoint (NTP-style; the probe
+        with the smallest RTT wins). collect_timeline ships the
+        offsets with the events so to_chrome can de-skew cross-node
+        lanes — workers share their node's clock, so node granularity
+        covers their spans too."""
+        return {"t": time.time()}
+
     async def node_timeline(self):
         """This node's event/span buffers: the agent's own plus
         everything its workers pushed (util/tracing.py; the control
@@ -417,7 +430,7 @@ class NodeAgent:
         from ray_tpu.util import events
         nid = self.node_id.hex()
         out = [{**e, "node": nid} for e in events.dump()]
-        out.extend(self._worker_events)
+        out.extend(self._worker_events.dump())
         return {"events": out}
 
     # --- heartbeats / cluster view ------------------------------------------

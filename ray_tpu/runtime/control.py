@@ -123,9 +123,10 @@ class ControlService:
             self._store = FileStore(persist_dir)
         self._recover_deadline = 0.0
         self._drained: set = set()         # node ids removed for good
-        from collections import deque
-        # span buffers archived by departing nodes (collect_timeline)
-        self._archived_events: "deque" = deque(
+        from ray_tpu.util.events import CategoryBuffer
+        # span buffers archived by departing nodes (collect_timeline);
+        # per-category budgets, same rule as the node-local buffers
+        self._archived_events = CategoryBuffer(
             maxlen=self.config.event_buffer_size)
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
@@ -1147,24 +1148,58 @@ class ControlService:
         self._archived_events.extend(events)
         return {"ok": True, "count": len(events)}
 
+    async def _clock_offset(self, addr) -> Optional[Tuple[float, float]]:
+        """Estimate a node's wall-clock offset vs this head: bracket a
+        clock_probe RPC with local clock reads, offset = remote -
+        midpoint; of 3 probes the one with the smallest RTT wins (its
+        midpoint assumption — symmetric network halves — is tightest).
+        Returns (offset_s, rtt_s), or None when the agent predates the
+        probe RPC / is unreachable."""
+        best = None
+        try:
+            for _ in range(3):
+                t0 = time.time()
+                r = await self.pool.call(addr, "clock_probe", timeout=5.0)
+                t1 = time.time()
+                rtt = t1 - t0
+                off = float(r["t"]) - (t0 + t1) / 2.0
+                if best is None or rtt < best[1]:
+                    best = (off, rtt)
+        except Exception:
+            return best
+        return best
+
     async def collect_timeline(self) -> dict:
         """Cluster-wide event/span collection: archived buffers from
         departed nodes + a fan-out to every alive agent (reference
-        surface: ray.timeline via gcs_task_manager)."""
-        async def pull(addr):
+        surface: ray.timeline via gcs_task_manager). Alongside the
+        events, each alive node's wall-clock offset vs this head is
+        estimated (ping-style midpoint over the same control-plane
+        RPCs) and returned as ``clock_offsets`` — to_chrome subtracts
+        them so merged cross-node lanes line up and collective flow
+        arrows cannot point backwards."""
+        async def pull(n):
+            evs: list = []
             try:
-                r = await self.pool.call(addr, "node_timeline",
+                r = await self.pool.call(n.addr, "node_timeline",
                                          timeout=10.0)
-                return r.get("events", [])
+                evs = r.get("events", [])
             except Exception:
-                return []
+                pass
+            off = await self._clock_offset(n.addr)
+            return n.node_id.hex(), evs, off
 
         results = await asyncio.gather(*[
-            pull(n.addr) for n in list(self.nodes.values()) if n.alive])
-        out = list(self._archived_events)
-        for evs in results:
+            pull(n) for n in list(self.nodes.values()) if n.alive])
+        out = self._archived_events.dump()
+        offsets: Dict[str, float] = {}
+        rtts: Dict[str, float] = {}
+        for nid, evs, off in results:
             out.extend(evs)
-        return {"events": out}
+            if off is not None:
+                offsets[nid], rtts[nid] = off
+        return {"events": out, "clock_offsets": offsets,
+                "clock_rtts": rtts}
 
     async def report_objects(self, node_id: NodeID, objects) -> dict:
         """Bulk object-directory refresh: an agent re-registering after a

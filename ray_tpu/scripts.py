@@ -379,15 +379,68 @@ def cmd_profile(args) -> int:
 
 def cmd_timeline(args) -> int:
     """Collect the cluster-wide task/span timeline; write a
-    chrome://tracing / Perfetto JSON file (reference: `ray timeline`)."""
+    chrome://tracing / Perfetto JSON file (reference: `ray timeline`).
+    Cross-node timestamps are corrected by the head's per-node
+    clock-offset estimates shipped with the collection."""
     from ray_tpu.util.tracing import to_chrome
     addr = _resolve_address(args)
-    evs = _call_head(addr, "collect_timeline").get("events", [])
-    recs = to_chrome(evs, args.output)
-    spans = sum(1 for r in recs if r.get("ph") == "X")
-    flows = sum(1 for r in recs if r.get("ph") == "s")
+    r = _call_head(addr, "collect_timeline")
+    evs = r.get("events", [])
+    offs = r.get("clock_offsets") or {}
+    recs = to_chrome(evs, args.output, clock_offsets=offs)
+    spans = sum(1 for x in recs if x.get("ph") == "X")
+    flows = sum(1 for x in recs if x.get("ph") == "s")
+    skew = max((abs(v) for v in offs.values()), default=0.0)
     print(f"wrote {args.output}: {spans} spans, {flows} flow edges "
-          f"({len(evs)} raw events)")
+          f"({len(evs)} raw events, {len(offs)} node clocks, "
+          f"max |offset| {skew * 1e3:.2f} ms)")
+    return 0
+
+
+def cmd_collectives(args) -> int:
+    """Summarize recent collective-plane rounds off the cluster
+    timeline: op, payload bytes, round time, recv-wait, straggler rank
+    — the `ray-tpu timeline` companion for the ring plane (same rows
+    the dashboard /tasks page renders)."""
+    import time as _time
+
+    from ray_tpu.util.state import (collectives_from_events,
+                                    summarize_collectives)
+    addr = _resolve_address(args)
+    r = _call_head(addr, "collect_timeline")
+    rows = collectives_from_events(r.get("events", []),
+                                   limit=args.limit)
+    if args.json:
+        print(json.dumps({"rounds": rows,
+                          "summary": summarize_collectives(rows)},
+                         default=str, indent=2))
+        return 0
+    if not rows:
+        print("no collective rounds in the timeline (is "
+              "collective_trace_level 'off'?)")
+        return 0
+    for t in rows:
+        started = _time.strftime(
+            "%H:%M:%S", _time.localtime(t["start_time"] or 0))
+        strag = t["straggler"] if t["straggler"] is not None else "-"
+        step = f"step {t['step']}" if t["step"] is not None else "-"
+        status = "ERROR" if t["error"] else "ok"
+        print(f"{started}  {t['kind']:15s} {str(t['op'] or '-'):5s} "
+              f"r{t['rank']}/{t['size']}  "
+              f"{(t['bytes'] or 0) / 1e6:8.2f} MB  "
+              f"{(t['duration_s'] or 0.0) * 1e3:9.2f} ms  "
+              f"wait {(t['recv_wait_s'] or 0.0) * 1e3:8.2f} ms  "
+              f"straggler={strag}  {step}  "
+              f"{t['codec'] or 'fp'}  {status}")
+    print()
+    for a in summarize_collectives(rows):
+        strag = (f"  top straggler rank {a['top_straggler']}"
+                 if a["top_straggler"] is not None else "")
+        print(f"{a['kind']} ({a['op']}, {a['codec'] or 'fp'}): "
+              f"{a['rounds']} rounds, mean "
+              f"{a['mean_s'] * 1e3:.2f} ms, max {a['max_s'] * 1e3:.2f} "
+              f"ms, {a['bytes'] / 1e6:.2f} MB/round, "
+              f"{a['errors']} errors{strag}")
     return 0
 
 
@@ -509,10 +562,19 @@ def main(argv=None) -> int:
 
     pt = sub.add_parser("timeline",
                         help="dump the cluster task timeline "
-                             "(chrome://tracing JSON)")
+                             "(chrome://tracing JSON, clock-offset "
+                             "corrected)")
     pt.add_argument("--address")
     pt.add_argument("-o", "--output", default="timeline.json")
     pt.set_defaults(fn=cmd_timeline)
+
+    pc = sub.add_parser("collectives",
+                        help="summarize recent ring collective rounds "
+                             "(op, bytes, round time, straggler rank)")
+    pc.add_argument("--address")
+    pc.add_argument("--json", action="store_true")
+    pc.add_argument("--limit", type=int, default=50)
+    pc.set_defaults(fn=cmd_collectives)
 
     pj = sub.add_parser("job", help="submit / inspect entrypoint jobs")
     jsub = pj.add_subparsers(dest="job_cmd", required=True)

@@ -191,6 +191,12 @@ class TrainContext:
         # that never allreduce host gradients pay nothing.
         self._grad_sync = grad_sync
         self._grad_ring = None
+        # Train-step tag for collective tracing: bumped once per
+        # completed gradient sync (an allreduce, or the allgather half
+        # closing a reduce-scatter/allgather pair), stamped onto the
+        # ring's spans so timeline lanes and straggler rows say WHICH
+        # step a slow round belongs to.
+        self.collective_step = 0
 
     # -- user API --
     def get_world_size(self) -> int:

@@ -89,23 +89,38 @@ def _validate_codec_opts(value: Any, op: str, quantize: Optional[str],
                     f"(wire dtype would be {w})")
 
 
-def _ring_call(ctx, timeout_s: Optional[float], fn):
+def _ring_call(ctx, timeout_s: Optional[float], fn,
+               bump_step: bool = False):
     """Run one collective on the controller-wired ring with an optional
-    per-call timeout override; RingPeerDead surfaces as RuntimeError."""
+    per-call timeout override; RingPeerDead surfaces as RuntimeError
+    (carrying the collective flight-recorder dump path when one was
+    written — the ring's cause message already names it). The train
+    step tag rides every span; ``bump_step`` advances it AFTER a
+    successful round (one gradient sync == one step; the allgather
+    half of a ZeRO step keeps the same tag)."""
     from ray_tpu.dag.ring import RingPeerDead
     try:
         ring = ctx.gradient_sync_ring()
+        ring.step = getattr(ctx, "collective_step", None)
         saved = ring.timeout_s
         if timeout_s is not None:
             ring.timeout_s = float(timeout_s)
         try:
-            return fn(ring)
+            out = fn(ring)
         finally:
             ring.timeout_s = saved      # per-call override, not sticky
+        if bump_step:
+            ctx.collective_step = getattr(ctx, "collective_step", 0) + 1
+        return out
     except RingPeerDead as e:
-        raise RuntimeError(
+        err = RuntimeError(
             f"gradient sync peer lost (worker died mid-ring?): "
-            f"{e.cause}") from e
+            f"{e.cause}")
+        err.flight_recorder_path = getattr(
+            e, "flight_recorder_path", None)
+        err.flight_recorder_summary = getattr(
+            e, "flight_recorder_summary", None)
+        raise err from e
 
 
 def allreduce_gradients(value: Any, op: str = "mean", *,
@@ -140,7 +155,8 @@ def allreduce_gradients(value: Any, op: str = "mean", *,
     return _ring_call(ctx, timeout_s, lambda ring: ring.reduce(
         value, op=op,
         quantize=quantize if quantize is not None else _UNSET,
-        wire_dtype=wire_dtype if wire_dtype is not None else _UNSET))
+        wire_dtype=wire_dtype if wire_dtype is not None else _UNSET),
+        bump_step=True)
 
 
 def reduce_scatter_gradients(value: Any, op: str = "mean", *,
@@ -176,6 +192,7 @@ def reduce_scatter_gradients(value: Any, op: str = "mean", *,
                        for l in leaves]}
         return flat
     from ray_tpu.dag.ring import _UNSET
+    # no bump: the ZeRO step's allgather half must share this tag
     return _ring_call(ctx, timeout_s, lambda ring: ring.reduce_scatter(
         value, op=op,
         quantize=quantize if quantize is not None else _UNSET))
@@ -229,7 +246,7 @@ def allgather_params(shard, *, wire_dtype: Optional[str] = None,
     return _ring_call(ctx, timeout_s, lambda ring: ring.allgather(
         shard,
         wire_dtype=wire_dtype if wire_dtype is not None else _UNSET,
-        total_hint=total_hint))
+        total_hint=total_hint), bump_step=True)
 
 
 def barrier(tag: str = "default", timeout: float = 120.0) -> None:

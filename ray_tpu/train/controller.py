@@ -246,6 +246,9 @@ class TrainController:
                 edges.append(new_tcp_spec(nslots, slot_bytes))
         return [{"rank": r, "size": n, "op": "mean", "timeout_s": 300.0,
                  "own": r,
+                 # collective spans/flight dumps tag this group id, so
+                 # timeline lanes and post-mortems name the incarnation
+                 "group": group_id[:12],
                  "to_next": edges[r], "from_prev": edges[(r - 1) % n]}
                 for r in range(n)]
 

@@ -141,6 +141,7 @@ class ShardedOptimizer:
         self._g = group
         self._g_resolved = group is not None
         self._m = zero_metrics()
+        self._step = 0      # collective-span train-step tag (tracing)
 
     # -- group resolution --------------------------------------------------
 
@@ -190,6 +191,11 @@ class ShardedOptimizer:
                 "ShardedOptimizer.update needs params (the allgather "
                 "reassembles updated parameters, not updates)")
         g = self._group()
+        if g is not None and hasattr(g, "step"):
+            # both halves of this update (RS + AG) trace as one step —
+            # the timeline's ring lanes group by it, and a straggler
+            # row names the step it stalled
+            g.step = self._step
         # ONE structure walk per step: leaves feed the wire dtype, the
         # total, the owned-slice copy, and the final rebuild
         leaves, rebuild, _ = _flatten(params)
@@ -244,6 +250,7 @@ class ShardedOptimizer:
         new_params = rebuild_from_layout(new_flat, {
             "rebuild": rebuild,
             "leaves": [(l.shape, l.size, l.dtype) for l in leaves]})
+        self._step += 1
         return new_params, new_state
 
     # -- helpers -----------------------------------------------------------

@@ -244,6 +244,31 @@ async def _tasks(fetch: Fetch, query: str = "") -> bytes:
             f"Full chrome trace: <code>ray-tpu timeline</code></p>"
             + _table(("task", "kind", "where", "duration (ms)",
                       "started", "status"), rows))
+    # collective-plane rounds off the same timeline collection (the
+    # `ray-tpu collectives` summary, rendered next to the task lanes)
+    from ray_tpu.util.state import collectives_from_events
+    crows = []
+    for t in collectives_from_events(r.get("events", []), limit=50):
+        strag = t["straggler"] if t["straggler"] is not None else "-"
+        crows.append((
+            _esc(t["kind"]),
+            _esc(f"{t['op'] or '-'}/{t['codec'] or 'fp'}"),
+            _esc(f"r{t['rank']}/{t['size']}"),
+            f"{(t['bytes'] or 0) / 1e6:.2f}",
+            f"{(t['duration_s'] or 0.0) * 1e3:.2f}",
+            f"{(t['recv_wait_s'] or 0.0) * 1e3:.2f}",
+            _esc(strag),
+            _esc(t["step"] if t["step"] is not None else "-"),
+            _state("ok" if not t["error"] else "ERROR", good=("ok",)),
+        ))
+    if crows:
+        body += ("<h2>collectives</h2>"
+                 "<p class=dim>newest ring rounds (dag/ring.py); "
+                 "CLI: <code>ray-tpu collectives</code>, per-rank "
+                 "lanes: <code>ray-tpu timeline</code></p>"
+                 + _table(("round", "op/codec", "rank", "MB",
+                           "round (ms)", "recv-wait (ms)", "straggler",
+                           "step", "status"), crows))
     return _page("tasks", body)
 
 

@@ -4,6 +4,18 @@ Lightweight analog of the reference's task-event pipeline (reference:
 core_worker/task_event_buffer.h -> gcs/gcs_task_manager.h -> ray.timeline at
 _private/state.py:1010): components append structured events; `dump()`
 returns chrome-trace-style records.
+
+Buffers are bounded PER CATEGORY: chatty categories get their own
+sub-budget so they age out against themselves instead of evicting
+everything else — a chunk-level collective trace (dag/ring.py can emit
+hundreds of spans per allreduce round) must not wipe the task exec
+spans `ray-tpu timeline` / `ray-tpu list tasks` are built on.
+Categories without a dedicated cap share the default budget.
+
+``CATEGORIES`` is the registry of every category the framework
+records; scripts/check_metrics_lint.py greps the source tree for
+``events.record(`` calls and fails on categories not listed here
+(tests/test_metrics_lint.py runs the same lint tier-1).
 """
 
 from __future__ import annotations
@@ -11,39 +23,123 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Deque, List
+from typing import Deque, Dict, List
 
-_BUF: Deque[dict] = deque(maxlen=65536)
+# Every category the framework records (lint-enforced; see module doc).
+#   trace       task/actor submit edges + exec spans (util/tracing.py)
+#   collective  ring collective rounds / chunk phases (dag/ring.py)
+#   worker      worker lifecycle incidents (runtime/agent.py)
+#   cgroup      cgroup attach/availability incidents (runtime/agent.py)
+#   memory      memory-monitor OOM kills (runtime/agent.py)
+CATEGORIES = ("trace", "collective", "worker", "cgroup", "memory")
+
+_DEFAULT_CAP = 65536
+# Dedicated sub-budgets: the key also names the bucket. Everything
+# else shares the "" bucket at _DEFAULT_CAP.
+_CATEGORY_CAPS: Dict[str, int] = {"collective": 16384}
+
+_BUFS: Dict[str, Deque[dict]] = {}
 _LOCK = threading.Lock()
+
+
+def _buf(category: str) -> Deque[dict]:
+    """Bucket for a category (callers hold _LOCK)."""
+    key = category if category in _CATEGORY_CAPS else ""
+    buf = _BUFS.get(key)
+    if buf is None:
+        buf = deque(maxlen=_CATEGORY_CAPS.get(key, _DEFAULT_CAP))
+        _BUFS[key] = buf
+    return buf
+
+
+class CategoryBuffer:
+    """Per-category bounded buffer for aggregated span streams — the
+    agent's worker-pushed events (report_events) and the head's
+    archived node buffers (report_node_events). Same budgeting rule as
+    the module-level buffer: categories with a dedicated cap age out
+    against themselves, everything else shares the default bucket.
+    Without this the aggregation points re-flatten the stream and a
+    chunk-level collective flood evicts task exec spans there even
+    though the worker-side buckets held."""
+
+    def __init__(self, maxlen: int = _DEFAULT_CAP):
+        self._maxlen = int(maxlen)
+        self._bufs: Dict[str, Deque[dict]] = {}
+        self._lock = threading.Lock()
+
+    def _bucket(self, category: str) -> Deque[dict]:
+        key = category if category in _CATEGORY_CAPS else ""
+        buf = self._bufs.get(key)
+        if buf is None:
+            # dedicated caps scale with the configured total so
+            # event_buffer_size keeps meaning "total budget"
+            cap = (max(1, _CATEGORY_CAPS[key] * self._maxlen
+                       // _DEFAULT_CAP)
+                   if key else self._maxlen)
+            buf = deque(maxlen=cap)
+            self._bufs[key] = buf
+        return buf
+
+    def extend(self, events) -> None:
+        with self._lock:
+            for e in events:
+                self._bucket(e.get("cat", "")).append(e)
+
+    def dump(self) -> List[dict]:
+        with self._lock:
+            out: List[dict] = []
+            for buf in self._bufs.values():
+                out.extend(buf)
+            out.sort(key=lambda e: e.get("ts", 0.0))
+            return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._bufs.values())
 
 
 def record(category: str, name: str, **fields) -> None:
     ev = {"cat": category, "name": name, "ts": time.time(), **fields}
     with _LOCK:
-        _BUF.append(ev)
+        _buf(category).append(ev)
+
+
+def _merged() -> List[dict]:
+    """All buckets merged in timestamp order (callers hold _LOCK).
+    Consumers (to_chrome, tasks_from_events) sort or bucket by ts
+    themselves, but a stable time order keeps dumps readable."""
+    out: List[dict] = []
+    for buf in _BUFS.values():
+        out.extend(buf)
+    out.sort(key=lambda e: e.get("ts", 0.0))
+    return out
 
 
 def dump() -> List[dict]:
     with _LOCK:
-        return list(_BUF)
+        return _merged()
 
 
 def drain() -> List[dict]:
     """Atomically take-and-clear (the worker's periodic flush to its
     agent — events must not be double-shipped or lost in between)."""
     with _LOCK:
-        out = list(_BUF)
-        _BUF.clear()
+        out = _merged()
+        for buf in _BUFS.values():
+            buf.clear()
         return out
 
 
 def requeue(evs: List[dict]) -> None:
-    """Put a drained batch back at the FRONT (a failed flush retries on
-    the next tick instead of losing that window's spans)."""
+    """Put a drained batch back at the FRONT of its buckets (a failed
+    flush retries on the next tick instead of losing that window's
+    spans)."""
     with _LOCK:
-        _BUF.extendleft(reversed(evs))
+        for e in reversed(evs):
+            _buf(e.get("cat", "")).appendleft(e)
 
 
 def clear() -> None:
     with _LOCK:
-        _BUF.clear()
+        for buf in _BUFS.values():
+            buf.clear()

@@ -77,6 +77,75 @@ def tasks_from_events(events, limit: int = 200,
     return rows[:limit]
 
 
+def collectives_from_events(events, limit: int = 50) -> List[dict]:
+    """Timeline "collective" round spans -> summary rows, newest
+    first. The ONE place the collective round-span shape is
+    interpreted — `ray-tpu collectives` and the dashboard /tasks page
+    both render these rows (chunk-level spans are a chrome-trace
+    concern and are skipped here)."""
+    rows = []
+    for e in events:
+        if e.get("cat") != "collective" or e.get("name") != "round":
+            continue
+        rows.append({
+            "kind": e.get("kind", "?"),
+            "op": e.get("op"),
+            "group": e.get("group"),
+            "cid": e.get("cid"),
+            "rank": e.get("rank"),
+            "size": e.get("size"),
+            "step": e.get("step"),
+            "node_id": str(e.get("node", ""))[:16] or None,
+            "pid": e.get("pid"),
+            "start_time": e.get("ts"),
+            "duration_s": e.get("dur", 0.0),
+            "bytes": e.get("bytes", 0),
+            "codec": e.get("codec"),
+            "recv_wait_s": e.get("recv_wait_s", 0.0),
+            "straggler": e.get("straggler"),
+            "error": e.get("error", False),
+        })
+    rows.sort(key=lambda x: -(x["start_time"] or 0))
+    return rows[:limit]
+
+
+def summarize_collectives(rows: List[dict]) -> List[dict]:
+    """Aggregate collective rows per (kind, op, codec): round count,
+    mean/max round time, bytes per round, and the modal straggler rank
+    (the one to go look at first)."""
+    agg: dict = {}
+    for r in rows:
+        a = agg.setdefault((r["kind"], r["op"], r["codec"]), {
+            "kind": r["kind"], "op": r["op"], "codec": r["codec"],
+            "rounds": 0, "total_s": 0.0, "max_s": 0.0, "bytes": 0,
+            "errors": 0, "stragglers": {}})
+        a["rounds"] += 1
+        a["total_s"] += r["duration_s"] or 0.0
+        a["max_s"] = max(a["max_s"], r["duration_s"] or 0.0)
+        a["bytes"] = max(a["bytes"], r["bytes"] or 0)
+        if r["error"]:
+            a["errors"] += 1
+        s = r.get("straggler")
+        if s is not None:
+            a["stragglers"][s] = a["stragglers"].get(s, 0) + 1
+    out = []
+    for a in agg.values():
+        strag = a.pop("stragglers")
+        a["mean_s"] = a["total_s"] / max(1, a["rounds"])
+        a["top_straggler"] = (max(strag, key=lambda k: strag[k])
+                              if strag else None)
+        out.append(a)
+    out.sort(key=lambda x: -x["rounds"])
+    return out
+
+
+def list_collectives(limit: int = 50) -> List[dict]:
+    """Recent collective rounds, newest first, off the cluster
+    timeline (`ray-tpu collectives` from Python)."""
+    r = _call("collect_timeline")
+    return collectives_from_events(r.get("events", []), limit)
+
+
 def list_tasks(limit: int = 200,
                name_filter: Optional[str] = None) -> List[dict]:
     """Recent task/actor-call executions, newest first, off the cluster

@@ -72,30 +72,79 @@ def record_exec(task_hex: str, kind: str, name: str,
                   batch=batch, pid=os.getpid())
 
 
-def to_chrome(evs: List[dict], path: Optional[str] = None) -> List[dict]:
+_COLLECTIVE_ROUND_ARGS = ("op", "codec", "cid", "step", "bytes",
+                          "send_s", "recv_wait_s", "headers_s",
+                          "straggler", "error", "group")
+
+
+def to_chrome(evs: List[dict], path: Optional[str] = None,
+              clock_offsets: Optional[dict] = None) -> List[dict]:
     """Convert collected events into chrome-trace records. Exec spans
     become "X" (complete) events laned by (node, pid); submit edges
-    become flow events when both ends are present."""
+    become flow events when both ends are present. Collective spans
+    (dag/ring.py "collective" category) become per-rank ring lanes
+    (``tid=ring:r<rank>`` under the node's pid group) with flow edges
+    from each rank's round span to its ring-successor's — the wire the
+    data actually took.
+
+    ``clock_offsets`` maps node-id hex -> estimated wall-clock offset
+    vs the collecting head (seconds; see control.collect_timeline).
+    Each event's timestamp is corrected by its node's offset before
+    laning — without this, merged cross-node lanes are skewed by clock
+    drift and flow arrows can point backwards in time. Events without
+    a node tag (the head's own) are taken as offset 0."""
     out = []
+    offs = {str(k): float(v)
+            for k, v in (clock_offsets or {}).items()}
+
+    def adj_us(e, ts: float) -> float:
+        return (ts - offs.get(str(e.get("node", "")), 0.0)) * 1e6
+
     starts = {}        # task hex -> (ts_us, pid, tid)
+    # (group, cid) -> {rank: (start_us, end_us, pid, tid, size)}
+    rounds: dict = {}
     for e in evs:
-        if e.get("cat") != "trace":
-            continue
+        cat = e.get("cat")
         node = str(e.get("node", ""))[:8]
-        pid = e.get("pid", 0)
-        if e.get("name") == "exec":
-            ts_us = e["ts"] * 1e6
+        node_pid = f"node:{node}" if node else "node"
+        if cat == "trace" and e.get("name") == "exec":
+            ts_us = adj_us(e, e["ts"])
             rec = {"ph": "X", "cat": e.get("kind", "task"),
                    "name": e.get("target", "?"),
                    "ts": ts_us, "dur": e.get("dur", 0.0) * 1e6,
-                   "pid": f"node:{node}" if node else "node",
-                   "tid": f"worker:{pid}",
+                   "pid": node_pid,
+                   "tid": f"worker:{e.get('pid', 0)}",
                    "args": {"task": e.get("task", ""),
                             "batch": e.get("batch", 1),
                             "error": e.get("error", False)}}
             out.append(rec)
             if e.get("task"):  # "" (no return oids) is not an identity
                 starts[e["task"]] = (ts_us, rec["pid"], rec["tid"])
+        elif cat == "collective":
+            ts_us = adj_us(e, e["ts"])
+            dur_us = e.get("dur", 0.0) * 1e6
+            tid = f"ring:r{e.get('rank', '?')}"
+            if e.get("name") == "round":
+                rec = {"ph": "X", "cat": "collective",
+                       "name": e.get("kind", "round"),
+                       "ts": ts_us, "dur": dur_us,
+                       "pid": node_pid, "tid": tid,
+                       "args": {k: e[k] for k in _COLLECTIVE_ROUND_ARGS
+                                if e.get(k) is not None}}
+                out.append(rec)
+                key = (e.get("group", ""), e.get("cid"))
+                rounds.setdefault(key, {})[e.get("rank")] = (
+                    ts_us, ts_us + dur_us, node_pid, tid,
+                    int(e.get("size") or 0))
+            else:              # chunk-level span (send/recv)
+                out.append({"ph": "X", "cat": "collective",
+                            "name": f"{e.get('phase', '?')}:"
+                                    f"{e.get('name')}",
+                            "ts": ts_us, "dur": dur_us,
+                            "pid": node_pid, "tid": tid,
+                            "args": {"seg": e.get("seg"),
+                                     "bytes": e.get("bytes"),
+                                     "cid": e.get("cid")}})
     flow = 0
     for e in evs:
         if e.get("cat") != "trace" or e.get("name") != "submit":
@@ -112,6 +161,25 @@ def to_chrome(evs: List[dict], path: Optional[str] = None) -> List[dict]:
         out.append({"ph": "f", "id": flow, "cat": "flow", "name": "spawn",
                     "ts": child[0], "pid": child[1], "tid": child[2],
                     "bp": "e"})
+    # ring flow edges: rank r's round feeds rank (r+1)%N's — drawn
+    # from the producer's round START (first chunk leaves immediately)
+    # to the consumer's round END (its last frame arrives last). With
+    # clock-corrected lanes the arrow can never run backwards: the
+    # consumer cannot finish before the producer started feeding it.
+    for lanes in rounds.values():
+        for rank, (s_us, _e_us, pid, tid, size) in lanes.items():
+            if not isinstance(rank, int) or size < 2:
+                continue
+            nxt = lanes.get((rank + 1) % size)
+            if nxt is None:
+                continue
+            flow += 1
+            out.append({"ph": "s", "id": flow, "cat": "flow",
+                        "name": "ring", "ts": s_us,
+                        "pid": pid, "tid": tid})
+            out.append({"ph": "f", "id": flow, "cat": "flow",
+                        "name": "ring", "ts": nxt[1],
+                        "pid": nxt[2], "tid": nxt[3], "bp": "e"})
     if path is not None:
         with open(path, "w") as f:
             json.dump({"traceEvents": out,

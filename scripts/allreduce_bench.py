@@ -19,6 +19,12 @@ Prints progress per config to stderr and ONE JSON line to stdout:
 number that should stay flat as participants grow for the ring and
 collapse ~1/N for the star (root ingress+egress is O(N*S)).
 
+``--trace <path>`` writes a chrome://tracing JSON of one chunk-level-
+traced ring config's rounds (per-rank lanes + flow edges — the
+loadable artifact perf claims ship with); ``--trace-overhead`` A/Bs
+``collective_trace_level`` off/round/chunk on the ring hot path
+(min-of-3 interleaved reps) into COLLECTIVE_TRACE_BENCH.json.
+
 ``--zero`` instead benches the SHARDED (ZeRO-1) path — standalone
 reduce_scatter / allgather rounds plus end-to-end zero_step (full
 ShardedOptimizer adamw steps: RS grads -> shard update -> AG params)
@@ -55,6 +61,7 @@ def _participant(mode: str, spec: dict, rank: int, nbytes: int,
     from ray_tpu.dag.channel import DATA
     from ray_tpu.dag.ring import allreduce_metrics
     from ray_tpu.dag.runtime import _Collective
+    from ray_tpu.util import events
 
     n = nbytes // 4
     rng = np.random.default_rng(rank)
@@ -68,6 +75,7 @@ def _participant(mode: str, spec: dict, rank: int, nbytes: int,
         return frame
 
     one_round()                      # warmup (attach, allocations)
+    events.clear()                   # trace exactly the timed rounds
     wire0 = sum(metrics["bytes"]._values.values())
     t0 = time.perf_counter()
     for _ in range(rounds):
@@ -91,13 +99,19 @@ def _participant(mode: str, spec: dict, rank: int, nbytes: int,
         from ray_tpu.runtime.serialization import loads_oob
         got = np.asarray(loads_oob(frame.to_bytes()), np.float64)
         max_err = float(np.abs(got - exact).max())
-    out_q.put({"rank": rank, "elapsed_s": elapsed,
-               "wire_bytes": wire / rounds, "max_err": max_err})
+    out = {"rank": rank, "elapsed_s": elapsed,
+           "wire_bytes": wire / rounds, "max_err": max_err}
+    if spec.get("trace_level") not in (None, "off"):
+        # ship this rank's collective spans home for the chrome trace
+        out["events"] = [{**e, "node": "bench"} for e in events.dump()
+                         if e.get("cat") == "collective"]
+    out_q.put(out)
     for ch in coll.channels():   # quiet exit: no exported-buffer GC noise
         ch.close()
 
 
-def run_config(mode: str, size_mb: int, nparts: int, rounds: int) -> dict:
+def run_config(mode: str, size_mb: int, nparts: int, rounds: int,
+               trace_level=None) -> dict:
     from ray_tpu.dag.channel import ShmRingChannel
 
     nbytes = size_mb * MB
@@ -127,6 +141,8 @@ def run_config(mode: str, size_mb: int, nparts: int, rounds: int) -> dict:
         for r in range(nparts):
             specs.append({"role": "ring", "rank": r, "size": nparts,
                           "op": "sum", "timeout_s": 120.0,
+                          "trace_level": trace_level,
+                          "group": f"{mode}-{size_mb}mb",
                           "quantize": "int8" if mode == "ring_int8"
                           else None,
                           "to_next": edges[r],
@@ -152,11 +168,14 @@ def run_config(mode: str, size_mb: int, nparts: int, rounds: int) -> dict:
     # asymmetric (the root moves 2(N-1)S) — report the max, which is
     # what the bottleneck link carries
     wire = max(o["wire_bytes"] for o in outs)
-    return {"mode": mode, "size_mb": size_mb, "participants": nparts,
-            "rounds": rounds, "round_s": round(round_s, 4),
-            "algbw_gbps": round(nbytes / round_s / 1e9, 3),
-            "wire_bytes_per_participant": int(wire),
-            "max_elementwise_err": max_err}
+    res = {"mode": mode, "size_mb": size_mb, "participants": nparts,
+           "rounds": rounds, "round_s": round(round_s, 4),
+           "algbw_gbps": round(nbytes / round_s / 1e9, 3),
+           "wire_bytes_per_participant": int(wire),
+           "max_elementwise_err": max_err}
+    if trace_level not in (None, "off"):
+        res["events"] = [e for o in outs for e in o.get("events", [])]
+    return res
 
 
 # --- ZeRO-1 sharded-optimizer bench --------------------------------------
@@ -373,6 +392,63 @@ def run_zero(quick: bool) -> dict:
     return summary
 
 
+def run_trace_overhead(quick: bool) -> dict:
+    """A/B the collective tracing levels on the ring hot path: the
+    same config at trace_level off / round / chunk. The acceptance
+    bar: "off" must sit within noise of the untraced (PR-4) ring, and
+    "round" — the default — within noise of "off"."""
+    sizes = (8,) if quick else (8, 64)
+    reps = 3                     # interleaved: load noise hits all
+    results = []                 # levels equally, min-of-reps dedupes it
+    for size_mb in sizes:
+        rounds = 5 if size_mb <= 8 else 3
+        best: dict = {}
+        for rep in range(reps):
+            for level in ("off", "round", "chunk"):
+                r = run_config("ring", size_mb, 4, rounds,
+                               trace_level=level)
+                nev = len(r.pop("events", []))
+                r["trace_level"] = level
+                r["collective_events_per_round"] = \
+                    nev // max(1, 4 * rounds)
+                print(json.dumps(dict(r, rep=rep)), file=sys.stderr,
+                      flush=True)
+                if level not in best \
+                        or r["round_s"] < best[level]["round_s"]:
+                    best[level] = r
+        results += [best[lv] for lv in ("off", "round", "chunk")]
+    hl = sizes[-1]
+
+    def pick(level):
+        return next(r for r in results if r["trace_level"] == level
+                    and r["size_mb"] == hl)
+
+    off = pick("off")
+    return {"bench": "collective_trace_overhead", "transport": "shm",
+            "reps": reps, "stat": "min_round_s_of_reps",
+            "results": results,
+            f"round_vs_off_{hl}mb_4p": round(
+                pick("round")["round_s"] / off["round_s"], 3),
+            f"chunk_vs_off_{hl}mb_4p": round(
+                pick("chunk")["round_s"] / off["round_s"], 3)}
+
+
+def write_trace(path: str) -> None:
+    """One chunk-level-traced ring config -> chrome://tracing JSON:
+    per-rank ring lanes, round + chunk spans, cross-rank flow edges —
+    the loadable artifact perf claims ship with."""
+    from ray_tpu.util.tracing import to_chrome
+    r = run_config("ring", 8, 4, 3, trace_level="chunk")
+    evs = r.pop("events", [])
+    recs = to_chrome(evs, path)
+    spans = sum(1 for x in recs if x.get("ph") == "X")
+    flows = sum(1 for x in recs if x.get("ph") == "s")
+    print(f"wrote {path}: {spans} spans, {flows} flow edges from "
+          f"{len(evs)} collective events "
+          f"(8 MB x 4 participants x {r['rounds']} rounds, "
+          f"{r['round_s']}s/round traced)", file=sys.stderr, flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -381,7 +457,28 @@ def main():
                     help="bench the sharded (ZeRO-1) reduce-scatter / "
                          "allgather / zero_step path; writes "
                          "ZERO_BENCH.json")
+    ap.add_argument("--trace", metavar="PATH",
+                    help="run one chunk-level-traced ring config and "
+                         "write a chrome://tracing JSON of its rounds "
+                         "(per-rank lanes + flow edges), then exit")
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="A/B trace_level off/round/chunk on the ring "
+                         "hot path; writes COLLECTIVE_TRACE_BENCH.json")
     args = ap.parse_args()
+
+    if args.trace:
+        write_trace(args.trace)
+        return
+
+    if args.trace_overhead:
+        summary = run_trace_overhead(args.quick)
+        line = json.dumps(summary)
+        out = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "COLLECTIVE_TRACE_BENCH.json")
+        with open(out, "w") as f:
+            f.write(line + "\n")
+        print(line, flush=True)
+        return
 
     if args.zero:
         summary = run_zero(args.quick)

@@ -12,6 +12,12 @@ after importing every instrumented module):
      registry keys by exact name, so ``Foo``/``foo`` could otherwise
      coexist and split a series).
 
+It also lints the EVENT-CATEGORY catalog: every ``events.record(``
+call site in the source tree must use a category enumerated in
+``ray_tpu/util/events.py CATEGORIES`` — categories gate per-category
+buffer budgets and timeline rendering, so an unregistered one would
+silently share the default budget and render nowhere.
+
 Usage: ``python scripts/check_metrics_lint.py`` (exits 1 on findings).
 tests/test_metrics_lint.py runs the same lint as a tier-1 test.
 """
@@ -87,17 +93,64 @@ def instantiate_all() -> dict:
     return out
 
 
+_RECORD_RE = re.compile(
+    r"""events\.record\(\s*(?:(['"])(?P<cat>[^'"]*)\1|(?P<expr>[^,)]+))""")
+
+
+def scan_event_categories(root: str = None) -> list:
+    """Every ``events.record(`` call site under ray_tpu/ as
+    ``(relpath:line, category)``; a non-literal first argument scans as
+    the special category ``<dynamic>`` (flagged — the budget table
+    can't reason about computed categories)."""
+    if root is None:
+        root = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "ray_tpu")
+    found = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            if os.path.join("util", "events.py") in path:
+                continue   # the registry itself (docstring mentions)
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+            for m in _RECORD_RE.finditer(text):
+                cat = m.group("cat")
+                if cat is None:
+                    cat = "<dynamic>"
+                line = text.count("\n", 0, m.start()) + 1
+                rel = os.path.relpath(path, os.path.dirname(root))
+                found.append((f"{rel}:{line}", cat))
+    return found
+
+
+def lint_event_categories(found: list, allowed=None) -> list:
+    """Violations for ``(site, category)`` pairs not in ``allowed``
+    (default: the events.CATEGORIES registry)."""
+    if allowed is None:
+        from ray_tpu.util import events
+        allowed = set(events.CATEGORIES)
+    return sorted(
+        f"{site}: event category {cat!r} not registered in "
+        f"ray_tpu/util/events.py CATEGORIES"
+        for site, cat in found if cat not in allowed)
+
+
 def main() -> int:
     instantiate_all()
     from ray_tpu.util import metrics
     errors = lint(metrics._REGISTRY)
+    found = scan_event_categories()
+    errors += lint_event_categories(found)
     if errors:
-        print(f"{len(errors)} metric lint violation(s):")
+        print(f"{len(errors)} metric/event lint violation(s):")
         for e in errors:
             print(f"  {e}")
         return 1
     print(f"metrics lint ok: {len(metrics._REGISTRY)} registered "
-          f"metric(s) pass")
+          f"metric(s) pass, {len(found)} events.record call site(s) "
+          f"over registered categories")
     return 0
 
 

@@ -29,10 +29,34 @@ def test_framework_metrics_pass_lint():
                  "allreduce_round_s", "allreduce_bytes_total",
                  "allreduce_quant_error",
                  "reduce_scatter_round_s", "allgather_round_s",
+                 "collective_recv_wait_s", "allreduce_straggler_rank",
                  "optim_shard_bytes"):
         assert name in registry, name
     errors = mod.lint(registry)
     assert errors == []
+
+
+def test_event_categories_all_registered():
+    """Every events.record call site in the tree uses a category
+    enumerated in util/events.CATEGORIES (unregistered categories get
+    no buffer sub-budget and render nowhere)."""
+    mod = _load_linter()
+    found = mod.scan_event_categories()
+    # the known instrumented categories actually appear in the scan
+    cats = {c for _, c in found}
+    assert {"trace", "collective"} <= cats, cats
+    assert mod.lint_event_categories(found) == []
+
+
+def test_event_category_lint_flags_unregistered():
+    mod = _load_linter()
+    errs = mod.lint_event_categories(
+        [("x.py:1", "bogus"), ("y.py:2", "trace"),
+         ("z.py:3", "<dynamic>")],
+        allowed={"trace"})
+    assert len(errs) == 2
+    assert any("bogus" in e for e in errs)
+    assert any("<dynamic>" in e for e in errs)
 
 
 def test_lint_flags_violations():
