@@ -74,6 +74,18 @@ class Config:
     # Deterministic fault injection, reference: src/ray/rpc/rpc_chaos.h.
     # Format: "Method=max_failures:deadline_ms,Method2=..."
     testing_rpc_failure: str = ""
+    # Deterministic fault injection for the DAG CHANNEL layer (shm ring
+    # + TCP channels — the collective plane's transport), the data-
+    # plane sibling of testing_rpc_failure: elasticity and recovery
+    # paths are exercised by repeatable injected failures instead of
+    # hand-timed process kills. Comma-separated rules
+    # "<op>:<action>:<nth>[:<param>]": op in {write, read}; action in
+    # {delay (sleep <param> s before the op), drop (writes: silently
+    # discard the frame; reads: raise ChannelTimeout), kill (SIGKILL
+    # this process — a deterministic mid-collective worker death)};
+    # nth = 1-based index of the matching op counted process-wide.
+    # See dag/channel.py ChannelChaos.
+    testing_channel_failure: str = ""
 
     # --- tasks / actors ---
     default_max_task_retries: int = 3

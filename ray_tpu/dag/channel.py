@@ -18,6 +18,7 @@ the same path as values.
 
 from __future__ import annotations
 
+import threading
 import time
 import uuid
 from multiprocessing import shared_memory
@@ -35,6 +36,140 @@ class ChannelClosed(Exception):
 
 class ChannelTimeout(Exception):
     pass
+
+
+class ChannelAttachRefused(ChannelClosed):
+    """A producer's connect was refused for the whole per-call budget
+    BEFORE any connection existed. Still a ChannelClosed for ordinary
+    callers (dag teardown reads it as "consumer stage is gone"), but
+    sliced ring waits retry it up to the ring timeout: during elastic
+    re-formation a consumer mid-restart refuses connects for longer
+    than one 0.25 s abort slice, and giving up on the first slice
+    would frame a live peer as dead and collapse the reshard into a
+    full restart. Resets on an ESTABLISHED connection stay instantly
+    fatal — only the attach phase is ambiguous."""
+
+
+class ChaosInjectedTimeout(ChannelTimeout):
+    """A testing_channel_failure read-drop. Subclasses ChannelTimeout
+    so ordinary timeout handling applies, but sliced-wait retry loops
+    (RingReducer._op_sliced) re-raise it instead of retrying — an
+    injected fault fires exactly once, so retrying would silently
+    nullify it (the counter is already past nth)."""
+    chaos_injected = True
+
+
+# --- deterministic chaos plane ------------------------------------------
+#
+# The channel-layer sibling of the RPC plane's fault injection
+# (runtime/rpc.py ChaosPlan, reference: src/ray/rpc/rpc_chaos.h):
+# Config.testing_channel_failure arms repeatable faults on the DAG
+# transports so elastic-training recovery is exercised by injection,
+# not by hand-timed process kills. Rules fire on the Nth matching op
+# counted PROCESS-WIDE — in a ring collective each participant's op
+# sequence is deterministic, so "write:kill:17" dies at the same
+# pipeline position every run.
+
+class ChannelChaos:
+    """Parsed testing_channel_failure rules + per-op trigger counters.
+
+    Spec: comma-separated ``<op>:<action>:<nth>[:<param>]`` —
+      op      "write" | "read" (both channel flavors)
+      action  "delay" (sleep ``param`` seconds, then proceed)
+              "drop"  (write: silently discard the frame — the peer
+                       starves and times out, a lossy-link simulation;
+                       read: raise ChannelTimeout once)
+              "kill"  (SIGKILL this process: a deterministic
+                       mid-collective worker death)
+      nth     1-based index of the matching op in this process
+      param   seconds (delay only; default 0.1)
+    """
+
+    _ACTIONS = ("delay", "drop", "kill")
+
+    def __init__(self, spec: str):
+        self.rules = []
+        for part in filter(None, (spec or "").split(",")):
+            bits = part.strip().split(":")
+            if len(bits) < 3:
+                raise ValueError(
+                    f"testing_channel_failure rule {part!r}: expected "
+                    f"<op>:<action>:<nth>[:<param>]")
+            op, action, nth = bits[0], bits[1], int(bits[2])
+            if op not in ("write", "read"):
+                raise ValueError(
+                    f"testing_channel_failure op must be write|read, "
+                    f"got {op!r}")
+            if action not in self._ACTIONS:
+                raise ValueError(
+                    f"testing_channel_failure action must be one of "
+                    f"{self._ACTIONS}, got {action!r}")
+            if nth < 1:
+                raise ValueError(
+                    f"testing_channel_failure nth must be >= 1, "
+                    f"got {nth}")
+            param = float(bits[3]) if len(bits) > 3 else 0.1
+            self.rules.append(
+                {"op": op, "action": action, "nth": nth,
+                 "param": param, "count": 0})
+
+    def fire(self, op: str) -> Optional[str]:
+        """Advance counters for ``op``; returns the action to apply at
+        this call site ("drop") after executing side-effectful ones
+        (delay sleeps here, kill never returns)."""
+        out = None
+        for r in self.rules:
+            if r["op"] != op:
+                continue
+            r["count"] += 1
+            if r["count"] != r["nth"]:
+                continue
+            if r["action"] == "delay":
+                time.sleep(r["param"])
+            elif r["action"] == "kill":
+                import os
+                import signal
+                os.kill(os.getpid(), signal.SIGKILL)
+            else:
+                out = "drop"
+        return out
+
+
+_chaos: Optional[ChannelChaos] = None
+_chaos_loaded = False
+_chaos_tl = threading.local()
+
+
+def chaos_mark_retry(flag: bool) -> None:
+    """Nth-op counters are per LOGICAL op: a sliced wait that re-enters
+    the same channel op after a ChannelTimeout (RingReducer._op_sliced
+    retries every abort slice) marks itself here so retries don't
+    advance the counters — otherwise a stall anywhere in the ring would
+    turn the op index into a wall-clock count and "kill at op 17" would
+    fire at a different pipeline position per run."""
+    _chaos_tl.retry = flag
+
+
+def _chaos_op(op: str) -> Optional[str]:
+    """Per-op chaos hook for both channel flavors; near-zero cost when
+    testing_channel_failure is empty (one module-global check)."""
+    global _chaos, _chaos_loaded
+    if not _chaos_loaded:
+        from ray_tpu.config import get_config
+        spec = getattr(get_config(), "testing_channel_failure", "")
+        _chaos = ChannelChaos(spec) if spec else None
+        _chaos_loaded = True
+    if _chaos is None or getattr(_chaos_tl, "retry", False):
+        return None
+    return _chaos.fire(op)
+
+
+def reset_channel_chaos() -> None:
+    """Re-read testing_channel_failure on the next channel op (tests
+    flip the config mid-process; counters restart from zero)."""
+    global _chaos, _chaos_loaded
+    _chaos = None
+    _chaos_loaded = False
 
 
 def _as_u8(payload) -> memoryview:
@@ -115,6 +250,9 @@ class ShmRingChannel:
         e.g. ring-allreduce chunk slices — are written without an
         intermediate bytes() copy), or an object with (frame_nbytes,
         write_into) — ray_tpu Serialized — written zero-copy."""
+        if _chaos is not None or not _chaos_loaded:
+            if _chaos_op("write") == "drop":
+                return              # injected lossy link: frame vanishes
         mv = None
         if hasattr(payload, "write_into"):
             n = payload.frame_nbytes
@@ -182,6 +320,9 @@ class ShmRingChannel:
         """Run fn(kind, memoryview-of-frame) on the next frame WITHOUT
         copying; the slot is released only after fn returns, so the view
         (and anything deserialized zero-copy from it) must not escape."""
+        if _chaos is not None or not _chaos_loaded:
+            if _chaos_op("read") == "drop":
+                raise ChaosInjectedTimeout("chaos: injected read drop")
         if self._lib is not None and self._cbase is not None:
             off = self._lib.rb_wait_readable(  # GIL-free wait
                 self._cbase, self.nslots, self.slot_bytes,
@@ -358,30 +499,53 @@ class TcpChannel:
             return
         else:
             # never poll forever: a consumer that died before attaching
-            # would otherwise hang the producer with no diagnosis
+            # would otherwise hang the producer with no diagnosis.
+            # Endpoint polling AND refused connects retry with jittered
+            # exponential backoff bounded by the caller's deadline: a
+            # peer mid-restart during elastic re-formation (endpoint
+            # not yet republished, or listener not yet accepting) must
+            # neither burn a CPU in a tight loop nor flake the attach —
+            # the KV is re-read each attempt, so a consumer that
+            # rebinds a fresh port under the same channel id is picked
+            # up as soon as it publishes.
             if deadline is None:
                 deadline = time.monotonic() + self.CONNECT_TIMEOUT_S
+            import random
+            attempt = 0
+            last_err: Optional[str] = None
             while True:
                 blob = _kv("kv_get", key=self.KV_PREFIX + self.id)
                 if blob:
-                    break
-                if time.monotonic() > deadline:
+                    host, port = blob.decode().rsplit(":", 1)
+                    try:
+                        self._sock = socket.create_connection(
+                            (host, int(port)),
+                            timeout=max(1.0,
+                                        deadline - time.monotonic()))
+                        self._sock.sendall(self.id.encode())
+                        break
+                    except socket.timeout:
+                        self._sock = None
+                        raise ChannelTimeout(
+                            "connect to consumer timed out")
+                    except OSError as e:
+                        # refused/reset: the consumer may be restarting
+                        # — back off and retry until the deadline
+                        self._sock = None
+                        last_err = str(e)
+                if time.monotonic() >= deadline:
+                    if last_err is not None:
+                        raise ChannelAttachRefused(
+                            f"connect failed: {last_err}")
                     raise ChannelTimeout(
                         f"consumer endpoint for channel {self.id} not "
                         f"published (peer dead before attach?)")
-                time.sleep(0.02)
-            host, port = blob.decode().rsplit(":", 1)
-            try:
-                self._sock = socket.create_connection(
-                    (host, int(port)),
-                    timeout=max(1.0, deadline - time.monotonic()))
-                self._sock.sendall(self.id.encode())
-            except socket.timeout:
-                self._sock = None
-                raise ChannelTimeout("connect to consumer timed out")
-            except OSError as e:
-                self._sock = None
-                raise ChannelClosed(f"connect failed: {e}")
+                delay = min(1.0, 0.02 * (2 ** min(attempt, 10))) \
+                    * (0.5 + random.random())
+                attempt += 1
+                time.sleep(min(delay,
+                               max(0.0,
+                                   deadline - time.monotonic())))
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def _check_ident(self, timeout: Optional[float]):
@@ -501,6 +665,9 @@ class TcpChannel:
         credit allows and flushed opportunistically — the driver can
         always return to draining the sink, which is what ultimately
         frees the pipeline."""
+        if _chaos is not None or not _chaos_loaded:
+            if _chaos_op("write") == "drop":
+                return              # injected lossy link: frame vanishes
         if hasattr(payload, "write_into"):
             n = payload.frame_nbytes
             data = bytearray(n)
@@ -557,6 +724,9 @@ class TcpChannel:
         keeps all progress (buffered bytes + parsed header) for the
         next call — driver-side 0-timeout polls interleave safely with
         blocking gets on the same channel."""
+        if _chaos is not None or not _chaos_loaded:
+            if _chaos_op("read") == "drop":
+                raise ChaosInjectedTimeout("chaos: injected read drop")
         self._ensure_conn(timeout)
         deadline = None if timeout is None \
             else time.monotonic() + timeout
@@ -614,9 +784,16 @@ def new_tcp_spec(nslots: int, slot_bytes: int) -> dict:
             "nslots": nslots, "slot_bytes": slot_bytes}
 
 
-def attach_channel(spec: dict, role: str, timeout: float = 60.0):
+def attach_channel(spec: dict, role: str, timeout: float = 60.0,
+                   abort=None):
     """Attach either channel flavor: shm specs are role-agnostic, tcp
     specs bind/connect per role ('producer' | 'consumer').
+
+    ``abort``: optional zero-arg predicate polled by the lazy-shm
+    producer wait (the only attach path that blocks); returning True
+    raises ChannelTimeout immediately — elastic training points this
+    at its regroup event so a group rewire can interrupt an attach
+    against a dead incarnation's specs instead of waiting it out.
 
     ``lazy`` shm specs cover co-located NON-driver stages: the driver
     can't create a segment on a remote host, so the consumer creates it
@@ -646,7 +823,15 @@ def attach_channel(spec: dict, role: str, timeout: float = 60.0):
         while True:
             try:
                 return ShmRingChannel.attach(spec)
-            except FileNotFoundError:
+            except (FileNotFoundError, ValueError):
+                # ValueError ("cannot mmap an empty file"): the
+                # consumer is mid-create — shm_open done, ftruncate
+                # not yet — so the name exists at 0 bytes for a
+                # moment; the same transient as not-yet-created
+                if abort is not None and abort():
+                    raise ChannelTimeout(
+                        f"attach of lazy shm channel {spec['name']} "
+                        f"aborted (group reshaped)")
                 if time.monotonic() > deadline:
                     raise ChannelTimeout(
                         f"lazy shm channel {spec['name']} never "

@@ -75,8 +75,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ray_tpu.dag.channel import (DATA, ERROR, ChannelClosed, ChannelTimeout,
-                                 attach_channel)
+from ray_tpu.dag.channel import (DATA, ERROR, ChannelAttachRefused,
+                                 ChannelClosed, ChannelTimeout,
+                                 attach_channel, chaos_mark_retry)
 from ray_tpu.runtime.serialization import dumps_oob, loads_oob
 from ray_tpu.util import events
 
@@ -99,6 +100,18 @@ class RingPeerDead(Exception):
 class RingProtocolError(Exception):
     """A frame kind the protocol cannot produce arrived mid-phase:
     the channels are desynced beyond repair for this group."""
+
+
+class _AbortedOp(Exception):
+    """Internal: ``RingReducer.abort()`` interrupted a blocked channel
+    op — surfaced to callers as RingPeerDead with a reshape message."""
+
+
+# How finely blocked ring waits are sliced so abort() can interrupt
+# them: the worst-case extra latency an aborted participant pays, and
+# the wakeup period while blocked (waits with data ready return
+# immediately; the slicing only costs when genuinely stalled).
+_ABORT_SLICE_S = 0.25
 
 
 def allreduce_metrics() -> dict:
@@ -751,9 +764,11 @@ class RingReducer:
         self._tr_err: Optional[BaseException] = None
         self._ph = "hdr"                  # current phase for chunk spans
         self._seg_tx = self._seg_rx = -1  # current segments in flight
+        self._abort = False               # set by abort() (any thread)
 
     @classmethod
-    def from_spec(cls, spec: Dict[str, Any]) -> "RingReducer":
+    def from_spec(cls, spec: Dict[str, Any],
+                  abort=None) -> "RingReducer":
         """Attach both ring edges from a controller-built spec:
         {"rank", "size", "to_next", "from_prev", "op"?, "timeout_s"?,
         "quantize"?, "chunk_bytes"?} — channel specs are the same dicts
@@ -766,14 +781,17 @@ class RingReducer:
         Attach waits honor the spec's timeout_s (participants may reach
         their first round arbitrarily skewed — compile, data load), and
         an attach that still times out surfaces as RingPeerDead like
-        any other unresponsive-neighbor condition."""
+        any other unresponsive-neighbor condition. ``abort`` (polled
+        by the blocking lazy-shm producer wait) interrupts an attach
+        early — the elastic rewire path, where the specs belong to an
+        incarnation the controller has already declared dead."""
         timeout_s = float(spec.get("timeout_s", 600.0))
         from_prev = None
         try:
             from_prev = attach_channel(spec["from_prev"], "consumer",
-                                       timeout=timeout_s)
+                                       timeout=timeout_s, abort=abort)
             to_next = attach_channel(spec["to_next"], "producer",
-                                     timeout=timeout_s)
+                                     timeout=timeout_s, abort=abort)
         except (ChannelTimeout, ChannelClosed) as e:
             if from_prev is not None:
                 # we created the inbound (consumer-owned) segment;
@@ -813,21 +831,77 @@ class RingReducer:
 
     # --- wire helpers ---------------------------------------------------
 
+    def abort(self) -> None:
+        """Interrupt any blocked ring op from ANOTHER thread (the
+        elastic-training rewire path: the controller has already
+        decided this incarnation is dead, so a survivor blocked on a
+        dead neighbor must not wait out the full ring timeout before
+        it can re-form). The next sliced wait raises RingPeerDead with
+        a reshape message; the flag is sticky for this ring — a
+        reshaped group attaches a FRESH ring."""
+        self._abort = True
+
+    def _op_sliced(self, op):
+        """Run one channel op under the ring timeout, sliced into
+        short waits (_ABORT_SLICE_S) so abort() can interrupt a
+        blocked participant. ``op(t)`` must be safely retryable after
+        a ChannelTimeout with no partial effect — both channel flavors
+        guarantee that (shm waits are stateless; TcpChannel reads
+        resume mid-frame and its writes only time out before any frame
+        byte is committed). ChannelAttachRefused is retried too: a
+        refused connect within one slice means the peer may still be
+        mid-restart, and only the ring timeout decides it is dead."""
+        if self._abort:
+            raise _AbortedOp()
+        deadline = time.monotonic() + self.timeout_s
+        retrying = False
+        try:
+            while True:
+                left = deadline - time.monotonic()
+                try:
+                    return op(max(1e-3, min(_ABORT_SLICE_S, left)))
+                except (ChannelTimeout, ChannelAttachRefused) as e:
+                    if self._abort:
+                        raise _AbortedOp()
+                    # an injected chaos read-drop fires exactly once —
+                    # a retry would re-read the still-present frame and
+                    # silently nullify the fault, so surface it as-is
+                    if getattr(e, "chaos_injected", False) \
+                            or time.monotonic() >= deadline:
+                        raise
+                    # retries re-enter the same LOGICAL channel op:
+                    # keep the chaos Nth-op counters from advancing
+                    retrying = True
+                    chaos_mark_retry(True)
+        finally:
+            if retrying:
+                chaos_mark_retry(False)
+
+    def _op_fail(self, which: str, e: BaseException) -> RingPeerDead:
+        if isinstance(e, _AbortedOp):
+            return RingPeerDead(RuntimeError(
+                f"ring collective aborted on rank {self.rank}: the "
+                f"worker group is being reshaped (elastic recovery)"))
+        peer = (self.rank + 1) % self.size if which == "next" \
+            else (self.rank - 1) % self.size
+        return RingPeerDead(RuntimeError(
+            f"ring allreduce peer (rank {peer})"
+            f" unresponsive for {self.timeout_s}s "
+            f"(participant died?): {e}"))
+
     def _write(self, payload):
         mv = payload if isinstance(payload, memoryview) \
             else memoryview(payload)
         tr = self._tr
         t0 = time.monotonic() if tr is not None else 0.0
         try:
-            self.to_next.write(mv, DATA, timeout=self.timeout_s)
-        except (ChannelTimeout, ChannelClosed) as e:
+            self._op_sliced(
+                lambda t: self.to_next.write(mv, DATA, timeout=t))
+        except (ChannelTimeout, ChannelClosed, _AbortedOp) as e:
             if tr is not None:   # the stalled write IS the evidence
                 tr.io("send", time.monotonic() - t0, mv.nbytes,
                       self._ph, self._seg_tx)
-            raise RingPeerDead(RuntimeError(
-                f"ring allreduce peer (rank {(self.rank + 1) % self.size})"
-                f" unresponsive for {self.timeout_s}s "
-                f"(participant died?): {e}"))
+            raise self._op_fail("next", e)
         if tr is not None:
             tr.io("send", time.monotonic() - t0, mv.nbytes,
                   self._ph, self._seg_tx)
@@ -837,13 +911,10 @@ class RingReducer:
         tr = self._tr
         if tr is None:
             try:
-                return self.from_prev.read_with(fn, self.timeout_s)
-            except (ChannelTimeout, ChannelClosed) as e:
-                raise RingPeerDead(RuntimeError(
-                    f"ring allreduce peer "
-                    f"(rank {(self.rank - 1) % self.size})"
-                    f" unresponsive for {self.timeout_s}s "
-                    f"(participant died?): {e}"))
+                return self._op_sliced(
+                    lambda t: self.from_prev.read_with(fn, t))
+            except (ChannelTimeout, ChannelClosed, _AbortedOp) as e:
+                raise self._op_fail("prev", e)
         # split the window into WAIT (blocked on the predecessor — the
         # straggler-attribution signal) and APPLY (fn: decode + reduce)
         t0 = time.monotonic()
@@ -857,16 +928,14 @@ class RingReducer:
             return out
 
         try:
-            out = self.from_prev.read_with(timed, self.timeout_s)
-        except (ChannelTimeout, ChannelClosed) as e:
+            out = self._op_sliced(
+                lambda t: self.from_prev.read_with(timed, t))
+        except (ChannelTimeout, ChannelClosed, _AbortedOp) as e:
             # record the fatal wait: in the flight dump THIS is the
             # row that shows where the round hung
             tr.io("recv", time.monotonic() - t0, 0,
                   self._ph, self._seg_rx)
-            raise RingPeerDead(RuntimeError(
-                f"ring allreduce peer (rank {(self.rank - 1) % self.size})"
-                f" unresponsive for {self.timeout_s}s "
-                f"(participant died?): {e}"))
+            raise self._op_fail("prev", e)
         tr.io("recv", box[0] - t0, box[2], self._ph, self._seg_rx,
               apply_s=box[1] - box[0])
         return out

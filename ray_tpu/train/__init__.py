@@ -10,13 +10,14 @@ train_fn as mesh axes (ray_tpu.parallel), not as framework protocols.
 
 from ray_tpu.train.api import (Checkpoint, CheckpointConfig, FailureConfig,
                                Result, RunConfig, ScalingConfig,
-                               ensure_jax_distributed, get_context,
-                               get_dataset_shard, report)
+                               await_regroup, ensure_jax_distributed,
+                               get_context, get_dataset_shard, report)
 from ray_tpu.train.boosting import (BoostingConfig, BoostingModel,
                                     BoostingTrainer)
-from ray_tpu.train.collective import (allgather_params,
+from ray_tpu.train.collective import (PeerLostError, allgather_params,
                                       allreduce_gradients,
                                       reduce_scatter_gradients)
+from ray_tpu.train.reshard import ReshardError
 from ray_tpu.train.trainer import (JaxTrainer, SklearnTrainer,
                                    TorchTrainer,
                                    get_controller)
@@ -24,9 +25,11 @@ from ray_tpu.train.zero import ShardedOptimizer
 
 __all__ = [
     "BoostingConfig", "BoostingModel", "BoostingTrainer",
-    "Checkpoint", "CheckpointConfig", "FailureConfig", "Result",
+    "Checkpoint", "CheckpointConfig", "FailureConfig", "PeerLostError",
+    "Result", "ReshardError",
     "RunConfig", "ScalingConfig", "ShardedOptimizer", "SklearnTrainer",
-    "allgather_params", "allreduce_gradients", "ensure_jax_distributed",
+    "allgather_params", "allreduce_gradients", "await_regroup",
+    "ensure_jax_distributed",
     "get_context", "get_dataset_shard", "reduce_scatter_gradients",
     "report", "JaxTrainer", "TorchTrainer", "get_controller",
 ]

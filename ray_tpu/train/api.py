@@ -10,6 +10,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import (Any, Callable, ClassVar, Dict, List, Optional, Tuple,
                     Union)
@@ -30,6 +31,19 @@ class ScalingConfig:
     # v2/_internal/execution/scaling_policy/elastic.py:29 resize
     # decisions in both directions). 0 disables grow checks.
     elastic_grow_interval_s: float = 5.0
+    # Elastic SHRINK without restart: on worker loss the controller
+    # re-forms the surviving ranks into an N-1 ring (fresh incarnation
+    # id) and the train_fn reshards ZeRO optimizer state over it
+    # (train/reshard.py) instead of the group restarting from the last
+    # disk checkpoint. Requires an elastic num_workers range, survivors
+    # >= min_workers, and no jax.distributed world (a jax process group
+    # cannot shrink in place — those groups keep the restart path).
+    elastic_reshard: bool = True
+    # Ring timeout for the controller-wired gradient-sync ring. Also
+    # bounds how long a survivor can stay blocked on a dead neighbor
+    # before surfacing PeerLostError when the controller has NOT yet
+    # aborted the ring (the rewire abort usually cuts this to ~0.25 s).
+    sync_timeout_s: float = 300.0
     # Whether the controller runs jax.distributed.initialize on every
     # worker before train_fn starts (reference: _JaxBackend.on_start at
     # v2/jax/config.py:96-124 does this unconditionally). "auto" = only
@@ -80,8 +94,15 @@ class ScalingConfig:
 @dataclass
 class FailureConfig:
     """Retry budget for worker-group failures (reference:
-    v2/_internal/execution/failure_handling/default.py:24)."""
+    v2/_internal/execution/failure_handling/default.py:24).
+
+    ``reset_after_clean_reports``: after this many consecutive clean
+    reports (no failure in between), the consumed failure count resets
+    to zero — a week-long job with rare preemptions spends its budget
+    per incident burst, not cumulatively over its whole life. 0 keeps
+    the budget strictly cumulative."""
     max_failures: int = 0
+    reset_after_clean_reports: int = 0
 
 
 @dataclass
@@ -170,7 +191,8 @@ class TrainContext:
                  dataset_shards: Optional[Dict[str, Any]] = None,
                  storage_path: Optional[str] = None,
                  group_id: str = "",
-                 grad_sync: Optional[dict] = None):
+                 grad_sync: Optional[dict] = None,
+                 mirror_peer: Any = None):
         self.rank = rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -197,6 +219,118 @@ class TrainContext:
         # ring's spans so timeline lanes and straggler rows say WHICH
         # step a slow round belongs to.
         self.collective_step = 0
+        # --- elastic reshape state (controller-driven; see
+        # await_regroup) ---
+        # generation bumps once per in-place rewire, so stale cached
+        # group objects (optimizer rings) can detect they predate the
+        # current incarnation.
+        self.generation = 0
+        self._regroup_evt = threading.Event()
+        self._rewire_payload: Optional[dict] = None
+        # Ring-successor worker actor handle: the in-memory
+        # peer-checkpoint target this rank mirrors its ZeRO shard to
+        # (train/zero.py mirror_interval_steps). None for world 1.
+        self._mirror_peer = mirror_peer
+        # Mirror blobs of LOST ranks this worker must contribute to the
+        # next reshard collective (assigned by the controller's rewire).
+        self._recovered_mirrors: list = []
+        self._lost_info: dict = {}
+
+    # -- elastic reshape ---------------------------------------------------
+
+    def apply_rewire(self, payload: dict) -> None:
+        """Called on the WORKER ACTOR thread when the controller
+        re-forms the group around a lost worker: stash the new identity
+        and wake await_regroup(). The in-flight collective (if any) is
+        aborted so a survivor blocked on the dead neighbor surfaces
+        PeerLostError in ~0.25 s instead of the full ring timeout."""
+        self._rewire_payload = payload
+        ring = self._grad_ring
+        if ring is not None:
+            try:
+                ring.abort()
+            except Exception:   # noqa: BLE001 — wake-up is best-effort
+                pass
+        self._regroup_evt.set()
+
+    def await_regroup(self, timeout_s: Optional[float] = None) -> dict:
+        """Block until the controller has re-formed the group, then
+        swap in the new incarnation: rank, world size, generation id,
+        gradient-sync ring spec, and mirror assignments. The elastic
+        recovery entrypoint for train_fns::
+
+            try:
+                params, state = opt.update(grads, state, params)
+            except train.PeerLostError:
+                info = train.await_regroup(timeout_s=60)
+                state = opt.reshard(state)
+                continue            # retry the interrupted step
+
+        Raises TimeoutError when no rewire arrives in ``timeout_s``
+        (the controller chose a full restart instead — let the error
+        propagate so the restart path takes over)."""
+        # clear BEFORE consuming the payload: a second rewire landing
+        # between read and clear would have its wakeup erased (payload
+        # stashed, event cleared) and the next await_regroup would
+        # block its full timeout despite a pending rewire. The inverse
+        # race — event still set with the payload already consumed —
+        # is a spurious wakeup; loop back to the wait.
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
+        while True:
+            left = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            if not self._regroup_evt.wait(left):
+                raise TimeoutError(
+                    "no group rewire arrived within "
+                    f"{timeout_s}s (controller restarting instead?)")
+            self._regroup_evt.clear()
+            payload, self._rewire_payload = self._rewire_payload, None
+            if payload is not None:
+                break
+        # the old ring's channels belong to the dead incarnation
+        self.close_gradient_sync()
+        self.rank = int(payload["rank"])
+        self.world_size = int(payload["world_size"])
+        self.group_id = payload["group_id"]
+        self._grad_sync = payload.get("grad_sync")
+        self._mirror_peer = payload.get("mirror_peer")
+        self._recovered_mirrors = list(payload.get("recovered") or [])
+        self._lost_info = dict(payload.get("lost") or {})
+        self.generation += 1
+        return {"rank": self.rank, "world_size": self.world_size,
+                "generation": self.generation,
+                "group_id": self.group_id,
+                "lost": dict(self._lost_info)}
+
+    def mirror_shard(self, blob: dict) -> bool:
+        """Ship one in-memory peer-checkpoint blob to this rank's ring
+        successor, fire-and-forget (an actor call posted off the step
+        path; mirroring is best-effort — a miss only means a fallback
+        to checkpoint restore if THIS rank's segment is lost later)."""
+        peer = self._mirror_peer
+        if peer is None:
+            return False
+        try:
+            peer.store_mirror.remote(
+                self.group_id, self.rank, int(blob.get("step", 0)), blob)
+            return True
+        except Exception:   # noqa: BLE001 — best-effort by contract
+            return False
+
+    def take_recovered_mirrors(self) -> list:
+        """Mirror blobs of lost ranks assigned to this worker for the
+        next reshard collective (consumed once)."""
+        out, self._recovered_mirrors = self._recovered_mirrors, []
+        return out
+
+    def lost_info(self) -> dict:
+        """The last rewire's lost-rank records ({old_rank: {old_rank,
+        old_size, holder}}): ``holder`` None means no surviving
+        in-memory mirror of that rank's shard exists anywhere — a
+        sharded optimizer must refuse to reshard (the segment would
+        materialize as zeros) and let the restart path recover."""
+        return dict(self._lost_info)
 
     # -- user API --
     def get_world_size(self) -> int:
@@ -226,7 +360,13 @@ class TrainContext:
                     "worker group (controller predates it, or "
                     "world_size == 1)")
             from ray_tpu.dag.ring import RingReducer
-            self._grad_ring = RingReducer.from_spec(self._grad_sync)
+            # a rewire landing while this thread is still INSIDE the
+            # attach has no ring to abort() — the regroup event is the
+            # only signal that can reach it, so the blocking attach
+            # wait polls it and bails instead of waiting out the sync
+            # timeout against a dead incarnation's specs
+            self._grad_ring = RingReducer.from_spec(
+                self._grad_sync, abort=self._regroup_evt.is_set)
         return self._grad_ring
 
     def close_gradient_sync(self) -> None:
@@ -347,6 +487,13 @@ def report(metrics: Dict[str, Any],
 
 def get_dataset_shard(name: str = "train"):
     return get_context().get_dataset_shard(name)
+
+
+def await_regroup(timeout_s: Optional[float] = None) -> dict:
+    """Block until the controller re-forms the worker group after a
+    peer loss (elastic reshape), then adopt the new rank/world size —
+    see TrainContext.await_regroup for the recovery loop idiom."""
+    return get_context().await_regroup(timeout_s)
 
 
 def jax_distributed_initialized() -> bool:

@@ -22,6 +22,32 @@ import ray_tpu
 from ray_tpu.train.api import get_context
 
 
+class PeerLostError(RuntimeError):
+    """A gradient-sync ring peer stopped responding (worker death,
+    injected channel death, or a controller-driven abort while the
+    group reshapes). RuntimeError subclass for back-compat; elastic
+    train_fns catch THIS and call ``train.await_regroup()`` +
+    ``ShardedOptimizer.reshard()`` to continue at the new world size
+    instead of dying into a checkpoint-restore restart. Carries
+    ``flight_recorder_path`` / ``flight_recorder_summary`` when the
+    collective plane dumped one."""
+
+
+def peer_lost_error(e) -> PeerLostError:
+    """The one conversion from a ring-plane ``RingPeerDead`` to the
+    typed error train_fns catch, flight-recorder attributes carried
+    over (shared by ``_ring_call`` and ``ShardedOptimizer`` so the two
+    paths can never drift apart in message or attribute shape)."""
+    err = PeerLostError(
+        f"gradient sync peer lost (worker died mid-ring?): "
+        f"{e.cause}")
+    err.flight_recorder_path = getattr(
+        e, "flight_recorder_path", None)
+    err.flight_recorder_summary = getattr(
+        e, "flight_recorder_summary", None)
+    return err
+
+
 class _Rendezvous:
     """Named actor holding per-epoch barrier/broadcast state."""
 
@@ -113,14 +139,7 @@ def _ring_call(ctx, timeout_s: Optional[float], fn,
             ctx.collective_step = getattr(ctx, "collective_step", 0) + 1
         return out
     except RingPeerDead as e:
-        err = RuntimeError(
-            f"gradient sync peer lost (worker died mid-ring?): "
-            f"{e.cause}")
-        err.flight_recorder_path = getattr(
-            e, "flight_recorder_path", None)
-        err.flight_recorder_summary = getattr(
-            e, "flight_recorder_summary", None)
-        raise err from e
+        raise peer_lost_error(e) from e
 
 
 def allreduce_gradients(value: Any, op: str = "mean", *,

@@ -11,6 +11,7 @@ checkpoint, resize if elastic) → finish.
 
 from __future__ import annotations
 
+import re
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -22,7 +23,51 @@ from ray_tpu.train.api import (Checkpoint, FailureConfig, Result, RunConfig,
                                ScalingConfig)
 from ray_tpu.train.checkpoint import CheckpointManager
 from ray_tpu.train.worker import TrainWorker
-from ray_tpu.util import tpu as tpu_util
+from ray_tpu.util import events, tpu as tpu_util
+
+
+def train_metrics() -> dict:
+    """Get-or-create the controller's elasticity series (process-global
+    registry, head-aggregated like every other pushed metric).
+
+      train_restarts_total  group recoveries, tagged kind=reshard
+                            (in-place N-1 re-form) | restart (teardown
+                            + restore from the latest checkpoint)
+      train_lost_steps      reports lost by the LAST recovery: 0 for a
+                            reshard (survivors keep live state),
+                            reports-since-last-checkpoint for a restore
+    """
+    from ray_tpu.util import metrics as m
+    return {
+        "restarts": m.Counter(
+            "train_restarts_total",
+            "Worker-group recoveries performed by the train "
+            "controller, tagged kind=reshard (elastic in-place "
+            "re-form at N-1) or kind=restart (full teardown + "
+            "checkpoint restore)",
+            tag_keys=("kind",)),
+        "lost_steps": m.Gauge(
+            "train_lost_steps",
+            "Progress reports lost by the last recovery: 0 when the "
+            "group resharded in place (survivors keep live state), "
+            "else the reports since the last registered checkpoint "
+            "that the restore will replay"),
+    }
+
+
+_FLIGHT_RE = re.compile(r"\[collective flight recorder: ([^\]\s]+)\]")
+
+
+def _flight_path(err: BaseException) -> Optional[str]:
+    """The collective flight-recorder dump path riding a failure, when
+    one was written: the attribute for in-process errors, else fished
+    out of the relayed traceback text (worker errors reach the
+    controller as strings)."""
+    p = getattr(err, "flight_recorder_path", None)
+    if p:
+        return str(p)
+    m = _FLIGHT_RE.search(str(err))
+    return m.group(1) if m else None
 
 
 class TrainGroupError(RuntimeError):
@@ -56,6 +101,20 @@ class TrainController:
         self._workers: List = []
         self._pg = None
         self._stop_requested = False
+        self._m = train_metrics()
+        self._group_id = ""
+        self._failures = 0            # consumed failure budget
+        self._clean_reports = 0       # reports since the last failure
+        # True between a reshape and the first report of the reshaped
+        # incarnation: a failure in that window is the SAME incident
+        # (the reshard didn't take — e.g. no mirrors to rebuild from,
+        # or a train_fn with no await_regroup loop), so the follow-up
+        # restart must not consume a second failure-budget unit
+        self._reshape_unvalidated = False
+        self._reports_since_ckpt = 0  # the restore path's replay cost
+        # last seen peer-checkpoint inventory per CURRENT rank index
+        # ({mirrored_rank: step}) — the reshape decision reads it
+        self._last_mirrors: Dict[int, Dict[int, int]] = {}
 
     # --- scaling policy (reference: scaling_policy/fixed.py, elastic.py) ---
 
@@ -244,7 +303,8 @@ class TrainController:
                               "slot_bytes": slot_bytes, "lazy": True})
             else:
                 edges.append(new_tcp_spec(nslots, slot_bytes))
-        return [{"rank": r, "size": n, "op": "mean", "timeout_s": 300.0,
+        return [{"rank": r, "size": n, "op": "mean",
+                 "timeout_s": float(self.scaling.sync_timeout_s),
                  "own": r,
                  # collective spans/flight dumps tag this group id, so
                  # timeline lanes and post-mortems name the incarnation
@@ -262,13 +322,21 @@ class TrainController:
         # incarnation so a restarted ring never attaches a stale segment.
         import uuid
         group_id = uuid.uuid4().hex
+        self._group_id = group_id
+        self._last_mirrors = {}
         sync = self._grad_sync_specs(group_id)
+        n = len(self._workers)
         refs = []
         for i, w in enumerate(self._workers):
+            # ring successor = the in-memory peer-checkpoint target
+            # (train/zero.py mirror_interval_steps): a lost rank's
+            # shard survives on the next rank over
+            peer = self._workers[(i + 1) % n] if n > 1 else None
             refs.append(w.start_train_fn.remote(
                 self.train_fn_payload, self.train_loop_config,
                 self.ckpt_manager.latest, shards[i],
-                self.run_config.storage_path, group_id, sync[i]))
+                self.run_config.storage_path, group_id, sync[i],
+                peer))
         ray_tpu.get(refs, timeout=120)
 
     def _split_datasets(self, n: int) -> List[Optional[dict]]:
@@ -324,7 +392,7 @@ class TrainController:
         }
 
     def run(self) -> Result:
-        failures = 0
+        self._failures = 0
         max_failures = self.run_config.failure_config.max_failures
         resize_to: Optional[int] = None
         while True:
@@ -363,20 +431,57 @@ class TrainController:
                 # RayTpuError covers actor death, worker crash, task errors
                 # AND placement failures (create_pg raising) — all of them
                 # consult the failure policy rather than escaping fit().
-                failures += 1
+                if self._reshape_unvalidated:
+                    # the failed reshape already consumed this
+                    # incident's unit — escalating to a restart is the
+                    # same incident, not a new failure
+                    self._reshape_unvalidated = False
+                else:
+                    self._failures += 1
+                self._clean_reports = 0
                 self._teardown_group()
-                if failures > max_failures:
+                if self._failures > max_failures:
+                    # budget exhausted: no recovery is performed, so
+                    # train_restarts_total must not count one
                     return Result(
                         metrics=(self.metrics_history[-1]
                                  if self.metrics_history else {}),
                         checkpoint=self.ckpt_manager.best(),
                         metrics_history=list(self.metrics_history),
                         error=e)
+                self._record_recovery("restart", e,
+                                      lost=self._reports_since_ckpt)
+                # the restore replays from the latest checkpoint, so
+                # the replay debt is spent — start counting afresh
+                self._reports_since_ckpt = 0
                 # restart (possibly resized) from the latest checkpoint
                 continue
             finally:
                 if self._workers:
                     self._teardown_group()
+
+    def _record_recovery(self, kind: str, cause: BaseException,
+                         lost: int, dur: float = 0.0,
+                         **fields) -> None:
+        """Metrics + a budget-capped "train" event span + a log line
+        for one group recovery; the collective flight-recorder dump
+        path (when the failure wrote one) is stitched onto all three,
+        so a restart log names the post-mortem file directly."""
+        try:
+            self._m["restarts"].inc(tags={"kind": kind})
+            self._m["lost_steps"].set(lost)
+        except Exception:
+            pass
+        flight = _flight_path(cause)
+        events.record(
+            "train", kind, ph="X", ts=time.time() - dur, dur=dur,
+            group=self._group_id[:12], failures=self._failures,
+            lost_reports=lost, flight=flight,
+            error=str(cause)[:400], **fields)
+        print(f"[train] group recovery kind={kind} "
+              f"failures={self._failures} lost_reports={lost}"
+              + (f" flight_recorder={flight}" if flight else "")
+              + f": {str(cause)[:200]}")
 
     def _poll_until_done(self, poll_s: float = 0.2):
         pending = set(range(len(self._workers)))
@@ -384,20 +489,45 @@ class TrainController:
         next_grow_check = time.monotonic() + grow_iv
         grow_seen: Optional[int] = None
         while pending:
-            polls = ray_tpu.get(
-                [self._workers[i].poll.remote() for i in sorted(pending)],
-                timeout=60)
+            order = sorted(pending)
+            refs = [self._workers[i].poll.remote() for i in order]
+            dead: List[tuple] = []
+            polls: Dict[int, dict] = {}
+            try:
+                results = ray_tpu.get(refs, timeout=60)
+                polls = dict(zip(order, results))
+            except api.RayTpuError:
+                # somebody in the batch died — isolate per worker so
+                # the survivors' reports/mirror inventories still land
+                # and the reshape path knows exactly who is gone
+                for i, ref in zip(order, refs):
+                    try:
+                        polls[i] = ray_tpu.get(ref, timeout=60)
+                    except api.RayTpuError as e:
+                        dead.append((i, e))
             if self._stop_requested:
                 raise TrainGroupError("stop requested")
-            for p in polls:
+            for i, p in sorted(polls.items()):
                 for rep in p["reports"]:
                     self._handle_report(p["rank"], rep)
+                self._last_mirrors[i] = dict(p.get("mirrors") or {})
                 if p["error"]:
                     raise api.TaskError(
                         f"train_fn failed on rank {p['rank']}:\n"
                         f"{p['error']}")
                 if p["done"]:
-                    pending.discard(p["rank"])
+                    pending.discard(i)
+            if dead:
+                # worker loss: reshape the surviving ranks in place
+                # when the elastic policy allows it, else fall through
+                # to the restart-from-checkpoint path in run()
+                plan = self._plan_reshape(dead, pending)
+                if plan is not None:
+                    pending = self._reshape(plan, dead[0][1])
+                    grow_seen = None
+                    next_grow_check = time.monotonic() + grow_iv
+                    continue
+                raise dead[0][1]
             # elastic GROW: capacity that appeared mid-run (autoscaler
             # added a node, another job released one) widens the group.
             # Requires seeing the grow target on two consecutive checks
@@ -412,12 +542,135 @@ class TrainController:
             if pending:
                 time.sleep(poll_s)
 
+    # --- elastic reshape (worker loss without restart) -------------------
+
+    def _plan_reshape(self, dead: List[tuple],
+                      pending: set) -> Optional[dict]:
+        """The in-place N-1 re-form decision AND its inputs, computed
+        once: legal when the group is elastic, enough ranks survive,
+        no jax.distributed world binds the group shape (a jax process
+        group cannot shrink in place), and — when peer mirroring is
+        active — every lost rank's shard has a surviving in-memory
+        copy (otherwise a reshard would silently zero state; the
+        checkpoint restore is strictly better). Returns None to take
+        the restart path, else the plan _reshape() executes verbatim —
+        the gate validates the exact assignment the executor ships, so
+        the two can't drift."""
+        if not (self.scaling.elastic
+                and getattr(self.scaling, "elastic_reshard", True)):
+            return None
+        if self.scaling.wants_jax_distributed():
+            return None
+        if self.datasets:
+            # dataset shards were streaming_split over the OLD world:
+            # an in-place re-form would silently drop the dead rank's
+            # shard for the rest of the run — the restart path
+            # re-splits over the new size, so it is the correct one
+            return None
+        dead_ranks = sorted({i for i, _ in dead})
+        survivors = [i for i in range(len(self._workers))
+                     if i not in dead_ranks]
+        if len(survivors) < max(1, self.scaling.min_workers):
+            return None
+        # EVERY survivor must still be mid-train_fn: a rank whose
+        # train_fn already returned would be wired into the new ring
+        # but never call await_regroup/attach, hanging the others'
+        # reshard collective for the full sync timeout
+        if not set(survivors) <= pending:
+            return None
+        from ray_tpu.train import reshard as _rs
+        inventory = {i: self._last_mirrors.get(i, {})
+                     for i in survivors}
+        assign = _rs.assign_recovery(dead_ranks, inventory)
+        if any(inventory.values()) \
+                and any(h is None for h in assign.values()):
+            return None             # a lost shard has no surviving copy
+        return {"dead": dead_ranks, "survivors": survivors,
+                "assign": assign}
+
+    def _reshape(self, plan: dict, cause: BaseException):
+        """Re-form the ring around the lost worker(s): survivors keep
+        their processes and live state, adopt new ranks and a fresh
+        incarnation id, and the train_fns reshard ZeRO optimizer
+        shards over the new ring (train/reshard.py) — no placement
+        group, no actor spawn, no checkpoint read. Consumes one unit
+        of the failure budget like a restart would; raises the cause
+        when the budget is exhausted or a rewire fails (the run() loop
+        then takes the restart path)."""
+        max_failures = self.run_config.failure_config.max_failures
+        if self._failures + 1 > max_failures:
+            raise cause             # run() counts + returns the error
+        t0 = time.monotonic()
+        dead = plan["dead"]
+        survivors = plan["survivors"]
+        assign = plan["assign"]
+        for i in dead:
+            try:
+                ray_tpu.kill(self._workers[i])
+            except Exception:       # noqa: BLE001 — already dead
+                pass
+        old_group = self._group_id
+        old_n = len(self._workers)
+        # survivors keep their topology order, so adjacent new ranks
+        # stay co-located wherever possible (same rule as create)
+        self._workers = [self._workers[i] for i in survivors]
+        self._infos = [self._infos[i] for i in survivors]
+        self._last_mirrors = {}
+        n = len(self._workers)
+        import uuid
+        gid = uuid.uuid4().hex
+        self._group_id = gid
+        specs = self._grad_sync_specs(gid)
+        lost = {int(d): {"old_rank": int(d), "old_size": old_n,
+                         "holder": assign.get(d)} for d in dead}
+        refs = []
+        for j, w in enumerate(self._workers):
+            contribute = [d for d in dead
+                          if assign.get(d) == survivors[j]]
+            refs.append(w.rewire.remote({
+                "rank": j, "world_size": n, "group_id": gid,
+                "old_group_id": old_group,
+                "old_rank": survivors[j], "old_world_size": old_n,
+                "grad_sync": specs[j],
+                "contribute": contribute, "lost": lost,
+                "mirror_peer": (self._workers[(j + 1) % n]
+                                if n > 1 else None)}))
+        # a rewire RPC failing (another death mid-reshape) propagates
+        # as RayTpuError: run() counts it and restarts from checkpoint
+        oks = ray_tpu.get(refs, timeout=120)
+        if not all(oks):
+            # an assigned mirror went missing (or a survivor never
+            # started a train_fn): the restart path is the safe one
+            raise cause
+        self._failures += 1
+        self._clean_reports = 0
+        self._reshape_unvalidated = True
+        self._record_recovery(
+            "reshard", cause, lost=0, dur=time.monotonic() - t0,
+            dead=dead, world=n, old_world=old_n)
+        return set(range(n))
+
     def _handle_report(self, rank: int, rep: dict):
+        # any report proves the (possibly reshaped) incarnation is
+        # making progress — later failures are new incidents
+        self._reshape_unvalidated = False
         # Rank 0's metrics are canonical (SPMD: all ranks see the same
         # reduced values). Checkpoints ARE registered from any rank — a
         # distributed save may be reported by whichever rank coordinated it.
         if rank == 0:
             self.metrics_history.append(rep["metrics"])
+            self._reports_since_ckpt += 1
         ckpt = rep.get("checkpoint")
         if ckpt is not None:
             self.ckpt_manager.register(ckpt, rep["metrics"])
+            self._reports_since_ckpt = 0
+        # failure-budget recovery: a sustained clean streak hands the
+        # budget back (FailureConfig.reset_after_clean_reports), so a
+        # long job with RARE preemptions spends max_failures per
+        # incident burst instead of exhausting it cumulatively
+        self._clean_reports += 1
+        reset = self.run_config.failure_config.reset_after_clean_reports
+        if reset > 0 and self._failures > 0 \
+                and self._clean_reports >= reset:
+            self._failures = 0
+            self._clean_reports = 0

@@ -42,6 +42,14 @@ class TrainWorker:
         self._result: Any = None
         self._error: Optional[str] = None
         self._done = threading.Event()
+        # In-memory peer-checkpoint store: ring predecessors mirror
+        # their ZeRO shard snapshots here ((group_id, from_rank) ->
+        # blob, latest wins) so a lost rank's segment is
+        # reconstructable WITHOUT touching storage (the controller
+        # reads the inventory off poll() and assigns contributions at
+        # rewire time).
+        self._mirrors: dict = {}
+        self._group_id = ""
 
     def get_address(self) -> Dict[str, Any]:
         return {"host": socket.gethostbyname(socket.gethostname()),
@@ -83,8 +91,11 @@ class TrainWorker:
                        dataset_shards: Optional[dict] = None,
                        storage_path: Optional[str] = None,
                        group_id: str = "",
-                       grad_sync: Optional[dict] = None) -> bool:
+                       grad_sync: Optional[dict] = None,
+                       mirror_peer: Any = None) -> bool:
         fn = cloudpickle.loads(fn_payload)
+        self._group_id = group_id
+        self._mirrors.clear()       # a fresh incarnation starts clean
         self.ctx = TrainContext(
             rank=self.rank, world_size=self.world_size,
             local_rank=self.local_rank, node_rank=self.node_rank,
@@ -92,7 +103,8 @@ class TrainWorker:
             dataset_shards=dataset_shards,
             storage_path=storage_path,
             group_id=group_id,
-            grad_sync=grad_sync)
+            grad_sync=grad_sync,
+            mirror_peer=mirror_peer)
 
         def run():
             set_context(self.ctx)
@@ -118,10 +130,55 @@ class TrainWorker:
 
     def poll(self) -> Dict[str, Any]:
         """Drain new reports + running state (reference:
-        worker_group.py:609 poll_status)."""
+        worker_group.py:609 poll_status). ``mirrors`` is this worker's
+        peer-checkpoint inventory for the CURRENT incarnation
+        ({mirrored_rank: step}) — the controller's reshape decision
+        reads it to know which lost segments have a surviving copy."""
         reports = self.ctx.drain_reports() if self.ctx else []
+        mirrors = {r: int(blob.get("step", 0))
+                   for (gid, r), blob in self._mirrors.items()
+                   if gid == self._group_id}
         return {"done": self._done.is_set(), "error": self._error,
-                "reports": reports, "rank": self.rank}
+                "reports": reports, "rank": self.rank,
+                "mirrors": mirrors}
+
+    # --- elastic reshape -------------------------------------------------
+
+    def store_mirror(self, group_id: str, from_rank: int, step: int,
+                     blob: dict) -> bool:
+        """Accept a ring predecessor's in-memory shard snapshot
+        (latest per (incarnation, rank) wins — there is no history to
+        keep, the newest mirror is strictly the best recovery)."""
+        self._mirrors[(group_id, int(from_rank))] = blob
+        return True
+
+    def rewire(self, payload: dict) -> bool:
+        """Adopt a reshaped incarnation IN PLACE: new rank / world
+        size / gradient-sync spec, plus the mirror blobs of lost ranks
+        this worker was assigned to contribute to the reshard
+        collective. Returns False when an assigned mirror is missing
+        (inventory raced a restart) — the controller falls back to a
+        full checkpoint-restore restart."""
+        if self.ctx is None:
+            return False
+        old_gid = payload.get("old_group_id", "")
+        recovered = []
+        for d in payload.get("contribute", ()):
+            blob = self._mirrors.get((old_gid, int(d)))
+            if blob is None:
+                return False
+            recovered.append(blob)
+        payload = dict(payload, recovered=recovered)
+        self.rank = int(payload["rank"])
+        self.world_size = int(payload["world_size"])
+        self._group_id = payload["group_id"]
+        # prune mirror generations nobody can recover from anymore
+        # (older than the incarnation being recovered right now)
+        keep = {old_gid, self._group_id}
+        self._mirrors = {k: v for k, v in self._mirrors.items()
+                         if k[0] in keep}
+        self.ctx.apply_rewire(payload)
+        return True
 
     def join(self) -> Dict[str, Any]:
         self._done.wait()

@@ -37,6 +37,7 @@ parameters directly, so there is nothing left to apply."""
 
 from __future__ import annotations
 
+import os
 from typing import Any, Optional, Tuple
 
 import numpy as np
@@ -49,8 +50,11 @@ def zero_metrics() -> dict:
     """Get-or-create the ZeRO series (process-global registry; pushed
     to the head like every other worker metric).
 
-      optim_shard_bytes  bytes of optimizer state (moments, counters)
-                         held by THIS rank — ≈ replicated_bytes / N
+      optim_shard_bytes     bytes of optimizer state (moments,
+                            counters) held by THIS rank —
+                            ≈ replicated_bytes / N
+      train_reshard_round_s wall time of one elastic reshard round on
+                            this rank (all per-leaf collectives)
     """
     from ray_tpu.util import metrics as m
     return {
@@ -59,6 +63,12 @@ def zero_metrics() -> dict:
             "Optimizer-state bytes (moments, counters) held by this "
             "rank under ZeRO-1 sharding — about 1/world_size of the "
             "replicated-optimizer footprint"),
+        "reshard_round": m.Histogram(
+            "train_reshard_round_s",
+            "Wall time of one elastic ZeRO reshard on this rank: all "
+            "per-state-leaf reduce-scatter rounds moving optimizer "
+            "shards from the old worker-group split to the new one "
+            "(train/reshard.py)"),
     }
 
 
@@ -123,10 +133,18 @@ class ShardedOptimizer:
       grad_quantize: "int8" block-quantizes the gradient
         reduce-scatter (the EQuARX-style wire format, dag/ring.py) —
         for cross-host rings where bytes are the bottleneck.
+      mirror_interval_steps: every K completed steps, snapshot this
+        rank's state shard and ship it to the ring successor as an
+        in-memory peer checkpoint (TrainContext.mirror_shard — an
+        async actor call off the step path). When a rank is lost, the
+        elastic reshard (``reshard``) reconstructs its segment from
+        the mirror instead of falling back to a disk checkpoint
+        restore. 0 disables mirroring.
     """
 
     def __init__(self, opt, *, param_wire_dtype: Optional[str] = None,
-                 grad_quantize: Optional[str] = None, group=None):
+                 grad_quantize: Optional[str] = None, group=None,
+                 mirror_interval_steps: int = 0):
         if not hasattr(opt, "init") or not hasattr(opt, "update"):
             raise TypeError(
                 "ShardedOptimizer wraps an optax-style transformation "
@@ -138,26 +156,70 @@ class ShardedOptimizer:
                 f"grad_quantize must be None or 'int8', "
                 f"got {grad_quantize!r}")
         self.grad_quantize = grad_quantize
+        if mirror_interval_steps < 0:
+            raise ValueError("mirror_interval_steps must be >= 0")
+        self.mirror_interval_steps = int(mirror_interval_steps)
         self._g = group
         self._g_resolved = group is not None
+        # generation of the train context the group was resolved
+        # against; None = explicit group (no elastic bookkeeping)
+        self._gen: Optional[int] = None if group is None else -1
         self._m = zero_metrics()
         self._step = 0      # collective-span train-step tag (tracing)
+        self._bounds: Optional[Tuple[int, int]] = None
 
     # -- group resolution --------------------------------------------------
+
+    def _ctx(self):
+        from ray_tpu.train.api import get_context
+        try:
+            return get_context()
+        except RuntimeError:         # plain script, no train_fn: local
+            return None
 
     def _group(self):
         """The ring to shard over, or None for a fully-local update
         (world_size == 1, or no train context at all)."""
         if not self._g_resolved:
-            from ray_tpu.train.api import get_context
-            try:
-                ctx = get_context()
-            except RuntimeError:     # plain script, no train_fn: local
-                ctx = None
+            ctx = self._ctx()
+            # attach under the peer-lost wrap too: a death (or rewire
+            # abort) DURING the first attach must surface as the same
+            # typed PeerLostError the recovery loop catches
             self._g = None if ctx is None or ctx.get_world_size() == 1 \
-                else ctx.gradient_sync_ring()
+                else self._wrap_peer_lost(ctx.gradient_sync_ring)
             self._g_resolved = True
+            self._gen = None if ctx is None \
+                else int(getattr(ctx, "generation", 0))
         return self._g
+
+    def _check_generation(self):
+        """A rewire (elastic reshape) invalidates the cached ring AND
+        the shard split this optimizer's state lives on — an update
+        against the stale split would be wrong on every rank. Callers
+        must reshard() first; explicit-group optimizers (gen -1) and
+        ring-less ones are exempt."""
+        if self._gen is None or self._gen < 0:
+            return
+        ctx = self._ctx()
+        if ctx is not None and \
+                int(getattr(ctx, "generation", 0)) != self._gen:
+            raise RuntimeError(
+                "worker group was reshaped since this optimizer last "
+                "resolved its collective — call "
+                "ShardedOptimizer.reshard(state) after "
+                "train.await_regroup() before the next update")
+
+    def _wrap_peer_lost(self, fn):
+        """Surface a ring neighbor's death as the typed error elastic
+        train_fns catch (train.PeerLostError), via the one shared
+        conversion (collective.peer_lost_error) so message and
+        attribute shape can't drift from the _ring_call path."""
+        from ray_tpu.dag.ring import RingPeerDead
+        try:
+            return fn()
+        except RingPeerDead as e:
+            from ray_tpu.train.collective import peer_lost_error
+            raise peer_lost_error(e) from e
 
     def shard_bounds(self, total: int) -> Tuple[int, int]:
         """This rank's owned (lo, hi) slice of the flat length-``total``
@@ -176,8 +238,12 @@ class ShardedOptimizer:
         total = int(sum(l.size for l in leaves))
         lo, hi = self.shard_bounds(total)
         self._total = total
+        self._bounds = (lo, hi)
         state = self.opt.init(_slice_leaves(leaves, wire, lo, hi))
         self._m["shard_bytes"].set(_tree_bytes(state))
+        # initial peer checkpoint: a rank lost before its first mirror
+        # interval must still be reconstructable
+        self._mirror(state)
         return state
 
     def update(self, grads, state, params):
@@ -190,6 +256,7 @@ class ShardedOptimizer:
             raise ValueError(
                 "ShardedOptimizer.update needs params (the allgather "
                 "reassembles updated parameters, not updates)")
+        self._check_generation()
         g = self._group()
         if g is not None and hasattr(g, "step"):
             # both halves of this update (RS + AG) trace as one step —
@@ -213,10 +280,11 @@ class ShardedOptimizer:
                     "gradient layout does not match the parameter "
                     "layout")
         else:
-            gshard = np.asarray(g.reduce_scatter(
-                grads, op="mean",
-                quantize=self.grad_quantize
-                if self.grad_quantize is not None else _UNSET),
+            gshard = np.asarray(self._wrap_peer_lost(
+                lambda: g.reduce_scatter(
+                    grads, op="mean",
+                    quantize=self.grad_quantize
+                    if self.grad_quantize is not None else _UNSET)),
                 dtype=wire)
             lo, hi = g.seg_bounds(total)
             if gshard.size != hi - lo:
@@ -242,16 +310,177 @@ class ShardedOptimizer:
             # flat gather (rebuild=False): the PYTREE is rebuilt below
             # from the PARAMETER leaves — the ring's cached layout
             # carries the GRADIENT leaf dtypes, which may be narrower
-            new_flat = np.asarray(g.allgather(
-                new_shard,
-                wire_dtype=self.param_wire_dtype
-                if self.param_wire_dtype is not None else _UNSET,
-                rebuild=False), dtype=wire)
+            new_flat = np.asarray(self._wrap_peer_lost(
+                lambda: g.allgather(
+                    new_shard,
+                    wire_dtype=self.param_wire_dtype
+                    if self.param_wire_dtype is not None else _UNSET,
+                    rebuild=False)), dtype=wire)
         new_params = rebuild_from_layout(new_flat, {
             "rebuild": rebuild,
             "leaves": [(l.shape, l.size, l.dtype) for l in leaves]})
         self._step += 1
+        self._bounds = (lo, hi)
+        if self.mirror_interval_steps and \
+                self._step % self.mirror_interval_steps == 0:
+            self._mirror(new_state)
         return new_params, new_state
+
+    # -- elastic reshard + in-memory peer checkpoints ----------------------
+
+    def _elem_indices(self, leaves: list, shard_len: int) -> list:
+        """Indices of state leaves living in the flat PARAMETER
+        coordinate space — exactly the per-element moments (built from
+        the shard vector by opt.init, so any array leaf of the shard's
+        length is one). Scalar leaves (step counters) are replicated
+        across ranks and never move."""
+        return [i for i, l in enumerate(leaves)
+                if getattr(l, "ndim", 0) >= 1 and l.size == shard_len]
+
+    @staticmethod
+    def _replace_elem_leaves(state, shard_len: int, new_arrays):
+        """Rebuild ``state`` substituting only the elementwise leaves
+        (same depth-first order as ``_flatten``) and passing every
+        other leaf through UNTOUCHED — an optax counter must keep its
+        exact array type (a round-trip through ``_flatten``'s rebuild
+        would .item() scalars into Python ints and trip optax's int32
+        checks on the next update)."""
+        it = iter(new_arrays)
+
+        def walk(v):
+            if isinstance(v, dict):
+                t = type(v)
+                out = {k: walk(x) for k, x in v.items()}
+                return out if t is dict else t(out)
+            if isinstance(v, tuple) and hasattr(v, "_fields"):
+                return type(v)(*(walk(x) for x in v))
+            if isinstance(v, (list, tuple)):
+                return type(v)(walk(x) for x in v)
+            a = np.asarray(v)
+            if a.ndim >= 1 and a.size == shard_len:
+                return next(it)
+            return v
+        return walk(state)
+
+    def _snapshot(self, state) -> dict:
+        """One in-memory peer-checkpoint blob: this rank's elementwise
+        state leaves (copied — the live arrays keep mutating) plus the
+        coordinates needed to re-embed them during a reshard."""
+        lo, hi = self._bounds
+        leaves, _, _ = _flatten(state)
+        arrays = [np.array(np.asarray(leaves[i]).reshape(-1), copy=True)
+                  for i in self._elem_indices(leaves, hi - lo)]
+        return {"step": self._step, "bounds": (int(lo), int(hi)),
+                "total": int(self._total), "leaves": arrays}
+
+    def _mirror(self, state) -> None:
+        """Ship a snapshot to the ring successor, best-effort and off
+        the step path (the actor call is posted, not awaited)."""
+        if not self.mirror_interval_steps or self._bounds is None:
+            return
+        ctx = self._ctx()
+        if ctx is None or ctx.get_world_size() == 1:
+            return
+        try:
+            ctx.mirror_shard(self._snapshot(state))
+        except Exception:   # noqa: BLE001 — mirroring is best-effort
+            pass
+
+    def reshard(self, state):
+        """Redistribute this optimizer's state to the CURRENT worker
+        group's shard split after an elastic reshape — the in-place
+        alternative to restarting from a disk checkpoint. Call after
+        ``train.await_regroup()`` returns::
+
+            except train.PeerLostError:
+                train.await_regroup(timeout_s=60)
+                state = opt.reshard(state)
+                continue        # retry the interrupted step
+
+        Each elementwise state leaf rides one reduce-scatter over the
+        NEW ring (train/reshard.py): this rank contributes its old
+        shard plus any peer-checkpoint mirrors of LOST ranks the
+        controller assigned to it, and receives its new owned slice.
+        Parameters need no exchange — ZeRO-1 replicates them. Raises
+        ``reshard.ReshardError`` when a lost segment has no surviving
+        copy (fall back to the restart path by letting it propagate)."""
+        import time as _time
+
+        from ray_tpu.train import reshard as _rs
+        from ray_tpu.train.api import get_context
+        from ray_tpu.util import events
+        ctx = get_context()
+        if getattr(self, "_total", None) is None or self._bounds is None:
+            raise RuntimeError("reshard() before init()")
+        t0 = _time.monotonic()
+        total = self._total
+        old_lo, old_hi = self._bounds
+        # re-resolve the collective against the REWIRED context (the
+        # attach is peer-lost-wrapped: another death mid-regroup must
+        # stay catchable by the same recovery loop)
+        self._g = None if ctx.get_world_size() == 1 \
+            else self._wrap_peer_lost(ctx.gradient_sync_ring)
+        self._g_resolved = True
+        self._gen = int(getattr(ctx, "generation", 0))
+        g = self._g
+        leaves, _, _ = _flatten(state)
+        elem = self._elem_indices(leaves, old_hi - old_lo)
+        # every lost rank's segment must have a surviving copy SOMEWHERE
+        # (this rank or a peer) — the controller can only see mirror
+        # inventories, so a sharded-but-unmirrored optimizer reaches
+        # here with holder=None and must fail loudly rather than let
+        # the exchange materialize zeros where moments existed
+        lost = ctx.lost_info() if hasattr(ctx, "lost_info") else {}
+        for d, info in sorted(lost.items()):
+            if info.get("holder") is not None:
+                continue
+            osz = int(info.get("old_size") or 1)
+            olo, ohi = _rs.shard_bounds(
+                total, osz, int(info.get("old_rank", d)))
+            if olo < ohi:
+                raise _rs.ReshardError(
+                    f"lost rank {d}'s optimizer shard [{olo}, {ohi}) "
+                    f"has no surviving in-memory mirror — cannot "
+                    f"reshard in place (set mirror_interval_steps>=1 "
+                    f"to enable peer checkpoints); let this propagate "
+                    f"so the controller restores from checkpoint")
+        mirrors = ctx.take_recovered_mirrors()
+        for mb in mirrors:
+            if mb.get("total") != total or \
+                    len(mb.get("leaves", ())) != len(elem):
+                raise _rs.ReshardError(
+                    f"peer mirror does not match this optimizer "
+                    f"(total {mb.get('total')} vs {total}, "
+                    f"{len(mb.get('leaves', ()))} vs {len(elem)} "
+                    f"elementwise leaves)")
+        staleness = max((self._step - int(mb.get("step", 0))
+                         for mb in mirrors), default=0)
+        new_arrays = []
+        for j, i in enumerate(elem):
+            src = np.asarray(leaves[i])
+            pieces = [(old_lo, old_hi, src.reshape(-1))]
+            for mb in mirrors:
+                mlo, mhi = mb["bounds"]
+                pieces.append((int(mlo), int(mhi), mb["leaves"][j]))
+            out = self._wrap_peer_lost(
+                lambda p=pieces, d=src.dtype:
+                _rs.exchange(g, total, p, dtype=d))
+            new_arrays.append(out.astype(src.dtype, copy=False))
+        new_state = self._replace_elem_leaves(
+            state, old_hi - old_lo, new_arrays)
+        self._bounds = self.shard_bounds(total)
+        dur = _time.monotonic() - t0
+        self._m["reshard_round"].observe(dur)
+        self._m["shard_bytes"].set(_tree_bytes(new_state))
+        events.record(
+            "train", "reshard", ph="X", ts=_time.time() - dur, dur=dur,
+            rank=ctx.get_world_rank(), size=ctx.get_world_size(),
+            group=ctx.group_id[:12], step=self._step,
+            mirrors=len(mirrors), staleness_steps=int(staleness),
+            pid=os.getpid())
+        # re-mirror promptly so the NEW incarnation starts covered
+        self._mirror(new_state)
+        return new_state
 
     # -- helpers -----------------------------------------------------------
 

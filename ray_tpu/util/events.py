@@ -28,15 +28,21 @@ from typing import Deque, Dict, List
 # Every category the framework records (lint-enforced; see module doc).
 #   trace       task/actor submit edges + exec spans (util/tracing.py)
 #   collective  ring collective rounds / chunk phases (dag/ring.py)
+#   train       train-group lifecycle: reshard / restart / rewire spans
+#               (train/controller.py, train/zero.py)
 #   worker      worker lifecycle incidents (runtime/agent.py)
 #   cgroup      cgroup attach/availability incidents (runtime/agent.py)
 #   memory      memory-monitor OOM kills (runtime/agent.py)
-CATEGORIES = ("trace", "collective", "worker", "cgroup", "memory")
+CATEGORIES = ("trace", "collective", "train", "worker", "cgroup",
+              "memory")
 
 _DEFAULT_CAP = 65536
 # Dedicated sub-budgets: the key also names the bucket. Everything
-# else shares the "" bucket at _DEFAULT_CAP.
-_CATEGORY_CAPS: Dict[str, int] = {"collective": 16384}
+# else shares the "" bucket at _DEFAULT_CAP. "train" is budget-capped
+# like "collective": a crash-looping group emitting restart/reshard
+# spans every few seconds must age out against itself, not evict the
+# task exec spans the timeline is built on.
+_CATEGORY_CAPS: Dict[str, int] = {"collective": 16384, "train": 4096}
 
 _BUFS: Dict[str, Deque[dict]] = {}
 _LOCK = threading.Lock()

@@ -90,6 +90,8 @@ def instantiate_all() -> dict:
     take(ring.allreduce_metrics())
     from ray_tpu.train import zero
     take(zero.zero_metrics())
+    from ray_tpu.train import controller
+    take(controller.train_metrics())
     return out
 
 
@@ -137,12 +139,27 @@ def lint_event_categories(found: list, allowed=None) -> list:
         for site, cat in found if cat not in allowed)
 
 
+def lint_category_caps() -> list:
+    """Every budget-capped category must itself be registered: a cap
+    keyed on an unregistered name would silently create a bucket no
+    recorder ever routes into (the "train"/"collective" sub-budgets
+    exist to protect task spans from floods — a typo there disables
+    the protection without an error anywhere)."""
+    from ray_tpu.util import events
+    return sorted(
+        f"events._CATEGORY_CAPS key {cat!r} not registered in "
+        f"events.CATEGORIES"
+        for cat in events._CATEGORY_CAPS
+        if cat not in events.CATEGORIES)
+
+
 def main() -> int:
     instantiate_all()
     from ray_tpu.util import metrics
     errors = lint(metrics._REGISTRY)
     found = scan_event_categories()
     errors += lint_event_categories(found)
+    errors += lint_category_caps()
     if errors:
         print(f"{len(errors)} metric/event lint violation(s):")
         for e in errors:

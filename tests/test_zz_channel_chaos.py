@@ -1,0 +1,194 @@
+"""Deterministic channel fault injection (dag/channel.py ChannelChaos,
+Config.testing_channel_failure): the data-plane sibling of the RPC
+chaos plan — drop / delay / kill-on-Nth-op on the shm ring + TCP
+transports, repeatable by op index instead of hand-timed kills.
+Late-alphabet module name keeps the tier-1 870 s cutoff stable."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu.dag import channel as ch_mod
+from ray_tpu.dag.channel import (DATA, ChannelChaos, ChannelTimeout,
+                                 ShmRingChannel, reset_channel_chaos)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def chaos():
+    """Arm a testing_channel_failure spec for the duration of one test
+    and ALWAYS disarm it — leaked chaos rules would fail every later
+    channel-using test in the process."""
+    from ray_tpu.config import Config, set_config
+
+    def arm(spec):
+        set_config(Config.from_env(testing_channel_failure=spec))
+        reset_channel_chaos()
+
+    try:
+        yield arm
+    finally:
+        set_config(Config.from_env(testing_channel_failure=""))
+        reset_channel_chaos()
+
+
+def _pair():
+    ch = ShmRingChannel(create=True, nslots=4, slot_bytes=4096)
+    return ch
+
+
+def test_spec_parse_rejects_garbage():
+    for bad in ("write", "write:drop", "flip:drop:1", "write:exploded:1",
+                "write:drop:0", "read:drop:x"):
+        with pytest.raises(ValueError):
+            ChannelChaos(bad)
+    plan = ChannelChaos("write:drop:2,read:delay:1:0.05")
+    assert len(plan.rules) == 2
+
+
+def test_counters_fire_on_exact_nth_op():
+    plan = ChannelChaos("write:drop:3")
+    assert plan.fire("write") is None
+    assert plan.fire("read") is None      # reads don't advance writes
+    assert plan.fire("write") is None
+    assert plan.fire("write") == "drop"   # the 3rd write exactly
+    assert plan.fire("write") is None     # one-shot
+
+
+def test_sliced_retries_do_not_advance_nth_counters(chaos):
+    """RingReducer._op_sliced re-enters the same logical channel op
+    every abort slice; those retries are marked (chaos_mark_retry) and
+    must not advance the Nth-op counters — determinism is per LOGICAL
+    op, not per wall-clock wait slice."""
+    chaos("read:drop:3")
+    ch = _pair()
+    try:
+        ch.write(b"a", DATA)
+        assert ch.read_bytes(timeout=1.0)[1] == b"a"    # logical op 1
+        # logical op 2: an empty-channel wait re-entered slice by
+        # slice the way _op_sliced retries — only the first attempt
+        # may count, else the rule would silently overshoot nth
+        for attempt in range(4):
+            if attempt:
+                ch_mod.chaos_mark_retry(True)
+            try:
+                with pytest.raises(ChannelTimeout):
+                    ch.read_bytes(timeout=0.01)
+            finally:
+                ch_mod.chaos_mark_retry(False)
+        ch.write(b"b", DATA)
+        with pytest.raises(ChannelTimeout):   # op 3: the drop fires
+            ch.read_bytes(timeout=0.5)
+        assert ch.read_bytes(timeout=1.0)[1] == b"b"    # one-shot
+    finally:
+        ch.close()
+        ch.unlink()
+
+
+def test_injected_write_drop_starves_reader(chaos):
+    chaos("write:drop:1")
+    ch = _pair()
+    try:
+        ch.write(b"lost", DATA)           # dropped on the floor
+        with pytest.raises(ChannelTimeout):
+            ch.read_bytes(timeout=0.2)
+        ch.write(b"kept", DATA)           # rule spent: flows again
+        kind, data = ch.read_bytes(timeout=2.0)
+        assert (kind, data) == (DATA, b"kept")
+    finally:
+        ch.close()
+        ch.unlink()
+
+
+def test_injected_read_drop_raises_once(chaos):
+    chaos("read:drop:1")
+    ch = _pair()
+    try:
+        ch.write(b"v", DATA)
+        with pytest.raises(ChannelTimeout):
+            ch.read_bytes(timeout=2.0)
+        kind, data = ch.read_bytes(timeout=2.0)   # frame still there
+        assert (kind, data) == (DATA, b"v")
+    finally:
+        ch.close()
+        ch.unlink()
+
+
+def test_injected_delay_fires_on_nth_write(chaos):
+    chaos("write:delay:3:0.25")
+    ch = _pair()
+    try:
+        t0 = time.monotonic()
+        ch.write(b"a", DATA)
+        ch.write(b"b", DATA)
+        fast = time.monotonic() - t0
+        t1 = time.monotonic()
+        ch.write(b"c", DATA)
+        slow = time.monotonic() - t1
+        assert slow >= 0.25 > fast
+    finally:
+        ch.close()
+        ch.unlink()
+
+
+_CHILD = r"""
+import sys
+from ray_tpu.dag.channel import DATA, ShmRingChannel
+ch = ShmRingChannel(sys.argv[1], nslots=4, slot_bytes=4096)
+for i in range(4):
+    ch.write(b"frame-%d" % i, DATA, timeout=10)
+print("survived all writes")
+"""
+
+
+def _run_child(name, spec):
+    env = dict(os.environ,
+               RAY_TPU_TESTING_CHANNEL_FAILURE=spec,
+               JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-c", _CHILD, name], env=env,
+        capture_output=True, timeout=60)
+
+
+def test_kill_on_nth_op_is_a_deterministic_worker_death():
+    """kill-on-Nth-op SIGKILLs the process at an exact pipeline
+    position — the repeatable stand-in for a preempted worker. Run it
+    twice: same op index, same frames on the wire both times."""
+    counts = []
+    for _ in range(2):
+        ch = ShmRingChannel(create=True, nslots=4, slot_bytes=4096)
+        try:
+            proc = _run_child(ch.name, "write:kill:3")
+            assert proc.returncode == -signal.SIGKILL, (
+                proc.returncode, proc.stdout, proc.stderr)
+            got = 0
+            while True:
+                try:
+                    kind, data = ch.read_bytes(timeout=0.2)
+                except ChannelTimeout:
+                    break
+                assert data == b"frame-%d" % got
+                got += 1
+            counts.append(got)
+        finally:
+            ch.close()
+            ch.unlink()
+    # exactly the 2 frames before the killed 3rd write, both runs
+    assert counts == [2, 2]
+
+
+def test_no_spec_means_no_interference(chaos):
+    chaos("")
+    ch = _pair()
+    try:
+        for i in range(8):
+            ch.write(b"x%d" % i, DATA)
+            assert ch.read_bytes(timeout=2.0)[1] == b"x%d" % i
+    finally:
+        ch.close()
+        ch.unlink()

@@ -1,0 +1,324 @@
+"""Elastic training under chaos: SIGKILL one worker mid-step and
+assert the group survives with loss-curve continuity.
+
+Two recovery paths, held to the SAME tolerance against an exact
+locally-computed reference curve:
+
+  * reshard (tier-1): the controller re-forms the ring at N-1, the
+    survivors redistribute ZeRO optimizer shards over collectives
+    (train/reshard.py) with the dead rank's segment reconstructed from
+    its in-memory peer mirror — no step regression beyond the
+    in-flight step, no storage touched;
+  * checkpoint restore (slow): the classic teardown + restart from the
+    latest per-step checkpoint.
+
+Every rank sees the SAME batch, so the loss curve is world-size
+independent — a 3-rank prefix and a 2-rank suffix must lie on one
+reference trajectory if and only if state survived intact.
+
+Own module (needs its own cluster + failure configs); late-alphabet
+name keeps the tier-1 870 s cutoff stable."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.config import Config
+from ray_tpu.train.api import (Checkpoint, FailureConfig, RunConfig,
+                               ScalingConfig)
+
+pytestmark = pytest.mark.chaos
+
+STEPS, DIE_AT, DIM, LR = 12, 5, 12, 0.05
+TOL = dict(rtol=2e-3, atol=1e-4)     # the ONE continuity tolerance
+
+
+def _problem():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(32, DIM)).astype(np.float32)
+    w_true = np.linspace(-1.0, 1.0, DIM).astype(np.float32)
+    return X, (X @ w_true).astype(np.float32)
+
+
+def _loss_grad(w, X, y):
+    r = X @ w - y
+    return float(np.mean(r * r)), \
+        ((2.0 / len(y)) * (X.T @ r)).astype(np.float32)
+
+
+def _reference_losses():
+    """The uninterrupted trajectory, computed exactly (adam is
+    elementwise, so the sharded update reproduces it per coordinate)."""
+    import optax
+    X, y = _problem()
+    opt = optax.adam(LR)
+    w = np.zeros(DIM, np.float32)
+    state = opt.init(w)
+    losses = []
+    for _ in range(STEPS):
+        loss, g = _loss_grad(w, X, y)
+        losses.append(loss)
+        upd, state = opt.update(g, state, w)
+        w = (w + np.asarray(upd, np.float32)).astype(np.float32)
+    return losses
+
+
+@pytest.fixture
+def cluster():
+    cfg = Config.from_env(num_workers_prestart=0, max_workers_per_node=8,
+                          default_max_task_retries=0)
+    ray_tpu.init(num_cpus=6, config=cfg)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_chaos_kill_midstep_reshards_to_n_minus_1(cluster, tmp_path):
+    marker = os.path.join(str(tmp_path), "died_once")
+    problem, loss_grad = _problem, _loss_grad
+    steps_n, die_at, dim, lr = STEPS, DIE_AT, DIM, LR
+
+    def train_fn():
+        import os as _os
+        import signal as _signal
+        import time as _time
+
+        import numpy as _np
+        import optax
+
+        from ray_tpu import train as _train
+        ctx = _train.get_context()
+        X, y = problem()
+        params = {"w": _np.zeros(dim, _np.float32)}
+        opt = _train.ShardedOptimizer(optax.adam(lr),
+                                      mirror_interval_steps=1)
+        state = opt.init(params)
+        step = 0
+        while step < steps_n:
+            loss, g = loss_grad(params["w"], X, y)
+            if step == die_at and ctx.generation == 0 \
+                    and ctx.get_world_rank() == 1 \
+                    and not _os.path.exists(marker):
+                open(marker, "w").close()
+                # brief pause so the step-(die_at-1) mirror and at
+                # least one controller poll land before the death —
+                # mid-step: the survivors are about to enter the sync
+                _time.sleep(0.5)
+                _os.kill(_os.getpid(), _signal.SIGKILL)
+            try:
+                params, state = opt.update({"w": g}, state, params)
+            except _train.PeerLostError:
+                _train.await_regroup(timeout_s=60)
+                state = opt.reshard(state)
+                continue            # retry the interrupted step
+            _train.report({"step": step, "loss": loss,
+                           "world": ctx.get_world_size(),
+                           "generation": ctx.generation})
+            step += 1
+            _time.sleep(0.15)       # paces mirrors + controller polls
+
+    res = train.JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(
+            num_workers=(2, 3), sync_timeout_s=8.0,
+            elastic_grow_interval_s=0.0),
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=1))).fit()
+    assert res.error is None, res.error
+    assert os.path.exists(marker), "the victim never fired"
+    hist = [m for m in res.metrics_history if "step" in m]
+    steps = [m["step"] for m in hist]
+    # continuity: every step reported exactly once, no regression
+    # beyond the in-flight step (which simply retried)
+    assert steps == list(range(STEPS)), steps
+    worlds = [m["world"] for m in hist]
+    assert set(worlds[:DIE_AT]) == {3}, worlds
+    assert set(worlds[DIE_AT:]) == {2}, worlds
+    assert hist[-1]["generation"] == 1          # resharded, no restart
+    np.testing.assert_allclose(
+        [m["loss"] for m in hist], _reference_losses(), **TOL)
+
+
+@pytest.mark.slow
+def test_chaos_kill_midstep_checkpoint_restore_same_tolerance(
+        cluster, tmp_path):
+    """The fallback path under the SAME kill and the SAME tolerance:
+    fixed-size group, per-step checkpoints, full restart + restore —
+    proving the reshard test's tolerance is not doing hidden work."""
+    tmp = str(tmp_path)
+    marker = os.path.join(tmp, "died_once")
+    problem, loss_grad = _problem, _loss_grad
+    steps_n, die_at, dim, lr = STEPS, DIE_AT, DIM, LR
+
+    def train_fn():
+        import json as _json
+        import os as _os
+        import signal as _signal
+        import time as _time
+
+        import jax
+        import numpy as _np
+        import optax
+
+        from ray_tpu import train as _train
+        ctx = _train.get_context()
+        rank = ctx.get_world_rank()
+        X, y = problem()
+        params = {"w": _np.zeros(dim, _np.float32)}
+        opt = _train.ShardedOptimizer(optax.adam(lr))
+        state = opt.init(params)
+        start = 0
+        resume = ctx.get_checkpoint()
+        if resume is not None:
+            d = resume.path
+            with open(_os.path.join(d, "meta.json")) as f:
+                start = _json.load(f)["step"] + 1
+            params = {"w": _np.load(_os.path.join(d, "w.npy"))}
+            blob = _np.load(_os.path.join(d, f"opt_{rank}.npz"))
+            tdef = jax.tree_util.tree_structure(state)
+            state = jax.tree_util.tree_unflatten(
+                tdef, [blob[f"l{i}"] for i in range(len(blob.files))])
+        for step in range(start, steps_n):
+            loss, g = loss_grad(params["w"], X, y)
+            if step == die_at and rank == 1 \
+                    and not _os.path.exists(marker):
+                open(marker, "w").close()
+                _time.sleep(0.3)
+                _os.kill(_os.getpid(), _signal.SIGKILL)
+            params, state = opt.update({"w": g}, state, params)
+            d = _os.path.join(tmp, f"ck_{step}")
+            _os.makedirs(d, exist_ok=True)
+            leaves = [_np.asarray(x) for x in
+                      jax.tree_util.tree_leaves(state)]
+            _np.savez(_os.path.join(d, f"opt_{rank}.npz"),
+                      **{f"l{i}": a for i, a in enumerate(leaves)})
+            if rank == 0:
+                _np.save(_os.path.join(d, "w.npy"), params["w"])
+                with open(_os.path.join(d, "meta.json"), "w") as f:
+                    _json.dump({"step": step}, f)
+                _train.report(
+                    {"step": step, "loss": loss,
+                     "world": ctx.get_world_size()},
+                    checkpoint=_train.Checkpoint.from_directory(d))
+            else:
+                _train.report({"step": step, "loss": loss})
+
+    res = train.JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=3, sync_timeout_s=8.0),
+        run_config=RunConfig(
+            storage_path=tmp,
+            failure_config=FailureConfig(max_failures=1))).fit()
+    assert res.error is None, res.error
+    assert os.path.exists(marker), "the victim never fired"
+    hist = [m for m in res.metrics_history if "step" in m]
+    steps = [m["step"] for m in hist]
+    assert steps == list(range(STEPS)), steps
+    assert set(m["world"] for m in hist) == {3}
+    np.testing.assert_allclose(
+        [m["loss"] for m in hist], _reference_losses(), **TOL)
+
+
+def test_failed_reshape_and_restart_are_one_incident(cluster, tmp_path):
+    """A reshape the train_fn can't honor (no await_regroup loop: the
+    survivor's next collective raises an uncaught PeerLostError) must
+    escalate to the checkpoint restart WITHOUT consuming a second
+    failure-budget unit — with max_failures=1 the job still completes.
+    Double-charging (reshape + same-incident restart) would exhaust
+    the budget and kill the job on a single preemption."""
+    tmp = str(tmp_path)
+
+    def train_fn():
+        import os as _os
+        import time as _time
+
+        import numpy as _np
+
+        from ray_tpu import train as _train
+        ctx = _train.get_context()
+        start = 0
+        resume = ctx.get_checkpoint()
+        if resume is not None:
+            with open(_os.path.join(resume.path, "step.txt")) as f:
+                start = int(f.read()) + 1
+        for step in range(start, 8):
+            if ctx.get_world_size() > 1:
+                _train.allreduce_gradients(
+                    {"g": _np.ones(4, _np.float32)})
+            if ctx.get_world_rank() == 0:
+                d = _os.path.join(tmp, f"ck_{step}")
+                _os.makedirs(d, exist_ok=True)
+                with open(_os.path.join(d, "step.txt"), "w") as f:
+                    f.write(str(step))
+                _train.report(
+                    {"step": step},
+                    checkpoint=_train.Checkpoint.from_directory(d))
+            else:
+                _train.report({"step": step})
+            _time.sleep(0.2)
+            if step == 3 and ctx.get_world_rank() == 1 and \
+                    not _os.path.exists(_os.path.join(tmp, "death")):
+                open(_os.path.join(tmp, "death"), "w").close()
+                _os._exit(1)
+
+    res = train.JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=(1, 2),
+                                     sync_timeout_s=8.0),
+        run_config=RunConfig(
+            storage_path=tmp,
+            failure_config=FailureConfig(max_failures=1))).fit()
+    assert res.error is None, res.error
+    assert res.metrics["step"] == 7
+    assert os.path.exists(os.path.join(tmp, "death"))
+
+
+def test_failure_budget_resets_after_clean_streak(cluster, tmp_path):
+    """FailureConfig.reset_after_clean_reports: two rare incidents, one
+    budget unit each — a cumulative budget (the old behavior) would
+    exhaust max_failures=1 at the second death."""
+    tmp = str(tmp_path)
+
+    def train_fn():
+        import os as _os
+        import time as _time
+
+        from ray_tpu import train as _train
+        ctx = _train.get_context()
+        start = 0
+        resume = ctx.get_checkpoint()
+        if resume is not None:
+            with open(_os.path.join(resume.path, "step.txt")) as f:
+                start = int(f.read()) + 1
+        for step in range(start, 10):
+            d = _os.path.join(tmp, f"ck_{step}")
+            _os.makedirs(d, exist_ok=True)
+            with open(_os.path.join(d, "step.txt"), "w") as f:
+                f.write(str(step))
+            _train.report({"step": step},
+                          checkpoint=_train.Checkpoint.from_directory(d))
+            # reports live in the worker until the controller's ~0.2 s
+            # poll drains them — pace the loop, or a death would take
+            # the whole clean streak down with it
+            _time.sleep(0.3)
+            if step in (2, 7) and \
+                    not _os.path.exists(_os.path.join(
+                        tmp, f"death_{step}")):
+                open(_os.path.join(tmp, f"death_{step}"), "w").close()
+                _os._exit(1)
+
+    res = train.JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            storage_path=tmp,
+            failure_config=FailureConfig(
+                max_failures=1,
+                reset_after_clean_reports=3))).fit()
+    assert res.error is None, res.error
+    assert res.metrics["step"] == 9
+    deaths = [x for x in os.listdir(tmp) if x.startswith("death_")]
+    assert len(deaths) == 2, deaths

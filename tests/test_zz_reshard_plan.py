@@ -1,0 +1,167 @@
+"""Elastic reshard planning units (train/reshard.py): pure math, no
+cluster — N->N-1 and N->N+k plans, zero-size shards, contribution
+embedding, coverage, and mirror-holder assignment. Late-alphabet module
+name keeps the tier-1 870 s cutoff stable."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.train import reshard as rs
+
+
+def _tiles(total, size):
+    bounds = rs.all_bounds(total, size)
+    assert bounds[0][0] == 0 and bounds[-1][1] == total
+    for (alo, ahi), (blo, bhi) in zip(bounds, bounds[1:]):
+        assert ahi == blo
+    return bounds
+
+
+def test_shard_bounds_tile_and_match_ring_formula():
+    for total in (0, 1, 3, 7, 1000):
+        for size in (1, 2, 3, 5, 8):
+            _tiles(total, size)
+            for r in range(size):
+                lo, hi = rs.shard_bounds(total, size, r)
+                assert (lo, hi) == (total * r // size,
+                                    total * (r + 1) // size)
+    with pytest.raises(ValueError):
+        rs.shard_bounds(10, 4, 4)
+
+
+def _check_plan(total, old_n, new_n, keep=None):
+    moves = rs.plan_reshard(total, old_n, new_n, keep=keep)
+    # every move is a genuine overlap of one old and one new segment
+    for m in moves:
+        olo, ohi = rs.shard_bounds(total, old_n, m.src)
+        nlo, nhi = rs.shard_bounds(total, new_n, m.dst)
+        assert olo <= m.lo < m.hi <= ohi
+        assert nlo <= m.lo < m.hi <= nhi
+    # the moves exactly tile the flat space (each coord moved once)
+    covered = sorted((m.lo, m.hi) for m in moves)
+    assert rs.coverage_gaps(total, covered) == []
+    assert sum(hi - lo for lo, hi in covered) == total
+    return moves
+
+
+def test_plan_shrink_n_to_n_minus_1():
+    moves = _check_plan(12, 4, 3)
+    # rank 0's new segment [0,4) spans old rank 0's [0,3) fully plus
+    # one element of old rank 1's — the minimal move set
+    locals_ = [m for m in moves if m.local]
+    wires = [m for m in moves if not m.local]
+    assert locals_ and wires
+    # identity keep: old rank r surviving as new rank r keeps its
+    # overlap local
+    for m in locals_:
+        assert m.src == m.dst
+
+
+def test_plan_grow_n_to_n_plus_k():
+    moves = _check_plan(100, 3, 5)
+    # growing strictly adds owners: every NEW rank receives something
+    assert {m.dst for m in moves} == set(range(5))
+    # moved (wire) bytes are only the non-local overlap
+    wire = rs.moved_bytes(moves)
+    assert 0 < wire < 4 * 100
+
+
+def test_plan_zero_size_shards():
+    # total < new size: some new shards are empty — no moves target them
+    moves = _check_plan(3, 5, 2)
+    moves2 = _check_plan(3, 2, 5)
+    assert all(m.hi > m.lo for m in moves + moves2)
+    # fully empty space: nothing to move anywhere
+    assert rs.plan_reshard(0, 4, 3) == []
+
+
+def test_plan_survivor_keep_map():
+    # old rank 1 died; survivors 0,2 become new ranks 0,1
+    keep = {0: 0, 2: 1}
+    moves = rs.plan_reshard(9, 3, 2, keep=keep)
+    for m in moves:
+        assert m.local == (keep.get(m.src) == m.dst)
+    # old rank 1's data is needed by SOME new rank but is never local
+    assert any(m.src == 1 and not m.local for m in moves)
+
+
+def test_contribution_embeds_disjoint_and_rejects_overlap():
+    v = rs.contribution(10, [(0, 3, np.arange(3.)),
+                             (7, 10, np.arange(3.))])
+    assert v.tolist() == [0, 1, 2, 0, 0, 0, 0, 0, 1, 2]
+    with pytest.raises(rs.ReshardError):
+        rs.contribution(10, [(0, 5, np.zeros(5)), (4, 8, np.zeros(4))])
+    with pytest.raises(rs.ReshardError):
+        rs.contribution(10, [(0, 5, np.zeros(3))])   # length mismatch
+    with pytest.raises(rs.ReshardError):
+        rs.contribution(4, [(2, 6, np.zeros(4))])    # out of range
+
+
+def test_coverage_gaps():
+    assert rs.coverage_gaps(10, [(0, 10)]) == []
+    assert rs.coverage_gaps(10, [(2, 4), (6, 8)]) == \
+        [(0, 2), (4, 6), (8, 10)]
+    assert rs.coverage_gaps(0, []) == []
+    assert rs.coverage_gaps(5, []) == [(0, 5)]
+
+
+def test_local_exchange_requires_full_coverage():
+    out = rs.exchange(None, 6, [(0, 2, np.arange(2.)),
+                                (2, 6, np.arange(4.))])
+    assert out.tolist() == [0, 1, 0, 1, 2, 3]
+    with pytest.raises(rs.ReshardError):
+        rs.exchange(None, 6, [(0, 2, np.arange(2.))])
+
+
+def test_assign_recovery_picks_freshest_mirror():
+    inv = {0: {2: 5}, 1: {2: 9, 3: 1}, 3: {}}
+    assert rs.assign_recovery([2], inv) == {2: 1}          # step 9 wins
+    assert rs.assign_recovery([2, 3], inv) == {2: 1, 3: 1}
+    assert rs.assign_recovery([4], inv) == {4: None}       # uncovered
+
+
+class _FakeRing:
+    """RingReducer-shaped double: 'reduce_scatter' sums the vectors
+    every fake rank contributed and returns this rank's new slice —
+    the exchange() contract without processes."""
+
+    def __init__(self, rank, size, pool):
+        self.rank, self.size, self.own = rank, size, rank
+        self.pool = pool
+
+    def seg_bounds(self, total, seg=None):
+        s = self.rank if seg is None else seg
+        return total * s // self.size, total * (s + 1) // self.size
+
+    def reduce_scatter(self, value, op="sum"):
+        assert op == "sum"
+        self.pool.append(np.asarray(value, np.float64))
+        full = np.sum(self.pool, axis=0)
+        lo, hi = self.seg_bounds(full.size)
+        return full[lo:hi]
+
+
+def test_exchange_matches_plan_on_shrink():
+    """Simulated 3->2 reshard: survivors (old ranks 0, 1) plus old
+    rank 1 holding old rank 2's mirror reconstruct exactly the values
+    the plan says each new rank owns."""
+    total = 11
+    state = np.arange(total, dtype=np.float64) * 1.5
+    old = rs.all_bounds(total, 3)
+    pieces = {
+        0: [(old[0][0], old[0][1], state[old[0][0]:old[0][1]])],
+        1: [(old[1][0], old[1][1], state[old[1][0]:old[1][1]]),
+            # old rank 1 contributes the dead rank 2's mirror
+            (old[2][0], old[2][1], state[old[2][0]:old[2][1]])],
+    }
+    outs = {}
+    for new_rank in (0, 1):
+        pool = []
+        for contributor in (0, 1):
+            ring = _FakeRing(new_rank, 2, pool)
+            out = rs.exchange(ring, total, pieces[contributor])
+        outs[new_rank] = out
+    new = rs.all_bounds(total, 2)
+    for r in (0, 1):
+        lo, hi = new[r]
+        np.testing.assert_allclose(outs[r], state[lo:hi])
