@@ -87,6 +87,49 @@ class Config:
     # See dag/channel.py ChannelChaos.
     testing_channel_failure: str = ""
 
+    # --- serve fault tolerance ---
+    # Default per-request deadline budget (seconds) when the client
+    # sends no X-Request-Deadline header. The budget is spent across
+    # queueing, routing, retries, and the replica call; once spent the
+    # proxy answers 504 and downstream work is cancelled.
+    serve_default_deadline_s: float = 120.0
+    # Proxy admission control: requests beyond the deployment's live
+    # capacity (running replicas x max_ongoing_requests) wait in a
+    # bounded queue; past this depth — or when the predicted queue wait
+    # exceeds the request's remaining deadline budget — the proxy sheds
+    # with a fast 503 + Retry-After instead of letting the request ride
+    # to its full deadline.
+    serve_queue_limit: int = 128
+    # Budgeted retry policy (route refresh, reroute-on-submit-failure):
+    # attempts are jittered-exponential-backoff spaced and always capped
+    # by the request's remaining deadline.
+    serve_retry_max_attempts: int = 3
+    # Replica circuit breaker (caller-side routing table): eject a
+    # replica after this many CONSECUTIVE infrastructure failures;
+    # half-open recovery probes admit one trial request after the
+    # cooldown (ping probes can shortcut or extend it).
+    serve_cb_failure_threshold: int = 3
+    serve_cb_cooldown_s: float = 2.0
+    # Latency ejection: >0 arms it — this many consecutive calls slower
+    # than the threshold eject the replica like failures do. 0 = off.
+    serve_cb_latency_threshold_s: float = 0.0
+    serve_cb_latency_count: int = 3
+    # Graceful draining: a DRAINING replica (scale-down / redeploy)
+    # finishes its in-flight requests (incl. streams) and accepts no
+    # new ones; after this many seconds the controller stops waiting.
+    serve_drain_timeout_s: float = 30.0
+    # Deterministic fault injection for the SERVE data path, the
+    # serving sibling of testing_rpc_failure / testing_channel_failure
+    # (reference: src/ray/rpc/rpc_chaos.h + serve.proto health checks).
+    # Comma-separated rules "<site>:<action>:<nth>[:<param>]": site in
+    # {proxy (handle -> replica submission), replica (replica -> user
+    # code / engine)}; action in {error (raise an injected failure),
+    # delay (sleep <param> s), drop (replica only: never respond — the
+    # caller's deadline fires), kill (SIGKILL this process)}; nth =
+    # 1-based index of the matching site's requests, counted
+    # process-wide. See serve/chaos.py ServeChaos.
+    testing_serve_failure: str = ""
+
     # --- tasks / actors ---
     default_max_task_retries: int = 3
     default_max_actor_restarts: int = 0

@@ -85,6 +85,10 @@ class _Request:
     fut: Optional[asyncio.Future] = None
     stream: Optional[asyncio.Queue] = None
     submitted: float = field(default_factory=time.monotonic)
+    # absolute wall-clock deadline (serve's propagated budget): the
+    # scheduler refuses to admit an expired request and cancels an
+    # active one at the next block boundary, reclaiming its slot
+    deadline_ts: Optional[float] = None
     admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
     prefill_device_s: float = 0.0           # block_until_ready-bounded
@@ -203,7 +207,8 @@ class LLMEngine:
                        eos_id: Optional[int] = None,
                        top_p: float = 1.0, top_k: int = 0,
                        stop: Optional[Sequence[Sequence[int]]] = None,
-                       prefilled: Optional[dict] = None) -> dict:
+                       prefilled: Optional[dict] = None,
+                       deadline_ts: Optional[float] = None) -> dict:
         """``prefilled`` skips the in-engine prompt forward pass: it is
         the KV payload a remote PrefillEngine computed for these tokens
         (prefill/decode disaggregation, ray_tpu/llm/pd.py; reference:
@@ -211,10 +216,13 @@ class LLMEngine:
         via NIXL there, via the object plane here). ``top_p``/``top_k``
         filter the on-device sampler (1.0/0 disable); ``stop`` is a list
         of token-id sequences that end generation (matched suffix
-        trimmed from the result)."""
+        trimmed from the result). ``deadline_ts`` (absolute wall clock,
+        serve's propagated budget) cancels the request — and frees its
+        decode slot for waiting requests — the moment the budget is
+        spent, raising serve.DeadlineExceeded."""
         r = self._submit(tokens, max_new_tokens, temperature, eos_id,
                          top_p=top_p, top_k=top_k, stop=stop,
-                         prefilled=prefilled)
+                         prefilled=prefilled, deadline_ts=deadline_ts)
         r.fut = asyncio.get_running_loop().create_future()
         await r.fut
         return self._result(r)
@@ -225,7 +233,8 @@ class LLMEngine:
                               eos_id: Optional[int] = None,
                               top_p: float = 1.0, top_k: int = 0,
                               stop: Optional[Sequence[Sequence[int]]] = None,
-                              prefilled: Optional[dict] = None):
+                              prefilled: Optional[dict] = None,
+                              deadline_ts: Optional[float] = None):
         """Async generator of token ids as they are produced. NOTE:
         tokens belonging to a stop sequence may already have been
         yielded by the time the match completes — streaming consumers
@@ -233,7 +242,7 @@ class LLMEngine:
         always trimmed)."""
         r = self._submit(tokens, max_new_tokens, temperature, eos_id,
                          top_p=top_p, top_k=top_k, stop=stop,
-                         prefilled=prefilled)
+                         prefilled=prefilled, deadline_ts=deadline_ts)
         r.stream = asyncio.Queue()
         while True:
             t = await r.stream.get()
@@ -251,9 +260,15 @@ class LLMEngine:
         return self.generate_stream(tokens, prefilled=prefilled, **kw)
 
     def _submit(self, tokens, max_new_tokens, temperature, eos_id,
-                top_p=1.0, top_k=0, stop=None, prefilled=None):
+                top_p=1.0, top_k=0, stop=None, prefilled=None,
+                deadline_ts=None):
         if self._stopped:
             raise RuntimeError("engine is stopped")
+        if deadline_ts is not None and time.time() > deadline_ts:
+            # spent before submission: fail NOW — don't occupy queue
+            # space the scheduler would only throw away later
+            from ray_tpu.serve.fault import DeadlineExceeded
+            raise DeadlineExceeded("budget spent before submission")
         tokens = list(map(int, tokens))
         if not tokens:
             raise ValueError("empty prompt")
@@ -289,7 +304,7 @@ class LLMEngine:
                     "(prefill/decode bucket configs disagree)")
         r = _Request(tokens, max_new_tokens, temperature, eos_id,
                      top_p=float(top_p), top_k=int(top_k), stop=stop,
-                     prefilled=prefilled)
+                     prefilled=prefilled, deadline_ts=deadline_ts)
         self._waiting.put_nowait(r)
         self._requests += 1
         self._ensure_loop()
@@ -326,12 +341,24 @@ class LLMEngine:
         try:
             while not self._stopped:
                 # 1) admit waiting requests into free slots (prefill) —
-                #    BEFORE the decode step, for low TTFT.
+                #    BEFORE the decode step, for low TTFT. Requests
+                #    whose deadline passed while queued fail fast here:
+                #    prefilling them would spend device time the client
+                #    already gave up on.
                 for slot in range(self.max_slots):
-                    if self._slots[slot] is not None or \
-                            self._waiting.empty():
+                    if self._slots[slot] is not None:
                         continue
-                    r = self._waiting.get_nowait()
+                    r = None
+                    while not self._waiting.empty():
+                        cand = self._waiting.get_nowait()
+                        if cand.deadline_ts is not None and \
+                                time.time() > cand.deadline_ts:
+                            self._expire(cand, None)
+                            continue
+                        r = cand
+                        break
+                    if r is None:
+                        continue
                     try:
                         tok = await loop.run_in_executor(
                             None, self._admit_sync, slot, r)
@@ -343,6 +370,15 @@ class LLMEngine:
                         self._fail(r, None, e)
                         continue
                     self._emit_token(r, tok, slot)
+                # deadline-cancel active slots at the block boundary:
+                # the slot is reclaimed NOW (the next admit pass refills
+                # it) instead of decoding to max_new_tokens for a client
+                # whose budget is spent
+                now = time.time()
+                for i, r in enumerate(self._slots):
+                    if r is not None and r.deadline_ts is not None \
+                            and now > r.deadline_ts:
+                        self._expire(r, i)
                 active = [i for i, r in enumerate(self._slots)
                           if r is not None]
                 if not active:
@@ -594,8 +630,22 @@ class LLMEngine:
         if r.fut is not None and not r.fut.done():
             r.fut.set_result(True)
 
+    def _expire(self, r: _Request, slot: Optional[int]):
+        """Cancel one request whose deadline budget is spent (queued or
+        mid-generation); its slot — if it held one — is reclaimed for
+        the next admit pass."""
+        from ray_tpu.serve.fault import DeadlineExceeded, fault_metrics
+        fault_metrics()["deadline"].inc(tags={"where": "engine"})
+        self._fail(r, slot, DeadlineExceeded(
+            f"generation cancelled at the deadline after "
+            f"{len(r.out)} token(s)"))
+
     def _fail(self, r: _Request, slot: Optional[int], e: BaseException):
-        err = RuntimeError(f"llm engine failed: {e}")
+        from ray_tpu.serve.fault import DeadlineExceeded
+        # deadline cancellations cross the serve boundary TYPED so the
+        # proxy can answer 504 instead of a generic 500
+        err = e if isinstance(e, DeadlineExceeded) else RuntimeError(
+            f"llm engine failed: {e}")
         if slot is not None and self._slots[slot] is r:
             self._slots[slot] = None
         if r.stream is not None:

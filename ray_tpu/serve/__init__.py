@@ -12,11 +12,14 @@ from ray_tpu.serve.api import (Application, Deployment, delete, deployment,
                                get_deployment_handle, proxy_address, run,
                                shutdown, status)
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.fault import (DeadlineExceeded, ReplicaDraining,
+                                 current_deadline_ts)
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
-    "Application", "Deployment", "DeploymentHandle", "batch", "delete",
+    "Application", "DeadlineExceeded", "Deployment", "DeploymentHandle",
+    "ReplicaDraining", "batch", "current_deadline_ts", "delete",
     "deployment", "get_deployment_handle", "get_multiplexed_model_id",
     "multiplexed", "proxy_address", "run", "shutdown", "status",
 ]

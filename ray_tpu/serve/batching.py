@@ -32,7 +32,17 @@ class _BatchQueue:
             self._do_flush()
         elif self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.ensure_future(self._delayed_flush())
-        return await fut
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            # deadline-cancelled caller (replica wait_for): pull the
+            # item back out so the batch doesn't spend model compute on
+            # a request nobody is waiting for
+            for i, (a, f) in enumerate(self.items):
+                if f is fut:
+                    del self.items[i]
+                    break
+            raise
 
     async def _delayed_flush(self):
         await asyncio.sleep(self.timeout)
@@ -47,6 +57,12 @@ class _BatchQueue:
             asyncio.ensure_future(self._run_batch(batch))
 
     async def _run_batch(self, batch: List[tuple]):
+        # drop entries whose waiter is already gone (deadline-cancelled
+        # between enqueue and flush): their batch slots are reclaimed
+        # for live requests instead of computing discarded results
+        batch = [(a, f) for a, f in batch if not f.done()]
+        if not batch:
+            return
         args = [a for a, _ in batch]
         futs = [f for _, f in batch]
         try:

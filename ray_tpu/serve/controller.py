@@ -31,17 +31,24 @@ HEALTH_CHECK_TIMEOUT_S = 10.0
 
 class _ReplicaInfo:
     __slots__ = ("actor_id", "state", "name", "started_at",
-                 "last_healthy", "ongoing", "model_ids", "bundle_index")
+                 "last_healthy", "ongoing", "model_ids", "bundle_index",
+                 "drain_started", "drain_notified", "drain_poll_fails")
 
     def __init__(self, actor_id: ActorID, name: str):
         self.actor_id = actor_id
         self.name = name
-        self.state = "STARTING"          # STARTING | RUNNING | STOPPING
+        # STARTING | RUNNING | DRAINING | STOPPING — DRAINING replicas
+        # (scale-down / redeploy) are out of the routing table, reject
+        # new requests, and finish their in-flight ones before stop
+        self.state = "STARTING"
         self.started_at = time.time()
         self.last_healthy = time.time()
         self.ongoing = 0
         self.model_ids: List[str] = []   # multiplexed models loaded here
         self.bundle_index: Optional[int] = None   # gang PG slot
+        self.drain_started = 0.0
+        self.drain_notified = False
+        self.drain_poll_fails = 0
 
 
 class _DeploymentState:
@@ -71,6 +78,19 @@ class _DeploymentState:
 
     def running(self) -> List[_ReplicaInfo]:
         return [r for r in self.replicas.values() if r.state == "RUNNING"]
+
+    def retire(self, r: _ReplicaInfo) -> None:
+        """Take one replica out of service: RUNNING non-gang replicas
+        DRAIN (finish in-flight, reject new, stop when empty); anything
+        else — STARTING, gang members (all-or-nothing groups can't
+        shrink one at a time), already-draining — stops hard."""
+        if r.state == "RUNNING" and not self.spec.get("gang"):
+            r.state = "DRAINING"
+            r.drain_started = time.time()
+            r.drain_notified = False
+        elif r.state != "DRAINING":
+            r.state = "STOPPING"
+        self.version += 1
 
 
 class ServeController:
@@ -259,13 +279,14 @@ class ServeController:
             if existing is None:
                 self.deployments[name] = _DeploymentState(name, spec)
             else:
-                # In-place upgrade: replace spec; replicas are replaced by
-                # the reconcile loop (stop-all-then-start keeps it simple
-                # and matches restart-on-upgrade semantics).
+                # In-place upgrade: replace spec; old replicas DRAIN
+                # (finish in-flight requests, take no new ones) while
+                # the reconcile loop starts their replacements — a
+                # redeploy is not allowed to abort live requests.
                 existing.spec = spec
                 existing.target = existing._initial_target()
                 for r in existing.replicas.values():
-                    r.state = "STOPPING"
+                    existing.retire(r)
                 existing.version += 1
                 # a gang PG reflects the OLD spec's size/resources:
                 # release it and let the reconcile loop re-reserve. The
@@ -277,11 +298,12 @@ class ServeController:
                     asyncio.ensure_future(self._remove_pg(existing.pg_id))
                 existing.pg_id = None
                 existing.pg_error = None
-        # Deployments removed from the app spec are torn down.
+        # Deployments removed from the app spec are torn down (drained
+        # first — removal must not abort in-flight requests either).
         for old in self.apps.get(app_name, []):
             if old not in names and old in self.deployments:
                 for r in self.deployments[old].replicas.values():
-                    r.state = "STOPPING"
+                    self.deployments[old].retire(r)
                 self.deployments[old].target = 0
                 self.deployments[old].spec["_deleted"] = True
         self.apps[app_name] = names
@@ -301,7 +323,7 @@ class ServeController:
                 dep.target = 0
                 dep.spec["_deleted"] = True
                 for r in dep.replicas.values():
-                    r.state = "STOPPING"
+                    dep.retire(r)
         await self._persist_apps()
         return True
 
@@ -332,7 +354,11 @@ class ServeController:
         running = dep.running()
         return {"replicas": [r.actor_id.binary() for r in running],
                 "model_ids": [list(r.model_ids) for r in running],
-                "version": dep.version}
+                "version": dep.version,
+                # per-replica concurrency: the proxy's admission
+                # control derives live capacity from it
+                "max_ongoing": int(
+                    dep.spec.get("max_ongoing_requests", 16))}
 
     async def report_model_ids(self, deployment_name: str,
                                replica_id: str, ids: list) -> bool:
@@ -443,6 +469,38 @@ class ServeController:
                 del self.deployments[name]
 
     async def _converge(self, dep: _DeploymentState):
+        # 0. graceful drain: notify once, then wait for in-flight
+        #    requests (incl. streams) to finish — bounded by
+        #    serve_drain_timeout_s — before the replica stops. DRAINING
+        #    replicas left the routing table at retire() time.
+        drain_timeout = float(getattr(
+            api._g.ctx.config, "serve_drain_timeout_s", 30.0))
+        for rid in list(dep.replicas):
+            r = dep.replicas[rid]
+            if r.state != "DRAINING":
+                continue
+            ongoing = None
+            try:
+                if not r.drain_notified:
+                    await self._acall(r.actor_id, "set_draining", True,
+                                      timeout=5.0)
+                    r.drain_notified = True
+                m = await self._acall(r.actor_id, "metrics", timeout=5.0)
+                ongoing = int(m["ongoing"])
+                r.drain_poll_fails = 0
+            except Exception:
+                # ONE transient RPC failure (busy loop, control hiccup)
+                # must not hard-stop a replica with live requests —
+                # only a consistently unreachable replica is dead
+                r.drain_poll_fails += 1
+            waited = time.time() - r.drain_started
+            if (ongoing == 0 and r.drain_notified) or \
+                    r.drain_poll_fails >= 3 or \
+                    waited > drain_timeout:
+                from ray_tpu.serve.fault import fault_metrics
+                fault_metrics()["drain_wait"].observe(
+                    waited, tags={"deployment": dep.name})
+                r.state = "STOPPING"
         # 1. reap STOPPING replicas
         for rid in list(dep.replicas):
             r = dep.replicas[rid]
@@ -518,12 +576,13 @@ class ServeController:
         # never cause a healthy replica to be stopped in its place.
         excess_n = len(alive) - dep.target
         if excess_n > 0:
-            # stop the youngest excess replicas (oldest keep serving)
+            # retire the youngest excess replicas (oldest keep
+            # serving); RUNNING ones drain — an autoscale-down must
+            # not abort the in-flight requests that triggered it
             excess = sorted(alive,
                             key=lambda r: r.started_at)[-excess_n:]
             for r in excess:
-                r.state = "STOPPING"
-                dep.version += 1
+                dep.retire(r)
 
     @staticmethod
     def _replica_resources(spec: dict) -> dict:

@@ -104,10 +104,15 @@ class _LLMServer:
                        eos_id: Optional[int] = None,
                        top_p: float = 1.0, top_k: int = 0,
                        stop=None) -> dict:
+        # the serve-propagated deadline (replica bound it to this
+        # request's context) rides into the engine, which cancels the
+        # generation — and frees its batch slot — when the budget ends
+        from ray_tpu.serve.fault import current_deadline_ts
         return await self.engine.generate(
             tokens, max_new_tokens=max_new_tokens,
             temperature=temperature, eos_id=eos_id,
-            top_p=top_p, top_k=top_k, stop=stop)
+            top_p=top_p, top_k=top_k, stop=stop,
+            deadline_ts=current_deadline_ts())
 
     # --- streaming (push-based core streaming generator) --------------
     # Tokens flow replica -> caller through num_returns="streaming"
@@ -118,9 +123,11 @@ class _LLMServer:
     async def generate_stream(self, tokens, max_new_tokens: int = 64,
                               temperature: float = 0.0,
                               eos_id: Optional[int] = None):
+        from ray_tpu.serve.fault import current_deadline_ts
         async for tok in self.engine.generate_stream(
                 tokens, max_new_tokens=max_new_tokens,
-                temperature=temperature, eos_id=eos_id):
+                temperature=temperature, eos_id=eos_id,
+                deadline_ts=current_deadline_ts()):
             yield int(tok)
 
     async def stats(self) -> dict:

@@ -13,22 +13,135 @@ transfer-encoded request bodies, Expect: 100-continue, bounded header/
 body sizes (431/413), and malformed-request 400s. HTTP/2 and gRPC
 ingress are out of scope by design (the image carries no h2/grpc deps;
 the reference gets both from uvicorn/grpcio).
+
+Fault tolerance (serve/fault.py): each request gets ONE deadline
+budget (X-Request-Deadline header, default
+Config.serve_default_deadline_s) spent across admission queueing,
+routing, retries, and the replica call — 504 when it runs out, with
+downstream work cancelled. Per-deployment admission control sheds
+overload with fast 503 + Retry-After once the bounded queue is full or
+the predicted queue wait exceeds the budget (_Admission). Route
+refreshes and reroutes retry under a budgeted jittered-backoff policy
+instead of one-shot immediate retries and fixed 120 s timeouts.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import math
 import time
+from collections import deque
 from typing import Dict, Optional, Tuple
 
 from ray_tpu import api
+from ray_tpu.serve import fault
 
 
 class _BadRequest(Exception):
     def __init__(self, msg: str, code: int = 400):
         super().__init__(msg)
         self.code = code
+
+
+class _Shed(Exception):
+    """Admission control rejected the request: fast 503 + Retry-After
+    instead of parking it until its (possibly 120 s) deadline."""
+
+    def __init__(self, msg: str, retry_after_s: float):
+        super().__init__(msg)
+        self.retry_after_s = max(1.0, retry_after_s)
+
+
+def _cfg():
+    ctx = getattr(api._g, "ctx", None)
+    if ctx is not None:
+        return ctx.config
+    from ray_tpu.config import get_config
+    return get_config()
+
+
+class _Admission:
+    """Per-deployment admission control + backpressure in the proxy.
+
+    Requests within live capacity (running replicas x per-replica
+    max_ongoing_requests, read off the handle router's table) dispatch
+    immediately; the rest wait in a BOUNDED queue. A request is shed
+    (503 + Retry-After) when the queue is full, when its predicted
+    queue wait (EWMA service time) exceeds its remaining deadline
+    budget, or when its budget runs out while queued — overload
+    produces fast, retryable rejections instead of a cliff of slow
+    timeouts (reference capability: serve's max_queued_requests +
+    backoff; the SLO-aware shed is the deadline-propagation dividend).
+    """
+
+    def __init__(self, deployment: str):
+        self.deployment = deployment
+        self.inflight = 0
+        self.waiters: deque = deque()      # asyncio futures, FIFO
+        self.ewma_s = 0.1                  # smoothed per-call service time
+
+    def observe_service(self, seconds: float) -> None:
+        self.ewma_s += 0.2 * (seconds - self.ewma_s)
+
+    def _capacity(self) -> int:
+        from ray_tpu.serve.handle import _router_for
+        cap = _router_for(self.deployment).capacity()
+        if not cap:
+            # table not fetched yet (first request) or zero replicas
+            # mid-rescale: stay optimistic — the bounded queue still
+            # protects the proxy, and the next refresh corrects it
+            return max(self.inflight + 1, 16)
+        return cap
+
+    def predicted_wait_s(self, queue_len: int) -> float:
+        cap = self._capacity()
+        return (queue_len + 1) * self.ewma_s / max(1, cap)
+
+    async def acquire(self, deadline_ts: Optional[float]) -> float:
+        """Admit or raise _Shed; returns seconds spent queued."""
+        cap = self._capacity()
+        if self.inflight < cap and not self.waiters:
+            self.inflight += 1
+            return 0.0
+        limit = int(getattr(_cfg(), "serve_queue_limit", 128))
+        if len(self.waiters) >= limit:
+            raise _Shed(
+                f"{self.deployment}: queue full "
+                f"({len(self.waiters)}/{limit})",
+                self.predicted_wait_s(len(self.waiters)))
+        rem = fault.remaining_s(deadline_ts)
+        est = self.predicted_wait_s(len(self.waiters))
+        if rem is not None and est > rem:
+            raise _Shed(
+                f"{self.deployment}: predicted queue wait {est:.2f}s "
+                f"exceeds remaining deadline {rem:.2f}s", est)
+        fut = asyncio.get_running_loop().create_future()
+        self.waiters.append(fut)
+        t0 = time.monotonic()
+        try:
+            await asyncio.wait_for(fut, rem)
+        except asyncio.TimeoutError:
+            # budget spent while queued: shed (wait_for cancelled fut,
+            # so release() skips it; remove eagerly to free the depth)
+            try:
+                self.waiters.remove(fut)
+            except ValueError:
+                pass
+            raise _Shed(
+                f"{self.deployment}: queue wait exceeded the deadline "
+                f"budget", self.predicted_wait_s(len(self.waiters)))
+        return time.monotonic() - t0
+
+    def release(self) -> None:
+        """Finish one in-flight request: hand the slot to the oldest
+        live waiter (inflight count transfers), else decrement."""
+        while self.waiters:
+            fut = self.waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                return
+        self.inflight = max(0, self.inflight - 1)
 
 
 def proxy_metrics() -> dict:
@@ -56,7 +169,17 @@ class HTTPProxy:
         self._routes_fetched = 0.0
         self._requests = 0
         self._errors = 0
+        self._shed = 0
         self._m = proxy_metrics()
+        self._fm = fault.fault_metrics()
+        self._adm: Dict[str, _Admission] = {}
+
+    def _admission(self, dep: str) -> _Admission:
+        a = self._adm.get(dep)
+        if a is None:
+            a = _Admission(dep)
+            self._adm[dep] = a
+        return a
 
     async def start(self, host: str = "127.0.0.1", port: int = 8000) -> dict:
         self._server = await asyncio.start_server(self._on_conn, host, port)
@@ -67,11 +190,12 @@ class HTTPProxy:
         return "ok"
 
     async def metrics(self) -> dict:
-        return {"requests": self._requests, "errors": self._errors}
+        return {"requests": self._requests, "errors": self._errors,
+                "shed": self._shed}
 
     # -- routing table -----------------------------------------------------
 
-    async def _refresh_routes(self):
+    async def _refresh_routes(self, deadline_ts: Optional[float] = None):
         if time.monotonic() - self._routes_fetched < 1.0 and self._routes:
             return
         from ray_tpu.serve.handle import CONTROLLER_NAME, SERVE_NAMESPACE
@@ -81,18 +205,23 @@ class HTTPProxy:
                                    namespace=SERVE_NAMESPACE)
         if not info or info.get("state") == "DEAD":
             return
-        for attempt in (0, 1):
-            try:
-                refs = await ctx.submit_actor_call(
-                    info["actor_id"], "get_ingress_routes", (), {})
-                self._routes = await ctx.get(refs[0], 10.0)
-                break
-            except Exception:
-                # one immediate retry: a crashed-and-restarted
-                # controller leaves a stale actor address in this
-                # worker's cache, and the failure just invalidated it
-                if attempt:
-                    raise
+
+        async def _fetch():
+            # each attempt spends from the request's deadline (a
+            # crashed-and-restarted controller leaves a stale actor
+            # address one call deep; the first failure invalidates it)
+            rem = fault.remaining_s(deadline_ts)
+            if rem is not None and rem <= 0:
+                raise fault.DeadlineExceeded("route refresh")
+            refs = await ctx.submit_actor_call(
+                info["actor_id"], "get_ingress_routes", (), {})
+            return await ctx.get(
+                refs[0], min(10.0, rem) if rem is not None else 10.0)
+
+        policy = fault.RetryPolicy.from_config("route_refresh", _cfg())
+        self._routes = await policy.run_async(
+            _fetch, deadline_ts,
+            retryable=lambda e: not isinstance(e, fault.DeadlineExceeded))
         self._routes_fetched = time.monotonic()
 
     def _match(self, path: str) -> Optional[str]:
@@ -231,13 +360,58 @@ class HTTPProxy:
             if crlf not in (b"\r\n", b"\n"):
                 raise _BadRequest("bad chunk terminator")
 
+    def _deadline_from_headers(self, headers) -> float:
+        """Absolute wall-clock deadline for this request: the client's
+        X-Request-Deadline budget (seconds), else the configured
+        default. Every downstream stage — queueing, routing, retries,
+        the replica call, the engine — spends from this ONE budget."""
+        raw = headers.get("x-request-deadline")
+        if raw is None:
+            budget = float(getattr(_cfg(), "serve_default_deadline_s",
+                                   120.0))
+        else:
+            try:
+                budget = float(raw)
+            except ValueError:
+                raise _BadRequest(f"bad X-Request-Deadline: {raw!r}")
+            if budget <= 0:
+                raise _BadRequest(
+                    f"X-Request-Deadline must be > 0, got {budget}")
+        return time.time() + budget
+
+    def _error_response(self, writer, e: BaseException,
+                        deadline_ts: float, where: str):
+        """Map a dispatch failure to HTTP: shed -> 503 + Retry-After,
+        spent budget -> 504, anything else -> 500."""
+        self._errors += 1
+        if isinstance(e, _Shed):
+            self._shed += 1
+            return self._respond(
+                writer, 503, {"error": f"overloaded: {e}"},
+                headers={"Retry-After":
+                         str(int(math.ceil(e.retry_after_s)))})
+        kind = fault.classify_error(e)
+        rem = fault.remaining_s(deadline_ts)
+        if kind == "deadline" or \
+                (kind == "timeout" and rem is not None and rem <= 0.05):
+            self._fm["deadline"].inc(tags={"where": where})
+            return self._respond(writer, 504,
+                                 {"error": f"deadline exceeded: {e}"})
+        return self._respond(writer, 500,
+                             {"error": f"{type(e).__name__}: {e}"})
+
     async def _dispatch(self, writer, method, path, headers, body):
         self._requests += 1
         t_arrive = time.monotonic()
         if path == "/-/healthz":
             return self._respond(writer, 200, {"status": "ok"})
         try:
-            await self._refresh_routes()
+            deadline_ts = self._deadline_from_headers(headers)
+        except _BadRequest as e:
+            self._errors += 1
+            return self._respond(writer, e.code, {"error": str(e)})
+        try:
+            await self._refresh_routes(deadline_ts)
         except Exception as e:
             # A refresh can fail transiently (controller just crashed
             # and restarted; its old address is still cached one call
@@ -248,9 +422,9 @@ class HTTPProxy:
                 self._errors += 1
                 return self._respond(
                     writer, 500, {"error": f"route refresh: {e}"})
-            # stamp NOW: stale routes keep serving and the (expensive,
-            # up-to-10s) failing refresh re-runs at most once per
-            # second, not on every request during a controller outage
+            # stamp NOW: stale routes keep serving and the (expensive)
+            # failing refresh re-runs at most once per second, not on
+            # every request during a controller outage
             self._routes_fetched = time.monotonic()
         if path == "/-/routes":
             return self._respond(writer, 200, {"routes": self._routes})
@@ -266,46 +440,91 @@ class HTTPProxy:
             arg = body
         else:
             arg = None
-        if "text/event-stream" in headers.get("accept", ""):
-            # SSE token streaming (reference: serve streams LLM responses
-            # over HTTP; here the proxy drives the replica's cursor-poll
-            # protocol and emits one `data:` event per token)
-            return await self._dispatch_stream(writer, dep, arg,
-                                               t_arrive)
-        loop = asyncio.get_running_loop()
         tags = {"deployment": dep}
+        adm = self._admission(dep)
         try:
-            # Handle routing + submission is the sync caller API — run it on
-            # a thread; await the result object on this loop.
-            from ray_tpu.serve.handle import DeploymentHandle
-            h = DeploymentHandle(dep)
-            ref = await loop.run_in_executor(
-                None, lambda: h.remote(arg) if arg is not None
-                else h.remote())
-            t_sent = time.monotonic()
-            # queue: parse + routing + submission; handler: replica time
-            self._m["queue"].observe(t_sent - t_arrive, tags)
+            await adm.acquire(deadline_ts)
+        except _Shed as e:
+            self._fm["shed"].inc(tags=tags)
+            return self._error_response(writer, e, deadline_ts,
+                                        "proxy")
+        try:
+            if "text/event-stream" in headers.get("accept", ""):
+                # SSE token streaming (reference: serve streams LLM
+                # responses over HTTP; the stream rides the core
+                # streaming-return path, one `data:` event per token)
+                return await self._dispatch_stream(
+                    writer, dep, arg, t_arrive, deadline_ts)
+            return await self._dispatch_unary(
+                writer, dep, arg, t_arrive, deadline_ts, tags)
+        finally:
+            adm.release()
+
+    async def _dispatch_unary(self, writer, dep, arg, t_arrive,
+                              deadline_ts, tags):
+        loop = asyncio.get_running_loop()
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        # A DRAINING replica rejects before starting (the request never
+        # ran), so rerouting it once is always safe; any other failure
+        # surfaces — the handle layer already did budgeted rerouting
+        # for submissions that failed to send.
+        for attempt in (0, 1):
+            t_sent = None
             try:
-                result = await api.get_async(ref, timeout=120.0)
-            finally:
-                # failures and 120s timeouts are the tail the histogram
-                # exists to show — record them, then surface the error
-                self._m["handler"].observe(
-                    time.monotonic() - t_sent, tags)
-        except BaseException as e:  # noqa: BLE001
-            self._errors += 1
-            return self._respond(writer, 500,
-                                 {"error": f"{type(e).__name__}: {e}"})
-        self._respond(writer, 200, result)
+                # Handle routing + submission is the sync caller API —
+                # run it on a thread; await the result on this loop.
+                h = DeploymentHandle(dep, _deadline_ts=deadline_ts)
+                ref = await loop.run_in_executor(
+                    None, lambda: h.remote(arg) if arg is not None
+                    else h.remote())
+                t_sent = time.monotonic()
+                # queue: parse+admission+routing; handler: replica
+                # time. One sample per REQUEST: the draining retry's
+                # second pass would otherwise re-observe a span that
+                # contains attempt 0's whole replica round-trip
+                if attempt == 0:
+                    self._m["queue"].observe(t_sent - t_arrive, tags)
+                rem = fault.remaining_s(deadline_ts)
+                if rem is None or rem <= 0:
+                    raise fault.DeadlineExceeded(
+                        "budget spent before the replica call")
+                try:
+                    result = await api.get_async(ref, timeout=rem)
+                finally:
+                    # failures and deadline timeouts are the tail the
+                    # histogram exists to show — record, then surface
+                    dt = time.monotonic() - t_sent
+                    self._m["handler"].observe(dt, tags)
+                    self._admission(dep).observe_service(dt)
+            except BaseException as e:  # noqa: BLE001
+                if attempt == 0 and \
+                        fault.classify_error(e) == "draining" and \
+                        (fault.remaining_s(deadline_ts) or 0) > 0:
+                    # invalidate the route cache: the retry must see a
+                    # fresh table (the controller already dropped the
+                    # draining replica from it — a <=0.5s-old cached
+                    # copy could re-pick the same replica)
+                    from ray_tpu.serve.handle import _router_for
+                    _router_for(dep).fetched_at = 0.0
+                    self._fm["retries"].inc(tags={"reason": "draining"})
+                    continue
+                return self._error_response(writer, e, deadline_ts,
+                                            "proxy")
+            return self._respond(writer, 200, result)
 
     async def _dispatch_stream(self, writer, dep: str, arg,
-                               t_arrive: Optional[float] = None) -> str:
+                               t_arrive: Optional[float] = None,
+                               deadline_ts: Optional[float] = None) -> str:
         """Server-sent events over the core streaming-return path: one
         streaming call on the deployment's generate_stream generator;
         each produced token is pushed replica -> proxy through the
         object plane and written as a `data:` event (no polling RPCs —
         reference: serve streams LLM responses push-based the same way).
-        Returns "close" — an SSE response ends with the connection."""
+        The request deadline bounds the WHOLE stream: each token wait
+        spends the remaining budget, and the replica/engine cancels its
+        side when the budget runs out. Returns "close" — an SSE
+        response ends with the connection."""
         from ray_tpu.serve.handle import DeploymentHandle
         loop = asyncio.get_running_loop()
         if arg is not None and not isinstance(arg, dict):
@@ -322,16 +541,13 @@ class HTTPProxy:
                           {"error": "stream request needs 'tokens'"})
             return "close"
         try:
-            h = DeploymentHandle(dep)
+            h = DeploymentHandle(dep, _deadline_ts=deadline_ts)
             # submission is the sync caller API — keep it off the loop
             gen = await loop.run_in_executor(
                 None, lambda: h.options(
                     stream=True).generate_stream.remote(tokens, **kw))
         except BaseException as e:  # noqa: BLE001
-            self._errors += 1
-            self._respond(writer, 500,
-                          {"error": f"{type(e).__name__}: {e}"})
-            return "close"
+            return self._error_response(writer, e, deadline_ts, "proxy")
         tags = {"deployment": dep}
         t_sent = time.monotonic()
         self._m["queue"].observe(t_sent - (t_arrive or t_sent), tags)
@@ -341,7 +557,11 @@ class HTTPProxy:
                      b"Connection: close\r\n\r\n")
         try:
             async for ref in gen:
-                t = await api.get_async(ref, timeout=120.0)
+                rem = fault.remaining_s(deadline_ts)
+                if rem is not None and rem <= 0:
+                    raise fault.DeadlineExceeded("mid-stream")
+                t = await api.get_async(
+                    ref, timeout=rem if rem is not None else 120.0)
                 await api._g.ctx.free([ref])  # long-lived proxy process
                 writer.write(
                     f"data: {json.dumps({'token': t})}\n\n".encode())
@@ -354,6 +574,11 @@ class HTTPProxy:
             # surface the failure as the protocol's error frame instead of
             # killing the connection handler with an unhandled exception
             self._errors += 1
+            kind = fault.classify_error(e)
+            if kind == "deadline" or (kind == "timeout" and
+                                      deadline_ts is not None):
+                self._fm["deadline"].inc(tags={"where": "proxy"})
+            gen.close()     # budget spent: stop the replica's stream
             try:
                 writer.write(
                     b"event: error\ndata: "
@@ -364,15 +589,20 @@ class HTTPProxy:
             except (ConnectionResetError, BrokenPipeError):
                 pass
         finally:
-            # a stream's handler span covers the whole generation
+            # a stream's handler span covers the whole generation —
+            # recorded in the histogram but NOT fed to the admission
+            # EWMA (a 60s generation would poison the per-call queue-
+            # wait prediction unary sheds are computed from)
             self._m["handler"].observe(time.monotonic() - t_sent, tags)
         return "close"
 
-    def _respond(self, writer, code: int, payload, close: bool = False):
+    def _respond(self, writer, code: int, payload, close: bool = False,
+                 headers: Optional[Dict[str, str]] = None):
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
                   413: "Payload Too Large",
                   431: "Request Header Fields Too Large",
-                  500: "Internal Server Error"}
+                  500: "Internal Server Error",
+                  503: "Service Unavailable", 504: "Gateway Timeout"}
         if isinstance(payload, (bytes, bytearray)):
             body = bytes(payload)
             ctype = "application/octet-stream"
@@ -382,8 +612,10 @@ class HTTPProxy:
             body = json.dumps(payload).encode()
             ctype = "application/json"
         conn = "Connection: close\r\n" if close else ""
+        extra = "".join(f"{k}: {v}\r\n"
+                        for k, v in (headers or {}).items())
         head = (f"HTTP/1.1 {code} {reason.get(code, 'OK')}\r\n"
                 f"Content-Type: {ctype}\r\n"
-                f"Content-Length: {len(body)}\r\n{conn}"
+                f"Content-Length: {len(body)}\r\n{conn}{extra}"
                 f"\r\n").encode()
         writer.write(head + body)

@@ -17,6 +17,9 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
+from ray_tpu.serve import fault
+from ray_tpu.serve.chaos import apply_async as _chaos_apply, chaos_fire
+
 
 def replica_metrics() -> dict:
     """Get-or-create the replica-side request-phase histograms: queue
@@ -57,8 +60,10 @@ class Replica:
         self._ongoing = 0
         self._processed = 0
         self._errors = 0
+        self._draining = False
         self._started_at = time.time()
         self._m = replica_metrics()
+        self._fm = fault.fault_metrics()
         # multiplexed-model loaders push loaded-set changes to the
         # controller so handles can route model-affine (serve/multiplex.py);
         # classes that reject new attributes (__slots__ etc.) just serve
@@ -75,15 +80,39 @@ class Replica:
 
     # -- data path ---------------------------------------------------------
 
+    async def _admit(self, meta: Optional[dict]):
+        """Entry gate shared by the unary and streaming paths: serve
+        chaos (replica->engine boundary), drain rejection, and the
+        deadline pre-check + context bind. Returns the deadline reset
+        token (the deadline itself rides fault.current_deadline_ts())."""
+        await _chaos_apply(chaos_fire("replica"), "replica")
+        if self._draining:
+            # reject BEFORE any user code: the caller can reroute this
+            # safely because nothing started here
+            raise fault.ReplicaDraining(
+                f"replica {self.replica_id} of {self.deployment_name} "
+                "is draining")
+        dl = (meta or {}).get("deadline_ts")
+        if dl is not None and time.time() > dl:
+            self._fm["deadline"].inc(tags={"where": "replica"})
+            raise fault.DeadlineExceeded(
+                f"budget spent before replica {self.replica_id} "
+                "started the request")
+        return fault.set_request_deadline(dl), dl
+
     async def handle_request(self, method: str, args: tuple, kwargs: dict,
                              meta: Optional[dict] = None):
         """Run a user method. Coroutine methods run on the actor's event
         loop (enables @serve.batch coalescing); sync methods run on the
         actor's thread pool via the worker's executor. ``meta`` carries
-        request metadata (currently the multiplexed model id)."""
+        request metadata (the multiplexed model id and the propagated
+        deadline — coroutine methods are cancelled when the deadline
+        passes; sync methods can't be interrupted mid-thread, but read
+        fault.current_deadline_ts() to cooperate)."""
         import contextvars
 
         from ray_tpu.serve.multiplex import _current_model_id
+        dl_token, dl = await self._admit(meta)
         self._ongoing += 1
         t_arrive = time.monotonic()
         tags = {"deployment": self.deployment_name}
@@ -100,7 +129,19 @@ class Replica:
                 t_run = time.monotonic()
                 self._m["queue"].observe(t_run - t_arrive, tags)
                 try:
-                    out = await fn(*args, **kwargs)
+                    if dl is not None:
+                        try:
+                            out = await asyncio.wait_for(
+                                fn(*args, **kwargs),
+                                max(0.001, dl - time.time()))
+                        except asyncio.TimeoutError:
+                            self._fm["deadline"].inc(
+                                tags={"where": "replica"})
+                            raise fault.DeadlineExceeded(
+                                f"{method} cancelled at the deadline "
+                                f"on replica {self.replica_id}")
+                    else:
+                        out = await fn(*args, **kwargs)
                 finally:
                     # errored/timed-out requests are exactly the
                     # latencies worth keeping (the sync path's finally
@@ -129,6 +170,7 @@ class Replica:
             self._errors += 1
             raise
         finally:
+            fault.reset_request_deadline(dl_token)
             if token is not None:
                 _current_model_id.reset(token)
                 n = self._model_active.get(mid, 1) - 1
@@ -145,8 +187,12 @@ class Replica:
         (sync or async) generator; its items are re-yielded, so a
         caller invoking this with num_returns="streaming" receives them
         push-based through the object plane (reference:
-        serve/_private/replica.py streaming call path)."""
+        serve/_private/replica.py streaming call path). The propagated
+        deadline is bound to the request context (the engine cancels at
+        it, reclaiming its slot); the stream itself is cut the moment
+        the budget is spent."""
         from ray_tpu.serve.multiplex import _current_model_id
+        dl_token, dl = await self._admit(meta)
         self._ongoing += 1
         t_run = time.monotonic()
         tags = {"deployment": self.deployment_name}
@@ -159,10 +205,22 @@ class Replica:
             fn = getattr(self.instance, method)
             if inspect.isasyncgenfunction(fn):
                 async for item in fn(*args, **kwargs):
+                    if dl is not None and time.time() > dl:
+                        self._fm["deadline"].inc(
+                            tags={"where": "replica"})
+                        raise fault.DeadlineExceeded(
+                            f"stream {method} cut at the deadline on "
+                            f"replica {self.replica_id}")
                     yield item
             elif inspect.isgeneratorfunction(fn):
                 from ray_tpu.util.aio import drive_sync_gen
                 async for item in drive_sync_gen(fn(*args, **kwargs)):
+                    if dl is not None and time.time() > dl:
+                        self._fm["deadline"].inc(
+                            tags={"where": "replica"})
+                        raise fault.DeadlineExceeded(
+                            f"stream {method} cut at the deadline on "
+                            f"replica {self.replica_id}")
                     yield item
             else:
                 raise TypeError(
@@ -180,6 +238,7 @@ class Replica:
             # a stream's "handler" span covers the whole generation —
             # the stream IS the call
             self._m["handler"].observe(time.monotonic() - t_run, tags)
+            fault.reset_request_deadline(dl_token)
             if token is not None:
                 _current_model_id.reset(token)
                 n = self._model_active.get(mid, 1) - 1
@@ -236,6 +295,15 @@ class Replica:
             self.instance.check_health()
         return "ok"
 
+    def set_draining(self, draining: bool = True) -> int:
+        """Graceful drain (controller-driven on scale-down/redeploy):
+        a DRAINING replica rejects NEW requests with ReplicaDraining
+        (callers reroute — the request never started) while in-flight
+        ones, including streams, run to completion. Returns the current
+        in-flight count so the controller can decide when to stop."""
+        self._draining = bool(draining)
+        return self._ongoing
+
     def metrics(self) -> Dict[str, Any]:
         return {
             "replica_id": self.replica_id,
@@ -243,6 +311,7 @@ class Replica:
             "ongoing": self._ongoing,
             "processed": self._processed,
             "errors": self._errors,
+            "draining": self._draining,
             "uptime_s": time.time() - self._started_at,
         }
 

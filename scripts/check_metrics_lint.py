@@ -83,9 +83,10 @@ def instantiate_all() -> dict:
     take(core._M_TASKS())
     from ray_tpu.llm import engine
     take(engine.engine_metrics())
-    from ray_tpu.serve import proxy, replica
+    from ray_tpu.serve import fault, proxy, replica
     take(proxy.proxy_metrics())
     take(replica.replica_metrics())
+    take(fault.fault_metrics())
     from ray_tpu.dag import ring
     take(ring.allreduce_metrics())
     from ray_tpu.train import zero
@@ -153,6 +154,43 @@ def lint_category_caps() -> list:
         if cat not in events.CATEGORIES)
 
 
+def chaos_knobs() -> list:
+    """Every ``testing_*_failure`` deterministic-fault-injection knob in
+    ray_tpu/config.py Config (rpc, channel, serve, ...)."""
+    from dataclasses import fields
+
+    from ray_tpu.config import Config
+    return sorted(f.name for f in fields(Config)
+                  if f.name.startswith("testing_")
+                  and f.name.endswith("_failure"))
+
+
+def lint_chaos_knob_tests(tests_dir: str = None,
+                          knobs: list = None) -> list:
+    """Violations for chaos config knobs no pytest exercises: a fault-
+    injection plane nothing injects through rots silently — the rule
+    (reference: rpc_chaos.h is exercised by its own gtest) is that
+    every ``testing_*_failure`` knob appears in at least one test
+    module (by name or RAY_TPU_* env form)."""
+    if tests_dir is None:
+        tests_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tests")
+    if knobs is None:
+        knobs = chaos_knobs()
+    blob = []
+    for fname in sorted(os.listdir(tests_dir)):
+        if fname.endswith(".py"):
+            with open(os.path.join(tests_dir, fname),
+                      encoding="utf-8", errors="replace") as f:
+                blob.append(f.read())
+    blob = "\n".join(blob)
+    return sorted(
+        f"chaos knob {k!r} (ray_tpu/config.py) has no test exercising "
+        f"it under tests/"
+        for k in knobs
+        if k not in blob and f"RAY_TPU_{k.upper()}" not in blob)
+
+
 def main() -> int:
     instantiate_all()
     from ray_tpu.util import metrics
@@ -160,6 +198,7 @@ def main() -> int:
     found = scan_event_categories()
     errors += lint_event_categories(found)
     errors += lint_category_caps()
+    errors += lint_chaos_knob_tests()
     if errors:
         print(f"{len(errors)} metric/event lint violation(s):")
         for e in errors:

