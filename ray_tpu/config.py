@@ -66,6 +66,24 @@ class Config:
     # latency dominates small frames; above it the root's O(N*S)
     # ingress/egress collapses).
     allreduce_star_max_bytes: int = 4 * 1024 * 1024
+    # Collective auto-tuner (dag/tuner.py): a one-shot in-situ
+    # micro-bench on each tuning-enabled ring (run lazily at the first
+    # collective, cached per ring generation) replaces the static
+    # crossover above — impl (star / flat ring / hierarchical) and
+    # chunk size are picked per payload band from the measured
+    # alpha/beta fit; the static knob stays the fallback for rings
+    # that never probed. The probe costs two tiny fused rounds.
+    collective_tuner: bool = True
+    collective_tuner_probe_bytes: int = 1 << 20   # largest probe round
+    collective_tuner_min_chunk_bytes: int = 64 * 1024
+    # Topology-aware hierarchical collectives (dag/ring.py
+    # HierarchicalReducer): "auto" wires the train gradient sync as a
+    # ring-of-rings (per-node shm intra rings, one TCP ring over node
+    # leaders, intra broadcast) whenever the worker group spans more
+    # than one node with at least one multi-rank node — cross-node
+    # wire traffic drops to ~1/ranks-per-node; "flat" keeps the
+    # one-level ring regardless of topology.
+    collective_hierarchy: str = "auto"
 
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0

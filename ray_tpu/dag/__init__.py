@@ -106,14 +106,21 @@ def allreduce(nodes, op: str = "sum", *, quantize: Optional[str] = None,
 
     ``impl`` defaults to "auto": with a ``payload_bytes`` hint (the
     approximate serialized size of ONE participant's value), the
-    topology is chosen by the measured crossover — star at or below
-    ``Config.allreduce_star_max_bytes`` (default 4 MB: a ring round is
-    3(N-1) sequential hops, and hop latency beats the root's O(N·S)
-    traffic on small frames — ALLREDUCE_BENCH's 1 MB/4p row has the
-    star at 0.8x the ring), ring above it. Without a hint the choice
-    falls back to group size (ring for N>2). Explicit "star"/"ring"
-    always win; ``quantize`` forces the ring (the star has no wire
-    codec).
+    topology is chosen by the in-situ auto-tuner's table when one has
+    been measured (dag/tuner.py), else by the static crossover — star
+    at or below ``Config.allreduce_star_max_bytes`` (default 4 MB: a
+    ring round is 3(N-1) sequential hops, and hop latency beats the
+    root's O(N·S) traffic on small frames — ALLREDUCE_BENCH's
+    1 MB/4p row has the star at 0.8x the ring), ring above it.
+    Without a hint the choice falls back to group size (ring for N>2,
+    hierarchical when the participants additionally span nodes with
+    co-located pairs). Explicit "star"/"ring"/"hier" always win
+    ("hier" degrades to the flat ring when the placement has no
+    two-level topology); ``quantize`` forces a ring family (the star
+    has no wire codec). "hier" compiles the group as a ring-of-rings
+    (per-node shm intra rings + one TCP ring over node leaders +
+    intra broadcast): cross-node wire drops to ~1/ranks-per-node, and
+    codecs apply to the cross-node leg only.
 
     Takes one upstream MethodNode per participant actor; returns one
     AllReduceNode per participant, each carrying the reduced value. The
@@ -127,9 +134,9 @@ def allreduce(nodes, op: str = "sum", *, quantize: Optional[str] = None,
     if quantize not in (None, "int8"):
         raise ValueError(f"quantize must be None or 'int8', "
                          f"got {quantize!r}")
-    if impl not in (None, "auto", "star", "ring"):
-        raise ValueError(f"impl must be None, 'auto', 'star' or "
-                         f"'ring', got {impl!r}")
+    if impl not in (None, "auto", "star", "ring", "hier"):
+        raise ValueError(f"impl must be None, 'auto', 'star', 'ring' "
+                         f"or 'hier', got {impl!r}")
     if impl == "star" and quantize is not None:
         raise ValueError("the star reduce does not support quantize; "
                          "use impl='ring' (or leave impl unset)")
@@ -149,25 +156,48 @@ def allreduce(nodes, op: str = "sum", *, quantize: Optional[str] = None,
     return out
 
 
-def _resolve_impl(group: dict) -> str:
-    """Star vs ring for one collective group, resolved at compile time
-    (the two topologies wire different channels, so the choice cannot
-    move per-round). Explicit impl wins; quantize forces the ring; a
-    payload hint picks by the benchmarked size crossover
-    (Config.allreduce_star_max_bytes); otherwise group size decides."""
+def _resolve_impl(group: dict, hier_ok: bool = False) -> str:
+    """Star vs ring vs ring-of-rings for one collective group, resolved
+    at compile time (the topologies wire different channels, so the
+    choice cannot move per-round). Explicit impl wins; quantize forces
+    a ring family; a payload hint consults the in-situ auto-tuner's
+    table when one exists (dag/tuner.py — populated by any
+    tuning-enabled ring's first collective, or a bench run) and falls
+    back to the static benchmarked crossover
+    (Config.allreduce_star_max_bytes) otherwise; no hint falls back to
+    group size. ``hier_ok`` says the participants actually span nodes
+    with co-located pairs — without that the hierarchical topology
+    does not exist and "hier" degrades to the flat ring."""
     impl = group.get("impl")
     if impl in ("star", "ring"):
         return impl
+    if impl == "hier":
+        return "hier" if hier_ok else "ring"
     if group["size"] < 2:
         return "star"            # a ring needs two ranks to exist
     if group.get("quantize"):
+        # a codec needs a ring; the hierarchy additionally confines it
+        # to the cross-node leg
+        if hier_ok:
+            pb = group.get("payload_bytes")
+            from ray_tpu.dag import tuner
+            t = tuner.choose_impl(pb, group["size"], hierarchical=True)
+            if t == "hier":
+                return "hier"
         return "ring"
     pb = group.get("payload_bytes")
     if pb is not None:
         from ray_tpu.config import get_config
+        from ray_tpu.dag import tuner
+        tuned = tuner.choose_impl(pb, group["size"],
+                                  hierarchical=hier_ok)
+        if tuned is not None:
+            return tuned
         thr = getattr(get_config(), "allreduce_star_max_bytes",
                       4 * 1024 * 1024)
         return "star" if pb <= thr else "ring"
+    if hier_ok and group["size"] > 2:
+        return "hier"
     return "ring" if group["size"] > 2 else "star"
 
 
@@ -377,7 +407,19 @@ class CompiledDag:
         # every other participant sends up / receives the result down.
         for g in self._groups:
             idxs = [idx[id(m.parent)] for m in g["members"]]
-            impl = _resolve_impl(g)
+            # the hierarchical topology exists only when the members
+            # span >1 cluster node AND some node hosts >=2 of them
+            # (otherwise there is no intra ring to save bytes with)
+            plc = [self._node_placement[i] for i in idxs]
+            by_node: Dict[str, list] = {}
+            for r, p in enumerate(plc):
+                by_node.setdefault(p, []).append(r)
+            hier_ok = len(by_node) > 1 and \
+                max(len(v) for v in by_node.values()) > 1
+            impl = _resolve_impl(g, hier_ok=hier_ok)
+            if impl == "hier":
+                self._build_hier_group(g, idxs, by_node)
+                continue
             if impl == "ring":
                 n = g["size"]
                 edges = [self._new_edge(idxs[r], idxs[(r + 1) % n])
@@ -417,6 +459,35 @@ class CompiledDag:
             si = idx[id(m.parent)] if isinstance(m, AllReduceNode) \
                 else idx[id(m)]
             self._out_chans[si].append(self._new_edge(si, None))
+
+    def _build_hier_group(self, g: dict, idxs: List[int],
+                          by_node: Dict[str, list]) -> None:
+        """Wire one collective group as a ring-of-rings (dag/ring.py
+        HierarchicalReducer): per-node intra rings over shm edges, one
+        cross-node ring over the first member of each node (the
+        elected leader), and the intra broadcast riding the same intra
+        edges. Codec options apply to the inter (TCP) leg only — the
+        wiring puts them in the inter sub-spec and nowhere else."""
+        from ray_tpu.dag.ring import build_hier_specs
+        gid = g["id"][:12]
+        nodes = list(by_node.values())       # member positions per node
+        leaders = [mlist[0] for mlist in nodes]
+        L = len(leaders)
+        specs = build_hier_specs(
+            [len(v) for v in nodes],
+            # intra: co-located members (shm / lazy shm by placement)
+            lambda i, j: self._new_edge(
+                idxs[nodes[i][j]],
+                idxs[nodes[i][(j + 1) % len(nodes[i])]]),
+            # inter: node leaders (cross-node: TCP by placement)
+            lambda i: self._new_edge(idxs[leaders[i]],
+                                     idxs[leaders[(i + 1) % L]]),
+            op=g["op"], timeout_s=self._coll_timeout, group=gid,
+            quantize=g.get("quantize"),
+            chunk_bytes=g.get("chunk_bytes"))
+        flat_positions = [pos for mlist in nodes for pos in mlist]
+        for pos, spec in zip(flat_positions, specs):
+            self._coll_spec[idxs[pos]] = spec
 
     def _start(self):
         from ray_tpu.api import ActorMethod

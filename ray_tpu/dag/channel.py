@@ -784,6 +784,14 @@ def new_tcp_spec(nslots: int, slot_bytes: int) -> dict:
             "nslots": nslots, "slot_bytes": slot_bytes}
 
 
+def spec_transport(spec: dict) -> str:
+    """"tcp" or "shm" for an edge spec — the transport label
+    ``RingReducer.from_spec`` stamps onto its flight-recorder summary,
+    so a collective post-mortem says whether the hung/slow edge was a
+    cross-host TCP link or same-host shm without the spec in hand."""
+    return "tcp" if spec.get("type") == "tcp" else "shm"
+
+
 def attach_channel(spec: dict, role: str, timeout: float = 60.0,
                    abort=None):
     """Attach either channel flavor: shm specs are role-agnostic, tcp

@@ -134,6 +134,20 @@ def allreduce_metrics() -> dict:
                               quantized round: (N * max_block_scale) / 2
                               where scale = max|block|/127 (0 when the
                               round was unquantized)
+      allreduce_hier_inter_bytes_total  wire bytes written by this
+                              participant on the CROSS-NODE (inter)
+                              leg of hierarchical collectives — the
+                              number the ring-of-rings exists to
+                              shrink (~1/ranks-per-node of the flat
+                              ring's cross-node traffic)
+      collective_bcast_round_s  wall time of one intra-node broadcast
+                              round (the hierarchical fan-out phase)
+      collective_tuner_regime   impl the in-situ auto-tuner chose for
+                              the last collective payload: 0 = star,
+                              1 = flat ring, 2 = hierarchical
+      allreduce_bucket_overlap_s  per gradient sync, the staging time
+                              that was hidden under in-flight ring
+                              rounds by bucketed sync (train plane)
     """
     from ray_tpu.util import metrics as m
     return {
@@ -186,6 +200,28 @@ def allreduce_metrics() -> dict:
             "magnitude skew with cancellation. +inf when a non-finite "
             "gradient was NaN-poisoned through the wire; 0 for "
             "unquantized rounds"),
+        "hier_inter_bytes": m.Counter(
+            "allreduce_hier_inter_bytes_total",
+            "Wire bytes this participant wrote on the cross-node "
+            "(inter) leg of hierarchical collectives — the traffic "
+            "the ring-of-rings shrinks to ~1/ranks-per-node of the "
+            "flat ring's cross-node bytes"),
+        "bc_round": m.Histogram(
+            "collective_bcast_round_s",
+            "Wall time of one intra-node broadcast round (header "
+            "relay + pipelined chunk forwarding from the node "
+            "leader; the hierarchical fan-out phase)"),
+        "tuner_regime": m.Gauge(
+            "collective_tuner_regime",
+            "Impl the in-situ collective auto-tuner chose for the "
+            "last payload it was consulted about: 0 = star, 1 = flat "
+            "ring, 2 = hierarchical (unset until the first tuned "
+            "decision)"),
+        "bucket_overlap": m.Histogram(
+            "allreduce_bucket_overlap_s",
+            "Per bucketed gradient sync: host staging time that was "
+            "hidden under in-flight ring rounds (the compute/comm "
+            "overlap the bucket pipeline creates)"),
     }
 
 
@@ -490,12 +526,19 @@ class _RingTrace:
     """
 
     _KIND = {"round": "allreduce", "rs_round": "reduce_scatter",
-             "ag_round": "allgather"}
+             "ag_round": "allgather", "bc_round": "broadcast"}
 
     def __init__(self, rank: int, size: int, level: str, group: str,
-                 metrics: dict, flight_rounds: int, flight_dir: str):
+                 metrics: dict, flight_rounds: int, flight_dir: str,
+                 ring_level: Optional[str] = None):
         self.rank, self.size = int(rank), int(size)
         self.level = level
+        # hierarchy level tag stamped on every span this sub-ring
+        # records ("intra"/"inter"; broadcast rounds override to
+        # "bcast"); None for a flat ring. Keeps to_chrome lanes and
+        # straggler attribution from cross-wiring the two levels —
+        # each sub-ring also carries a distinct group id.
+        self.ring_level = ring_level
         self.group = group or "ring"
         self._m = metrics
         self.flight: "deque" = deque(maxlen=max(1, int(flight_rounds or 1)))
@@ -515,6 +558,7 @@ class _RingTrace:
         self.round_no += 1
         self.cur = {"round": self.round_no, "t0": time.time(),
                     "kind": None, "op": None, "codec": None,
+                    "level": self.ring_level,
                     "step": self.step, "send_s": 0.0, "wait_s": 0.0,
                     "apply_s": 0.0, "hdr_s": 0.0}
         if self.level == "chunk":
@@ -615,6 +659,7 @@ class _RingTrace:
         events.record(
             "collective", "round", ph="X", ts=cur["t0"], dur=dur,
             kind=kind, op=cur["op"], codec=cur["codec"],
+            level=cur.get("level"),
             group=self.group, cid=cur["round"], rank=self.rank,
             size=self.size, step=cur["step"], bytes=cur["bytes"],
             send_s=round(cur["send_s"], 6),
@@ -640,6 +685,10 @@ class _RingTrace:
             break
         return {"rank": self.rank, "size": self.size,
                 "group": self.group,
+                # set by RingReducer.from_spec (channel.spec_transport):
+                # a post-mortem reader learns whether the hung edge was
+                # a TCP link or same-host shm without the spec in hand
+                "transports": getattr(self, "transports", None),
                 "rounds_recorded": len(self.flight),
                 "last_straggler": self.last_straggler,
                 "recv_wait_by_rank": dict(self.last_rw),
@@ -714,7 +763,8 @@ class RingReducer:
                  quantize: Optional[str] = None,
                  chunk_bytes: Optional[int] = None,
                  wire_dtype=None, own: Optional[int] = None,
-                 trace_level: Optional[str] = None, group: str = ""):
+                 trace_level: Optional[str] = None, group: str = "",
+                 level: Optional[str] = None, tune: bool = False):
         if size < 2:
             raise ValueError("ring allreduce needs at least 2 ranks")
         if quantize not in _QUANTIZE_MODES:
@@ -746,6 +796,26 @@ class RingReducer:
         self._m = allreduce_metrics()
         self._wrote = 0           # wire bytes this round (batched inc)
         self._layout = None       # cached by reduce_scatter for allgather
+        # Group label: tags spans/flight dumps, and keys the in-situ
+        # tuner cache (one profile per ring generation).
+        self.group = group or ""
+        # Hierarchy level of THIS ring ("intra"/"inter" for the
+        # sub-rings of a HierarchicalReducer, None for a flat ring):
+        # stamped on every span, and "inter" rings additionally meter
+        # their writes into allreduce_hier_inter_bytes_total.
+        self.level = level
+        if level not in (None, "intra", "inter"):
+            raise ValueError(
+                f"ring level must be None, 'intra' or 'inter', "
+                f"got {level!r}")
+        # In-situ auto-tuning (dag/tuner.py): when set, the first
+        # collective op runs two tiny probe rounds (identically on
+        # every rank — probes ARE collectives) and later rounds pick
+        # their chunk size from the tuned table per payload band.
+        self._tune = bool(tune)
+        self._tuning = False      # reentrancy guard: probes call reduce
+        self._base_chunk = self.chunk_bytes
+        self._payload_hint: Optional[int] = None  # last round's bytes
         # Collective tracing + flight recorder (Config default, spec
         # override). "off" skips every clock read on the hot path.
         from ray_tpu.config import get_config
@@ -759,7 +829,8 @@ class RingReducer:
         self._tr = None if level == "off" else _RingTrace(
             self.rank, self.size, level, group, self._m,
             getattr(cfg, "collective_flight_rounds", 8),
-            getattr(cfg, "collective_flight_dir", ""))
+            getattr(cfg, "collective_flight_dir", ""),
+            ring_level=self.level)
         self.step: Optional[int] = None   # train-step span tag
         self._tr_err: Optional[BaseException] = None
         self._ph = "hdr"                  # current phase for chunk spans
@@ -806,7 +877,7 @@ class RingReducer:
                 f"ring allreduce peer never attached within "
                 f"{timeout_s}s (participant died before its first "
                 f"round?): {e}"))
-        return cls(to_next, from_prev,
+        ring = cls(to_next, from_prev,
                    rank=spec["rank"], size=spec["size"],
                    op=spec.get("op", "sum"),
                    timeout_s=timeout_s,
@@ -815,7 +886,17 @@ class RingReducer:
                    wire_dtype=spec.get("wire_dtype"),
                    own=spec.get("own"),
                    trace_level=spec.get("trace_level"),
-                   group=spec.get("group", ""))
+                   group=spec.get("group", ""),
+                   level=spec.get("level"),
+                   tune=bool(spec.get("tune")))
+        # transport mix for post-mortems: flight-dump summaries say
+        # whether a slow/hung edge was a TCP link or same-host shm
+        from ray_tpu.dag.channel import spec_transport
+        ring.transports = {"from_prev": spec_transport(spec["from_prev"]),
+                           "to_next": spec_transport(spec["to_next"])}
+        if ring._tr is not None:
+            ring._tr.transports = ring.transports
+        return ring
 
     def channels(self) -> list:
         return [self.to_next, self.from_prev]
@@ -1025,6 +1106,10 @@ class RingReducer:
         if self._codec is not None:
             self._qmax = max(self._qmax, self._codec.max_scale)
         self._m["bytes"].inc(self._wrote)
+        if self.level == "inter":
+            # the cross-node leg of a hierarchical collective: THE
+            # traffic the ring-of-rings exists to shrink
+            self._m["hier_inter_bytes"].inc(self._wrote)
         self._m["quant_err"].set(
             0.5 * self._qmax * self.size if self._q else 0.0)
         self._m[key].observe(time.monotonic() - t0)
@@ -1033,6 +1118,56 @@ class RingReducer:
                 self._tr.end(key, self._wrote, self._tr_err)
             except Exception:
                 pass
+
+    # --- in-situ auto-tuning (dag/tuner.py) ------------------------------
+
+    def _ensure_tuned(self):
+        """Lazily run the one-shot in-situ micro-bench on THIS ring
+        the first time any collective op is called (probes are
+        themselves collective rounds, so every rank reaches them in
+        lockstep and runs the identical sequence). Cached per ring
+        generation — keyed by the group id, which the controller
+        regenerates per incarnation — so a rewired group re-probes.
+        No-op unless the spec opted in (``tune``) and
+        Config.collective_tuner is on."""
+        if not self._tune or self._tuning:
+            return
+        from ray_tpu.config import get_config
+        if not getattr(get_config(), "collective_tuner", True):
+            return
+        from ray_tpu.dag import tuner
+        if tuner.profile_for(self.group, self.size) is not None:
+            return
+        self._tuning = True
+        try:
+            tuner.probe_ring(self)
+        finally:
+            self._tuning = False
+
+    def _apply_tuned_chunk(self, payload_bytes: int) -> None:
+        """Per-round chunk size from the tuned table's payload band
+        (falls back to the constructor chunk when untuned), plus the
+        ``collective_tuner_regime`` gauge for this payload. The
+        payload hint is derived from the ALREADY-flattened layout —
+        never a flatten-just-to-size pass — and memoized in
+        ``_payload_hint``: training steps repeat the same layout, so
+        every round after the first reuses the previous decision
+        instead of re-consulting the tuner table."""
+        payload_bytes = int(payload_bytes)
+        if not self._tune or self._tuning:
+            if not self._tuning:     # probe rounds must not poison
+                self._payload_hint = payload_bytes   # the memo
+            return
+        if payload_bytes == self._payload_hint:
+            return                   # same layout as last round
+        self._payload_hint = payload_bytes
+        from ray_tpu.dag import tuner
+        slot = min(self.to_next.slot_bytes, self.from_prev.slot_bytes)
+        c = tuner.tuned_chunk(self.group, self.size, payload_bytes, slot)
+        self.chunk_bytes = c if c else self._base_chunk
+        tuner.choose_impl(payload_bytes, self.size,
+                          hierarchical=self.level == "inter",
+                          key=self.group)   # records the regime gauge
 
     def _check_codec_wire(self, wire: np.dtype):
         if self._codec is not None and wire.kind != "f":
@@ -1052,6 +1187,7 @@ class RingReducer:
         ``wire_dtype`` override the constructor defaults for this round
         (all ranks must pass the same values — validated in the header
         phase)."""
+        self._ensure_tuned()
         op = self._begin(op, quantize, wire_dtype)
         t0 = time.monotonic()
         leaves = rebuild = wires = None
@@ -1171,6 +1307,7 @@ class RingReducer:
         reassemble the full pytree. Raises the group's agreed error on
         layout mismatch / participant failure, RingPeerDead on a dead
         neighbor."""
+        self._ensure_tuned()
         t0 = time.monotonic()
         leaves = rebuild = wire = None
         hdr: Dict[str, Any] = {"origin": self.rank}
@@ -1201,6 +1338,7 @@ class RingReducer:
             if agreed is not None:
                 self._raise(agreed)
             src, total = self._flat_src(leaves, wire)
+            self._apply_tuned_chunk(total * wire.itemsize)
             buf = np.empty(total, wire)
             bounds = [self.seg_bounds(total, i) for i in range(self.size)]
             self._rs_phase(src, buf, bounds, wire, op)
@@ -1242,6 +1380,7 @@ class RingReducer:
         back, wire dtype taken from the shard itself — what a caller
         that reassembles its own pytree wants, e.g. ShardedOptimizer
         rebuilding with PARAMETER leaf dtypes, not gradient ones)."""
+        self._ensure_tuned()
         t0 = time.monotonic()
         hdr: Dict[str, Any] = {"origin": self.rank}
         err_frame = None
@@ -1298,6 +1437,7 @@ class RingReducer:
                     f"value space: total {total}, offending rank(s) "
                     f"{bad} of {self.size} (every rank must pass "
                     f"exactly its seg_bounds(total) slice)")
+            self._apply_tuned_chunk(total * wire.itemsize)
             buf = np.empty(total, wire)
             lo, hi = bounds[self.own]
             buf[lo:hi] = shard
@@ -1310,6 +1450,77 @@ class RingReducer:
             raise
         finally:
             self._finish("ag_round", t0)
+
+    def broadcast(self, value, *, root: int = 0):
+        """Pipelined ring broadcast of a FLAT array from ``root``: one
+        header relay (root ships length + dtype; errors propagate like
+        any other round) then the chunks flow root -> root+1 -> ... ->
+        root-1, each intermediate rank forwarding VERBATIM — every
+        rank ends holding bitwise-identical bytes. Non-root ranks pass
+        ``value=None``. This is the hierarchical collective's fan-out
+        phase (node leader -> members over shm); spans record kind
+        "broadcast" with level tag "bcast" so timeline lanes can't
+        cross-wire it with the reduce legs."""
+        t0 = time.monotonic()
+        hdr: Dict[str, Any] = {"origin": self.rank}
+        err_frame = None
+        arr = None
+        try:
+            self._begin(None, None, None)   # broadcasts ship raw bytes
+            if self._tr is not None and self._tr.cur is not None:
+                self._tr.cur["level"] = "bcast"
+                self._tr.options("bcast", None)
+            root = int(root)
+            if not 0 <= root < self.size:
+                raise ValueError(
+                    f"broadcast root {root} out of range for "
+                    f"{self.size} ranks")
+            if self.rank == root:
+                arr = np.ascontiguousarray(np.asarray(value)).reshape(-1)
+                hdr["bn"] = int(arr.size)
+                hdr["bd"] = arr.dtype.str
+            hdr["sig"] = ("bc", root)
+        except BaseException as e:  # noqa: BLE001 — enters as error
+            try:
+                err_frame = dumps_oob(e)
+            except Exception:
+                err_frame = dumps_oob(RuntimeError(
+                    f"{type(e).__name__}: {e}"))
+        if err_frame is not None:
+            hdr["err"] = bytes(err_frame)
+        try:
+            headers = self._exchange_headers(hdr)
+            agreed = self._agree(headers, "broadcast")
+            if agreed is not None:
+                self._raise(agreed)
+            rh = headers[root]
+            n, dt = int(rh["bn"]), np.dtype(rh["bd"])
+            self._ph = "bc"
+            self._seg_tx = self._seg_rx = root
+            if self.rank == root:
+                for lo, hi in self._chunks(0, n, dt.itemsize):
+                    self._write(arr[lo:hi].data.cast("B"))
+                return arr
+            buf = np.empty(n, dt)
+            # the rank whose successor is the root terminates the chain
+            forward = (self.rank + 1) % self.size != root
+            for lo, hi in self._chunks(0, n, dt.itemsize):
+                def apply(kind, mv, lo=lo, hi=hi):
+                    if kind != DATA:
+                        raise RingProtocolError(
+                            f"unexpected frame kind {kind} in ring "
+                            f"broadcast")
+                    buf[lo:hi] = np.frombuffer(mv, dt)
+                    return bytes(mv) if forward else None
+                frame = self._read_with(apply)
+                if forward:
+                    self._write(frame)
+            return buf
+        except BaseException as e:  # noqa: BLE001 — flight recorder
+            self._tr_err = e
+            raise
+        finally:
+            self._finish("bc_round", t0)
 
     # --- data movement --------------------------------------------------
 
@@ -1459,6 +1670,7 @@ class RingReducer:
         phase logic."""
         n = self.size
         src, total = self._flat_src(leaves, wire)
+        self._apply_tuned_chunk(total * wire.itemsize)
         buf = np.empty(total, wire)         # filled by RS + AG below
         bounds = [self.seg_bounds(total, i) for i in range(n)]
         self._rs_phase(src, buf, bounds, wire, op)
@@ -1474,3 +1686,423 @@ class RingReducer:
             outs.append(buf[off:off + l.size].reshape(l.shape))
             off += l.size
         return outs
+
+
+# --- hierarchical (ring-of-rings) collectives ----------------------------
+
+
+class _PoisonValue:
+    """``np.asarray`` of this raises the carried exception — the hook
+    for injecting an already-raised error into a collective leg's
+    error-frame entry path: the leg's own prep try/except turns it
+    into the err frame every peer agrees on in one header relay,
+    instead of stalling them to the ring timeout."""
+
+    def __init__(self, err: BaseException):
+        self.err = err
+
+    def __array__(self, *a, **kw):  # noqa: D105 — numpy hook
+        raise self.err
+
+
+def hier_seg_bounds(total: int, node_counts, world_rank: int):
+    """(lo, hi) of ``world_rank``'s owned slice under the two-level
+    split: the flat space is first split across nodes by the inter
+    ring's even L-way split (total*i//L), then each node segment is
+    split across its members by the intra ring's even k-way split.
+    This nests EXACTLY with what the sub-rings' own ``seg_bounds``
+    produce (the flat N-way split does not, for small totals), so
+    hierarchical reduce-scatter shards always tile and validate."""
+    counts = [int(c) for c in node_counts]
+    L = len(counts)
+    r = int(world_rank)
+    node = 0
+    while node < L and r >= counts[node]:
+        r -= counts[node]
+        node += 1
+    if node >= L:
+        raise ValueError(
+            f"world rank {world_rank} out of range for nodes {counts}")
+    base = total * node // L
+    nlen = total * (node + 1) // L - base
+    k = counts[node]
+    return base + nlen * r // k, base + nlen * (r + 1) // k
+
+
+def build_hier_specs(node_counts, intra_edge, inter_edge, *, op: str,
+                     timeout_s: float, group: str,
+                     quantize: Optional[str] = None,
+                     chunk_bytes: Optional[int] = None,
+                     tune: bool = False) -> List[Dict[str, Any]]:
+    """THE ring-of-rings spec builder every plane shares (the train
+    controller, the dag compiler, the bench): given per-node rank
+    counts and two edge factories — ``intra_edge(i, j)`` returns the
+    edge from local rank j to local rank (j+1)%k of node i,
+    ``inter_edge(i)`` the edge from leader i to leader (i+1)%L — it
+    emits one ``HierarchicalReducer.from_spec`` spec per world rank
+    (world order), with codec/tuner options riding the INTER sub-spec
+    only and distinct trace groups per sub-ring. One builder means
+    the spec contract cannot drift between planes."""
+    counts = [int(c) for c in node_counts]
+    L = len(counts)
+    intra_edges = [[intra_edge(i, j) for j in range(k)] if k > 1
+                   else None for i, k in enumerate(counts)]
+    inter_edges = [inter_edge(i) for i in range(L)]
+    specs: List[Dict[str, Any]] = []
+    for i, k in enumerate(counts):
+        for j in range(k):
+            intra = None
+            if k > 1:
+                intra = {"rank": j, "size": k, "op": op,
+                         "timeout_s": timeout_s,
+                         "chunk_bytes": chunk_bytes,
+                         "group": f"{group}.n{i}", "level": "intra",
+                         "to_next": intra_edges[i][j],
+                         "from_prev": intra_edges[i][(j - 1) % k]}
+            inter = None
+            if j == 0:
+                inter = {"rank": i, "size": L, "op": op,
+                         "timeout_s": timeout_s,
+                         "quantize": quantize,
+                         "chunk_bytes": chunk_bytes,
+                         "group": f"{group}.x", "level": "inter",
+                         "tune": tune,
+                         "to_next": inter_edges[i],
+                         "from_prev": inter_edges[(i - 1) % L]}
+            specs.append({"role": "hier", "rank": len(specs),
+                          "size": sum(counts), "node": i, "local": j,
+                          "nodes": counts, "op": op,
+                          "timeout_s": timeout_s,
+                          "quantize": quantize, "group": group,
+                          "intra": intra, "inter": inter})
+    return specs
+
+
+class HierarchicalReducer:
+    """Topology-aware two-level collective group: per-node intra rings
+    (shm), one cross-node ring over elected node leaders (TCP), and an
+    intra-node broadcast fan-out — the ring-of-rings decomposition of
+    "The Big Send-off" (arxiv 2504.18658). Cross-node wire traffic
+    drops to ~1/ranks-per-node of the flat ring's: only the leaders'
+    node-combined values ride the inter ring, and the existing wire
+    codecs (int8 block quantization, bf16 cast) apply on THAT leg only
+    — shm legs ship full precision for free.
+
+    Same collective surface as ``RingReducer`` (``reduce`` /
+    ``reduce_scatter`` / ``allgather`` / ``round`` / ``seg_bounds`` /
+    ``abort`` / ``step`` / ``timeout_s``), so the train plane,
+    ``ShardedOptimizer`` and the dag ``_Collective`` use it
+    interchangeably. Shard ownership follows ``hier_seg_bounds`` (the
+    nested two-level split); results are bitwise identical on every
+    rank — the inter ring's owner round-trip plus verbatim broadcast
+    forwarding guarantee it whichever codec is active.
+
+    One collective here is: intra reduce-scatter + intra allgather
+    (node members combine into the node value, kept flat in the wide
+    accumulation dtype), the inter leg over leaders, then an intra
+    broadcast of the leader's result. An error in ANY leg — a dead
+    leader mid-inter-ring included — is injected into every remaining
+    leg as an error frame, so all world ranks surface the same failure
+    (with their flight-recorder dumps) instead of stalling."""
+
+    def __init__(self, *, node: int, local: int, node_counts,
+                 intra: Optional[RingReducer],
+                 inter: Optional[RingReducer],
+                 op: str = "sum", timeout_s: float = 600.0,
+                 quantize: Optional[str] = None, wire_dtype=None,
+                 group: str = ""):
+        self.node_counts = [int(c) for c in node_counts]
+        self.nnodes = len(self.node_counts)
+        if self.nnodes < 2:
+            raise ValueError(
+                "hierarchical collectives need at least 2 nodes — use "
+                "a flat ring for single-node groups")
+        self.node, self.local = int(node), int(local)
+        self.size = sum(self.node_counts)
+        self.rank = sum(self.node_counts[:self.node]) + self.local
+        k = self.node_counts[self.node]
+        if (intra is None) != (k == 1):
+            raise ValueError(
+                f"node {node} has {k} member(s): intra ring must be "
+                f"{'absent' if k == 1 else 'present'}")
+        if (inter is None) != (self.local != 0):
+            raise ValueError(
+                "exactly the node leaders (local rank 0) carry the "
+                "inter ring")
+        self.intra, self.inter = intra, inter
+        self.op = op
+        self.quantize = quantize
+        self.wire_dtype = resolve_wire_dtype(wire_dtype)
+        self.group = group
+        self._timeout_s = float(timeout_s)
+        self.timeout_s = self._timeout_s     # fan out to the legs
+        self._layout = None
+        self._step: Optional[int] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def from_spec(cls, spec: Dict[str, Any],
+                  abort=None) -> "HierarchicalReducer":
+        """Attach both sub-rings from a controller/compiler-built spec:
+        {"kind": "hier", "node", "local", "nodes": [k_0..k_L-1],
+        "intra": ring spec | None, "inter": ring spec | None (leaders
+        only), "op"?, "timeout_s"?, "quantize"?, "group"?}. The intra
+        ring attaches first (consumer-first within each ring, as
+        RingReducer.from_spec guarantees); an inter attach failure
+        releases the intra channels instead of leaking them."""
+        intra = RingReducer.from_spec(spec["intra"], abort=abort) \
+            if spec.get("intra") else None
+        inter = None
+        try:
+            inter = RingReducer.from_spec(spec["inter"], abort=abort) \
+                if spec.get("inter") else None
+        except BaseException:
+            if intra is not None:
+                intra.close()
+            raise
+        return cls(node=spec["node"], local=spec["local"],
+                   node_counts=spec["nodes"], intra=intra, inter=inter,
+                   op=spec.get("op", "sum"),
+                   timeout_s=float(spec.get("timeout_s", 600.0)),
+                   quantize=spec.get("quantize"),
+                   wire_dtype=spec.get("wire_dtype"),
+                   group=spec.get("group", ""))
+
+    def _legs(self):
+        return [g for g in (self.intra, self.inter) if g is not None]
+
+    def channels(self) -> list:
+        return [ch for g in self._legs() for ch in g.channels()]
+
+    def close(self):
+        for g in self._legs():
+            g.close()
+
+    def abort(self) -> None:
+        for g in self._legs():
+            g.abort()
+
+    @property
+    def step(self) -> Optional[int]:
+        return self._step
+
+    @step.setter
+    def step(self, v: Optional[int]) -> None:
+        self._step = v
+        for g in self._legs():
+            g.step = v
+
+    @property
+    def timeout_s(self) -> float:
+        return self._timeout_s
+
+    @timeout_s.setter
+    def timeout_s(self, v: float) -> None:
+        self._timeout_s = float(v)
+        for g in self._legs():
+            g.timeout_s = float(v)
+
+    # -- topology ----------------------------------------------------------
+
+    def seg_bounds(self, total: int, seg: Optional[int] = None):
+        """(lo, hi) of segment ``seg`` (default: this rank's) under the
+        nested two-level split — see ``hier_seg_bounds``."""
+        s = self.rank if seg is None else int(seg)
+        return hier_seg_bounds(total, self.node_counts, s)
+
+    def _node_base(self, total: int) -> int:
+        return total * self.node // self.nnodes
+
+    # -- error relay -------------------------------------------------------
+
+    def _relay_inter(self, err: BaseException) -> None:
+        """Inject ``err`` into the inter ring (leaders only): the other
+        leaders' in-flight leg resolves to this agreed error in one
+        header relay, and their own relays fan it out to their node
+        members."""
+        if self.inter is None:
+            return
+        try:
+            self.inter.reduce_scatter(_PoisonValue(err))
+        except BaseException:  # noqa: BLE001 — original error wins
+            pass
+
+    def _relay_bcast(self, err: BaseException) -> None:
+        """Inject ``err`` into the intra broadcast this node's members
+        are (or will be) blocked in. Leader-only by construction —
+        members never hold an error their node leader hasn't seen."""
+        if self.intra is None or self.local != 0:
+            return
+        try:
+            self.intra.broadcast(_PoisonValue(err), root=0)
+        except BaseException:  # noqa: BLE001 — original error wins
+            pass
+
+    # -- collectives -------------------------------------------------------
+
+    def reduce_scatter(self, value, *, op: Optional[str] = None,
+                       quantize=_UNSET):
+        """Hierarchical reduce-scatter: returns this rank's owned flat
+        shard (``seg_bounds(total)`` under the nested split, mean
+        already divided). ``quantize`` applies to the cross-node leg
+        only. The layout is cached for a following ``allgather``."""
+        op = op or self.op
+        if op not in ("sum", "mean", "max", "min"):
+            raise ValueError(f"unknown op {op!r}")
+        q = self.quantize if quantize is _UNSET else quantize
+        leg_op = "sum" if op == "mean" else op
+        # 0. flatten ONCE: the staged flat vector feeds every leg (the
+        #    legs' own flatten of a 1-D contiguous array is zero-copy)
+        #    and its metadata feeds the final layout — device leaves
+        #    pay exactly one device->host copy per sync. A local
+        #    flatten failure enters the legs as a poison value, so it
+        #    ships as an error frame every peer agrees on in one
+        #    header relay instead of stalling them to the ring timeout.
+        leaves = rebuild = None
+        try:
+            leaves, rebuild, _ = _flatten(value)
+            w0 = _wire_dtype([l.dtype for l in leaves], leg_op) \
+                if leaves else np.dtype(np.float32)
+            entry = np.empty(int(sum(l.size for l in leaves)), w0)
+            off = 0
+            for l in leaves:
+                entry[off:off + l.size] = np.asarray(
+                    l, dtype=w0).reshape(-1)
+                off += l.size
+        except BaseException as e:  # noqa: BLE001 — enters poisoned
+            entry = _PoisonValue(e)
+        # 1. intra combine: node members reduce into the node value,
+        #    kept flat in the wide accumulation dtype (shm; no codec)
+        if self.intra is not None:
+            try:
+                ishard = self.intra.reduce_scatter(entry, op=leg_op)
+                node_flat = self.intra.allgather(ishard, rebuild=False)
+            except BaseException as e:  # noqa: BLE001 — relay onward
+                self._relay_inter(e)
+                raise
+        else:
+            node_flat = entry
+        # 2. inter leg (leaders): reduce-scatter node values across
+        #    nodes — the only wire leg, and the only codec'd one
+        lead = None
+        if self.inter is not None:
+            try:
+                lead = self.inter.reduce_scatter(
+                    node_flat, op=leg_op,
+                    quantize=q if q is not None else None)
+                if op == "mean":
+                    # world mean, applied identically on every leader
+                    # BEFORE the broadcast so members receive final
+                    # bytes (bitwise identity by construction)
+                    lead = lead / self.size
+            except BaseException as e:  # noqa: BLE001 — relay onward
+                self._relay_bcast(e)
+                raise
+        # 3. intra fan-out of the leader's owned node segment
+        if self.intra is not None:
+            full_seg = self.intra.broadcast(lead, root=0)
+        else:
+            full_seg = lead
+        # 4. layout + owned slice from the step-0 metadata (a poisoned
+        #    entry never reaches here — the legs raised)
+        total = int(sum(l.size for l in leaves))
+        wide = full_seg.dtype
+        self._layout = {
+            "rebuild": rebuild, "total": total, "wire": wide,
+            "leaves": [(l.shape, l.size,
+                        wide if _keeps_wide(l.dtype, op) else l.dtype)
+                       for l in leaves]}
+        lo, hi = self.seg_bounds(total)
+        base = self._node_base(total)
+        return np.ascontiguousarray(
+            full_seg[lo - base:hi - base]).copy()
+
+    def allgather(self, shard, *, wire_dtype=_UNSET, total_hint=None,
+                  rebuild: bool = True):
+        """Hierarchical allgather: member shards gather over the intra
+        ring into the node segment, leaders allgather node segments
+        across the inter ring (``wire_dtype`` codec applies HERE
+        only), and the full vector broadcasts back down. Layout-cache
+        semantics match ``RingReducer.allgather`` (``total_hint`` pins
+        the match, ``rebuild=False`` skips it)."""
+        shard = np.ascontiguousarray(np.asarray(shard)).reshape(-1)
+        layout = self._layout if rebuild else None
+        if layout is not None:
+            lo, hi = self.seg_bounds(layout["total"])
+            if (layout["total"] != total_hint
+                    if total_hint is not None
+                    else hi - lo != shard.size):
+                layout = None
+        wire = layout["wire"] if layout is not None else shard.dtype
+        shard = np.ascontiguousarray(shard, dtype=wire)
+        wdt = self.wire_dtype if wire_dtype is _UNSET else wire_dtype
+        # 1. intra gather: member shards tile the node segment under
+        #    the nested split, which IS the intra ring's own split
+        if self.intra is not None:
+            try:
+                node_seg = self.intra.allgather(shard, rebuild=False)
+            except BaseException as e:  # noqa: BLE001 — relay onward
+                self._relay_inter(e)
+                raise
+        else:
+            node_seg = shard
+        # 2. inter leg (leaders): node segments -> full vector
+        full = None
+        if self.inter is not None:
+            try:
+                full = self.inter.allgather(
+                    node_seg,
+                    wire_dtype=wdt if wdt is not None else _UNSET,
+                    rebuild=False)
+            except BaseException as e:  # noqa: BLE001 — relay onward
+                self._relay_bcast(e)
+                raise
+        # 3. intra fan-out
+        if self.intra is not None:
+            full = self.intra.broadcast(full, root=0)
+        if layout is None or layout["total"] != full.size:
+            return full
+        return rebuild_from_layout(full, layout)
+
+    def reduce(self, value, *, op: Optional[str] = None,
+               quantize=_UNSET, wire_dtype=_UNSET):
+        """Fused hierarchical allreduce: the two standalone phases back
+        to back (reduce-scatter caches the layout; allgather rebuilds
+        the pytree with the flat ring's cast-back policy). ``quantize``
+        rides the inter reduce-scatter, ``wire_dtype`` the inter
+        allgather — cross-node leg only, results bitwise identical on
+        every rank."""
+        shard = self.reduce_scatter(value, op=op, quantize=quantize)
+        return self.allgather(shard, wire_dtype=wire_dtype,
+                              total_hint=self._layout["total"])
+
+    def round(self, kind: int, value, err_frame: Optional[bytes], *,
+              op: Optional[str] = None,
+              quantize=_UNSET, wire_dtype=_UNSET):
+        """Dag-loop entrypoint: (DATA, reduced_value) or (ERROR,
+        frame). An error entry (or a local failure) resolves to the
+        same agreed error on every world rank via the per-leg error
+        relay; a dead neighbor raises RingPeerDead as usual."""
+        if kind != DATA and err_frame is None:
+            err_frame = dumps_oob(RuntimeError(
+                "hier participant entered an error round without a "
+                "frame"))
+        if err_frame is not None:
+            err = loads_oob(err_frame)
+            if not isinstance(err, BaseException):
+                err = RuntimeError(str(err))
+            value = _PoisonValue(err)
+        try:
+            out = self.reduce(value, op=op, quantize=quantize,
+                              wire_dtype=wire_dtype)
+            return DATA, out
+        except RingPeerDead:
+            raise
+        except BaseException as e:  # noqa: BLE001 — agreed error
+            try:
+                frame = dumps_oob(e)
+            except Exception:
+                frame = dumps_oob(RuntimeError(
+                    f"{type(e).__name__}: {e}"))
+            return ERROR, frame

@@ -167,6 +167,12 @@ class _Collective:
         if self.role == "ring":
             from ray_tpu.dag.ring import RingReducer
             self._ring = RingReducer.from_spec(spec)
+        elif self.role == "hier":
+            # ring-of-rings: same collective surface as the flat ring
+            # (round / reduce_scatter / allgather), so every path
+            # below treats it identically
+            from ray_tpu.dag.ring import HierarchicalReducer
+            self._ring = HierarchicalReducer.from_spec(spec)
         elif self.role == "root":
             self.up = [attach_channel(s, "consumer") for s in spec["up"]]
             self.down = [attach_channel(s, "producer")

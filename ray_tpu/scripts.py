@@ -425,7 +425,9 @@ def cmd_collectives(args) -> int:
         strag = t["straggler"] if t["straggler"] is not None else "-"
         step = f"step {t['step']}" if t["step"] is not None else "-"
         status = "ERROR" if t["error"] else "ok"
+        level = t.get("level") or "flat"
         print(f"{started}  {t['kind']:15s} {str(t['op'] or '-'):5s} "
+              f"{level:5s} "
               f"r{t['rank']}/{t['size']}  "
               f"{(t['bytes'] or 0) / 1e6:8.2f} MB  "
               f"{(t['duration_s'] or 0.0) * 1e3:9.2f} ms  "
@@ -436,7 +438,8 @@ def cmd_collectives(args) -> int:
     for a in summarize_collectives(rows):
         strag = (f"  top straggler rank {a['top_straggler']}"
                  if a["top_straggler"] is not None else "")
-        print(f"{a['kind']} ({a['op']}, {a['codec'] or 'fp'}): "
+        print(f"{a['kind']}[{a.get('level') or 'flat'}] "
+              f"({a['op']}, {a['codec'] or 'fp'}): "
               f"{a['rounds']} rounds, mean "
               f"{a['mean_s'] * 1e3:.2f} ms, max {a['max_s'] * 1e3:.2f} "
               f"ms, {a['bytes'] / 1e6:.2f} MB/round, "

@@ -359,13 +359,15 @@ class TrainContext:
                     "host-plane gradient sync is not wired for this "
                     "worker group (controller predates it, or "
                     "world_size == 1)")
-            from ray_tpu.dag.ring import RingReducer
+            from ray_tpu.dag.ring import HierarchicalReducer, RingReducer
             # a rewire landing while this thread is still INSIDE the
             # attach has no ring to abort() — the regroup event is the
             # only signal that can reach it, so the blocking attach
             # wait polls it and bails instead of waiting out the sync
             # timeout against a dead incarnation's specs
-            self._grad_ring = RingReducer.from_spec(
+            cls = HierarchicalReducer \
+                if self._grad_sync.get("role") == "hier" else RingReducer
+            self._grad_ring = cls.from_spec(
                 self._grad_sync, abort=self._regroup_evt.is_set)
         return self._grad_ring
 
@@ -392,7 +394,15 @@ class TrainContext:
             raise ValueError(f"rank {r} out of range for {n} workers")
         if n == 1:
             return 0, total
-        own_self = (self._grad_sync or {}).get("own", self.rank)
+        gs = self._grad_sync or {}
+        if gs.get("role") == "hier":
+            # two-level topology: ownership follows the NESTED split
+            # (inter split by node, intra split of the node segment —
+            # dag/ring.py hier_seg_bounds), which is what the wired
+            # HierarchicalReducer's reduce-scatter actually hands out
+            from ray_tpu.dag.ring import hier_seg_bounds
+            return hier_seg_bounds(total, gs["nodes"], r)
+        own_self = gs.get("own", self.rank)
         seg = (r + (own_self - self.rank)) % n
         return total * seg // n, total * (seg + 1) // n
 

@@ -16,7 +16,9 @@ shipping full tensors through the rendezvous actor.
 from __future__ import annotations
 
 import time
-from typing import Any, Optional
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
 
 import ray_tpu
 from ray_tpu.train.api import get_context
@@ -115,6 +117,240 @@ def _validate_codec_opts(value: Any, op: str, quantize: Optional[str],
                     f"(wire dtype would be {w})")
 
 
+# --- bucketed gradient sync ----------------------------------------------
+#
+# Splitting a gradient pytree into leaf buckets lets the ring start
+# reducing EARLY buckets while LATER leaves are still being staged to
+# host (np.asarray of a jax leaf is a device->host transfer): the
+# caller's thread stages bucket k+1 while a single worker thread runs
+# the (order-preserving) ring rounds for buckets <= k — host staging
+# hides under ring I/O through the channels' existing per-item
+# send/recv windows. Bucket cuts are derived from the layout alone
+# (leaf order + nbytes), so every rank cuts identical buckets and the
+# ring's per-round layout validation still applies per bucket.
+
+
+def _raw_leaves(value) -> list:
+    """The pytree's leaves in ``dag.ring._flatten`` order WITHOUT
+    staging them (no np.asarray): bucketed sync must not pay the
+    device->host copy before the bucket that ships the leaf."""
+    out: list = []
+
+    def walk(v):
+        if isinstance(v, dict):
+            for k in v:
+                walk(v[k])
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                walk(x)
+        else:
+            out.append(v)
+    walk(value)
+    return out
+
+
+def _rebuild_like(value, it):
+    """Reassemble a pytree shaped like ``value`` from an iterator of
+    reduced arrays (same leaf order as ``_raw_leaves``), applying
+    ``_flatten``'s scalar policy: a non-ndarray 0-d leaf comes back as
+    a Python scalar."""
+    if isinstance(value, dict):
+        t = type(value)
+        out = {k: _rebuild_like(v, it) for k, v in value.items()}
+        return out if t is dict else t(out)
+    if isinstance(value, tuple) and hasattr(value, "_fields"):
+        return type(value)(*(_rebuild_like(x, it) for x in value))
+    if isinstance(value, (list, tuple)):
+        return type(value)(_rebuild_like(x, it) for x in value)
+    arr = next(it)
+    if not isinstance(value, np.ndarray) and np.ndim(value) == 0:
+        return arr.item() if hasattr(arr, "item") else arr
+    return arr
+
+
+def _leaf_nbytes(leaf) -> int:
+    nb = getattr(leaf, "nbytes", None)
+    return int(nb) if nb is not None else int(np.asarray(leaf).nbytes)
+
+
+def _bucket_parts(leaves: list, bucket_bytes: int) -> List[Tuple[int, int]]:
+    """Order-preserving leaf index ranges whose summed nbytes stay at
+    or under ``bucket_bytes`` (every bucket gets at least one leaf, an
+    oversized leaf rides alone). Deterministic from the layout, so all
+    ranks cut the same buckets."""
+    if bucket_bytes <= 0:
+        raise ValueError("bucket_bytes must be > 0")
+    parts: List[Tuple[int, int]] = []
+    a, acc = 0, 0
+    for i, leaf in enumerate(leaves):
+        nb = _leaf_nbytes(leaf)
+        if i > a and acc + nb > bucket_bytes:
+            parts.append((a, i))
+            a, acc = i, 0
+        acc += nb
+    parts.append((a, max(a + 1, len(leaves))) if leaves else (0, 0))
+    return parts if leaves else []
+
+
+def _pipeline_buckets(nparts: int, stage_fn: Callable[[int], Any],
+                      ring_fn: Callable[[int, Any], Any]):
+    """Run ``stage_fn(i)`` on the calling thread while ONE worker
+    thread runs ``ring_fn(i, staged)`` strictly in bucket order (ring
+    rounds must stay ordered — every rank issues the same sequence).
+    Returns ``(results, overlap_s)``: overlap_s is the staging wall
+    time that ran while a ring round was in flight — the comm/compute
+    overlap the bucketing buys, exported as
+    ``allreduce_bucket_overlap_s``."""
+    from concurrent.futures import ThreadPoolExecutor
+    ring_windows: List[Tuple[float, float]] = []
+    stage_windows: List[Tuple[float, float]] = []
+    failed: List[BaseException] = []
+
+    def run(i, staged):
+        # once a bucket's round has failed, every LATER queued bucket
+        # short-circuits instead of issuing another collective: an
+        # agreed error fails the same bucket on every rank (so all
+        # ranks skip the same tail — lockstep preserved), and a dead
+        # peer is terminal for the group anyway. Without this, a
+        # large model's remaining buckets would each wait out the
+        # ring timeout against the dead peer — hours, not the one
+        # timeout the elastic recovery deadline budgets for.
+        if failed:
+            raise failed[0]
+        t0 = time.monotonic()
+        try:
+            return ring_fn(i, staged)
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+            failed.append(e)
+            raise
+        finally:
+            ring_windows.append((t0, time.monotonic()))
+
+    from ray_tpu.dag.ring import _PoisonValue
+    with ThreadPoolExecutor(1) as ex:
+        futs = []
+        for i in range(nparts):
+            t0 = time.monotonic()
+            try:
+                staged = stage_fn(i)
+            except BaseException as e:  # noqa: BLE001 — must not stall
+                # a rank-local staging failure still ENTERS the ring
+                # round (the poison ships as an error frame every peer
+                # agrees on in one header relay) — peers must never be
+                # left blocking because this rank's device->host copy
+                # died; the same contract the unbucketed path gets
+                # from flattening inside the ring's try
+                staged = _PoisonValue(e)
+            stage_windows.append((t0, time.monotonic()))
+            futs.append(ex.submit(run, i, staged))
+        results = [f.result() for f in futs]
+    overlap = 0.0
+    for s0, s1 in stage_windows:
+        for r0, r1 in ring_windows:
+            overlap += max(0.0, min(s1, r1) - max(s0, r0))
+    try:
+        from ray_tpu.dag.ring import allreduce_metrics
+        allreduce_metrics()["bucket_overlap"].observe(overlap)
+    except Exception:   # noqa: BLE001 — telemetry must never break
+        pass
+    return results, overlap
+
+
+def _stage(leaf) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(leaf))
+
+
+def _bucketed_allreduce(ring, value, op: str, quantize, wire_dtype,
+                        bucket_bytes: int):
+    from ray_tpu.dag.ring import _UNSET
+    leaves = _raw_leaves(value)
+    parts = _bucket_parts(leaves, bucket_bytes)
+    q = quantize if quantize is not None else _UNSET
+    w = wire_dtype if wire_dtype is not None else _UNSET
+    if len(parts) <= 1:
+        return ring.reduce(value, op=op, quantize=q, wire_dtype=w)
+    outs, _ = _pipeline_buckets(
+        len(parts),
+        lambda i: [_stage(l) for l in leaves[parts[i][0]:parts[i][1]]],
+        lambda i, staged: ring.reduce(staged, op=op, quantize=q,
+                                      wire_dtype=w))
+    flat = [arr for out in outs for arr in out]
+    return _rebuild_like(value, iter(flat))
+
+
+def _bucketed_reduce_scatter(ctx, ring, value, op: str, quantize,
+                             bucket_bytes: int):
+    """Per-bucket ring reduce-scatter with the staging/ring pipeline.
+    Returns the concatenation of this rank's owned per-bucket shards
+    (each bucket's flat space split by ``ring.seg_bounds``, mean
+    already divided) and caches the bucket layout on the context so
+    ``allgather_params`` can reassemble the full pytree."""
+    from ray_tpu.dag.ring import _UNSET
+    leaves = _raw_leaves(value)
+    parts = _bucket_parts(leaves, bucket_bytes)
+    q = quantize if quantize is not None else _UNSET
+    meta = {"bucket_bytes": int(bucket_bytes), "totals": [],
+            "wires": [], "leaves": [], "template": value}
+
+    def rs(i, staged):
+        shard = ring.reduce_scatter(staged, op=op, quantize=q)
+        # the ring thread runs buckets sequentially, so the cached
+        # layout read here is bucket i's (not a later bucket's)
+        return shard, ring._layout
+
+    outs, _ = _pipeline_buckets(
+        len(parts),
+        lambda i: [_stage(l) for l in leaves[parts[i][0]:parts[i][1]]],
+        rs)
+    shards = []
+    for shard, layout in outs:
+        meta["totals"].append(layout["total"])
+        meta["wires"].append(layout["wire"])
+        meta["leaves"].append(layout["leaves"])   # per-bucket metas
+        shards.append(shard)
+    meta["total"] = int(sum(meta["totals"]))
+    ctx._bucketed_rs = meta
+    return np.concatenate(shards) if shards else np.empty(0, np.float32)
+
+
+def _bucketed_allgather(ctx, ring, shard, wire_dtype, meta):
+    """Reassemble the full pytree from a concatenated bucketed shard:
+    split by per-bucket owned lengths, allgather each bucket (flat),
+    stitch the flat buckets (bucket cuts are leaf-aligned, so their
+    concatenation IS the flat value space) and rebuild with each
+    leaf's cast-back dtype."""
+    from ray_tpu.dag.ring import _UNSET
+    w = wire_dtype if wire_dtype is not None else _UNSET
+    flat = np.ascontiguousarray(np.asarray(shard)).reshape(-1)
+    lens, offs = [], [0]
+    for t in meta["totals"]:
+        lo, hi = ring.seg_bounds(t)
+        lens.append(hi - lo)
+        offs.append(offs[-1] + (hi - lo))
+    if offs[-1] != flat.size:
+        raise ValueError(
+            f"bucketed shard has {flat.size} elements, the cached "
+            f"bucket layout owns {offs[-1]} — pass exactly what the "
+            f"bucketed reduce-scatter returned")
+    pieces = [np.ascontiguousarray(flat[offs[i]:offs[i] + lens[i]],
+                                   dtype=meta["wires"][i])
+              for i in range(len(lens))]
+    outs, _ = _pipeline_buckets(
+        len(pieces), lambda i: pieces[i],
+        lambda i, p: ring.allgather(p, wire_dtype=w, rebuild=False))
+    # per-bucket rebuild (no cross-bucket concatenation: buckets may
+    # carry different wire dtypes, and promotion would corrupt values)
+    leaves_out = []
+    for bi, out in enumerate(outs):
+        fb = np.asarray(out).reshape(-1)
+        off = 0
+        for shape, size, od in meta["leaves"][bi]:
+            leaves_out.append(
+                fb[off:off + size].reshape(shape).astype(od, copy=False))
+            off += size
+    return _rebuild_like(meta["template"], iter(leaves_out))
+
+
 def _ring_call(ctx, timeout_s: Optional[float], fn,
                bump_step: bool = False):
     """Run one collective on the controller-wired ring with an optional
@@ -145,6 +381,7 @@ def _ring_call(ctx, timeout_s: Optional[float], fn,
 def allreduce_gradients(value: Any, op: str = "mean", *,
                         quantize: Optional[str] = None,
                         wire_dtype: Optional[str] = None,
+                        bucket_bytes: Optional[int] = None,
                         timeout_s: Optional[float] = None) -> Any:
     """Elementwise allreduce of a host gradient pytree (dict / list /
     tuple / NamedTuple of numpy-compatible arrays) across the train
@@ -163,14 +400,33 @@ def allreduce_gradients(value: Any, op: str = "mean", *,
     ShardedOptimizer). All results are bitwise identical across
     workers, so SPMD state cannot diverge.
 
+    ``bucket_bytes`` splits the pytree into leaf buckets of about that
+    size and PIPELINES them: the ring starts reducing early buckets
+    while later leaves are still being staged to host, hiding staging
+    under ring I/O (the hidden time lands in the
+    ``allreduce_bucket_overlap_s`` histogram). Results stay bitwise
+    identical ACROSS RANKS (the per-bucket rounds keep the ring's
+    guarantee); vs the unbucketed sync they are numerically
+    equivalent — each element's contributions may associate in a
+    different ring order, the same reduction-order rounding any ring
+    reshape implies (bitwise equal whenever sums are exact). All
+    ranks must pass the same ``bucket_bytes``.
+
     Every worker must call this the same number of times with matching
     layouts and options; a worker that dies mid-ring surfaces as a
     RuntimeError on every survivor within the ring timeout."""
     ctx = get_context()
+    if bucket_bytes is not None and bucket_bytes <= 0:
+        raise ValueError("bucket_bytes must be > 0")
     if ctx.get_world_size() == 1:
         _validate_codec_opts(value, op, quantize, wire_dtype)
         return value
     from ray_tpu.dag.ring import _UNSET
+    if bucket_bytes is not None:
+        return _ring_call(
+            ctx, timeout_s, lambda ring: _bucketed_allreduce(
+                ring, value, op, quantize, wire_dtype, bucket_bytes),
+            bump_step=True)
     return _ring_call(ctx, timeout_s, lambda ring: ring.reduce(
         value, op=op,
         quantize=quantize if quantize is not None else _UNSET,
@@ -180,6 +436,7 @@ def allreduce_gradients(value: Any, op: str = "mean", *,
 
 def reduce_scatter_gradients(value: Any, op: str = "mean", *,
                              quantize: Optional[str] = None,
+                             bucket_bytes: Optional[int] = None,
                              timeout_s: Optional[float] = None):
     """Reduce-scatter a host gradient pytree across the train worker
     group: each worker receives ONLY its owned contiguous shard of the
@@ -190,9 +447,19 @@ def reduce_scatter_gradients(value: Any, op: str = "mean", *,
     ``ShardedOptimizer``). The flat layout is cached ring-side so a
     following ``allgather_params`` reassembles the full pytree.
 
+    ``bucket_bytes`` splits the pytree into leaf buckets and pipelines
+    staging against the ring (see ``allreduce_gradients``); the return
+    value is then the CONCATENATION of this rank's per-bucket owned
+    shards (each bucket's flat space split by ``seg_bounds``) — pass
+    it back to ``allgather_params`` unchanged, which reassembles via
+    the cached bucket layout. All ranks must pass the same
+    ``bucket_bytes``.
+
     world_size == 1 returns the whole flattened vector (the "shard" is
     everything)."""
     ctx = get_context()
+    if bucket_bytes is not None and bucket_bytes <= 0:
+        raise ValueError("bucket_bytes must be > 0")
     if ctx.get_world_size() == 1:
         _validate_codec_opts(value, op, quantize, None)
         import numpy as np
@@ -211,6 +478,12 @@ def reduce_scatter_gradients(value: Any, op: str = "mean", *,
                        for l in leaves]}
         return flat
     from ray_tpu.dag.ring import _UNSET
+    if bucket_bytes is not None:
+        # no bump: the ZeRO step's allgather half must share this tag
+        return _ring_call(
+            ctx, timeout_s, lambda ring: _bucketed_reduce_scatter(
+                ctx, ring, value, op, quantize, bucket_bytes))
+    ctx._bucketed_rs = None      # an unbucketed RS retires stale meta
     # no bump: the ZeRO step's allgather half must share this tag
     return _ring_call(ctx, timeout_s, lambda ring: ring.reduce_scatter(
         value, op=op,
@@ -219,7 +492,8 @@ def reduce_scatter_gradients(value: Any, op: str = "mean", *,
 
 def allgather_params(shard, *, wire_dtype: Optional[str] = None,
                      timeout_s: Optional[float] = None,
-                     total_hint: Optional[int] = None):
+                     total_hint: Optional[int] = None,
+                     bucket_bytes: Optional[int] = None):
     """Allgather each worker's owned flat shard back into the full
     value: the ZeRO-1 parameter reassembly. When the ring holds a
     layout cached by a previous ``reduce_scatter_gradients``, the full
@@ -232,10 +506,18 @@ def allgather_params(shard, *, wire_dtype: Optional[str] = None,
     half the fp32 wire bytes, one rounding event, bitwise identical on
     every rank (the shard owner round-trips its own copy).
 
+    After a BUCKETED ``reduce_scatter_gradients`` (matching
+    ``bucket_bytes``, or the shard length matching the cached bucket
+    layout), the concatenated per-bucket shards are split back, each
+    bucket allgathers (pipelined), and the full pytree reassembles —
+    bitwise identical to the unbucketed path.
+
     world_size == 1 rebuilds locally — applying the same single
     wire-dtype rounding, so 1-worker runs reproduce the sharded
     numerics."""
     ctx = get_context()
+    if bucket_bytes is not None and bucket_bytes <= 0:
+        raise ValueError("bucket_bytes must be > 0")
     if ctx.get_world_size() == 1:
         import numpy as np
         from ray_tpu.dag.ring import resolve_wire_dtype
@@ -262,6 +544,29 @@ def allgather_params(shard, *, wire_dtype: Optional[str] = None,
         from ray_tpu.dag.ring import rebuild_from_layout
         return rebuild_from_layout(flat, layout)
     from ray_tpu.dag.ring import _UNSET
+    meta = getattr(ctx, "_bucketed_rs", None)
+    if meta is not None:
+        n_el = int(np.asarray(shard).size)
+        if bucket_bytes is not None:
+            use = meta["bucket_bytes"] == bucket_bytes
+        elif total_hint is not None:
+            use = total_hint == meta["total"]
+        else:
+            # no explicit pin: match by this rank's summed per-bucket
+            # owned length (same stale-layout guard as the flat path)
+            owned = 0
+            try:
+                ring = ctx.gradient_sync_ring()
+                owned = sum((lambda b: b[1] - b[0])(ring.seg_bounds(t))
+                            for t in meta["totals"])
+            except Exception:   # noqa: BLE001 — fall through unmatched
+                pass
+            use = owned == n_el and owned > 0
+        if use:
+            return _ring_call(
+                ctx, timeout_s, lambda ring: _bucketed_allgather(
+                    ctx, ring, shard, wire_dtype, meta),
+                bump_step=True)
     return _ring_call(ctx, timeout_s, lambda ring: ring.allgather(
         shard,
         wire_dtype=wire_dtype if wire_dtype is not None else _UNSET,

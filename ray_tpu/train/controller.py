@@ -285,15 +285,31 @@ class TrainController:
         — train/zero.py), identity rotation rank->segment today.
         TrainContext.shard_bounds and the ring validate against it, so
         a restarted/resized incarnation re-derives a consistent
-        ownership split from its own spec instead of assuming one."""
+        ownership split from its own spec instead of assuming one.
+
+        When the group spans more than one node AND some node hosts
+        two or more ranks (and Config.collective_hierarchy allows it),
+        the specs describe a TWO-LEVEL topology instead — per-node shm
+        intra rings, one TCP ring over the node leaders, intra
+        broadcast (dag/ring.py HierarchicalReducer): cross-node wire
+        traffic drops to ~1/ranks-per-node, and wire codecs apply on
+        the cross-node leg only."""
         n = len(self._workers)
         if n < 2:
             return [None] * n
+        from ray_tpu.config import get_config
         from ray_tpu.dag.channel import new_tcp_spec
+        cfg = get_config()
         # 4 MB slots (the dag compiler's default): chunk frames are
         # clamped to the slot, and header/error frames (layout sig
         # scales with leaf count) need headroom beyond one chunk
         nslots, slot_bytes = 4, 4 << 20
+        tune = bool(getattr(cfg, "collective_tuner", True))
+        groups = self._node_groups()
+        hier = self._wants_hier(groups)
+        if hier:
+            return self._hier_sync_specs(group_id, groups, nslots,
+                                         slot_bytes, tune)
         edges = []
         for r in range(n):
             if self._infos[r]["node_id"] == \
@@ -305,12 +321,59 @@ class TrainController:
                 edges.append(new_tcp_spec(nslots, slot_bytes))
         return [{"rank": r, "size": n, "op": "mean",
                  "timeout_s": float(self.scaling.sync_timeout_s),
-                 "own": r,
+                 "own": r, "tune": tune,
                  # collective spans/flight dumps tag this group id, so
                  # timeline lanes and post-mortems name the incarnation
                  "group": group_id[:12],
                  "to_next": edges[r], "from_prev": edges[(r - 1) % n]}
                 for r in range(n)]
+
+    def _node_groups(self) -> List[list]:
+        """Contiguous per-node rank grouping [(node_id, [ranks])...]
+        of the CURRENT worker list (ranks are topology-sorted, so
+        same-node ranks are adjacent)."""
+        groups: List[list] = []
+        for r in range(len(self._workers)):
+            nid = self._infos[r]["node_id"]
+            if groups and groups[-1][0] == nid:
+                groups[-1][1].append(r)
+            else:
+                groups.append([nid, [r]])
+        return groups
+
+    def _wants_hier(self, groups: List[list]) -> bool:
+        """True when _grad_sync_specs would wire the two-level
+        topology for this grouping — the ONE condition, shared with
+        the reshape path so the recorded old split can't drift from
+        the specs that were actually wired."""
+        from ray_tpu.config import get_config
+        return getattr(get_config(), "collective_hierarchy",
+                       "auto") != "flat" \
+            and len(self._workers) >= 2 and len(groups) > 1 \
+            and max(len(g[1]) for g in groups) > 1
+
+    def _hier_sync_specs(self, group_id: str, groups: List[list],
+                         nslots: int, slot_bytes: int,
+                         tune: bool) -> List[dict]:
+        """Ring-of-rings channel specs via the shared builder
+        (dag/ring.py build_hier_specs): one lazy-shm intra ring per
+        node (consumer creates at attach, names unique per incarnation
+        + node + position), one TCP ring over the first rank of each
+        node (the elected leader — leaders are on distinct nodes by
+        construction, so every inter edge genuinely crosses nodes).
+        The tuner flag rides the INTER sub-ring: that leg owns the
+        cross-node wire the auto-tuner exists to optimize."""
+        from ray_tpu.dag.channel import new_tcp_spec
+        from ray_tpu.dag.ring import build_hier_specs
+        gid = group_id[:12]
+        return build_hier_specs(
+            [len(ranks) for _, ranks in groups],
+            lambda i, j: {"name": f"rtgi-{gid}-{i}-{j}",
+                          "nslots": nslots,
+                          "slot_bytes": slot_bytes, "lazy": True},
+            lambda i: new_tcp_spec(nslots, slot_bytes),
+            op="mean", timeout_s=float(self.scaling.sync_timeout_s),
+            group=gid, tune=tune)
 
     def _start_train(self):
         self._recover_latest_checkpoint()
@@ -611,6 +674,13 @@ class TrainController:
                 pass
         old_group = self._group_id
         old_n = len(self._workers)
+        # record the OLD incarnation's shard split BEFORE filtering:
+        # a hierarchical group owned the nested hier_seg_bounds split,
+        # and the reshard legality check must assess the lost rank's
+        # segment under THAT split, not the flat one
+        old_groups = self._node_groups()
+        old_nodes = [len(g[1]) for g in old_groups] \
+            if self._wants_hier(old_groups) else None
         # survivors keep their topology order, so adjacent new ranks
         # stay co-located wherever possible (same rule as create)
         self._workers = [self._workers[i] for i in survivors]
@@ -622,6 +692,7 @@ class TrainController:
         self._group_id = gid
         specs = self._grad_sync_specs(gid)
         lost = {int(d): {"old_rank": int(d), "old_size": old_n,
+                         "old_nodes": old_nodes,
                          "holder": assign.get(d)} for d in dead}
         refs = []
         for j, w in enumerate(self._workers):

@@ -41,10 +41,13 @@ class ReshardError(RuntimeError):
 
 def shard_bounds(total: int, size: int, rank: int) -> Tuple[int, int]:
     """(lo, hi) of segment ``rank`` in the canonical contiguous
-    ``size``-way split of a flat length-``total`` space — THE split
-    formula (identical to ``RingReducer.seg_bounds`` and
+    ``size``-way FLAT split of a length-``total`` space — identical to
+    ``RingReducer.seg_bounds`` and flat-ring
     ``TrainContext.shard_bounds``, duplicated here so planning stays
-    importable without a ring)."""
+    importable without a ring. HIERARCHICAL groups own the nested
+    split instead (``dag/ring.py hier_seg_bounds``): callers reasoning
+    about a hier incarnation's old shards must use the ``old_nodes``
+    counts the controller records in its lost-rank info."""
     if not 0 <= rank < size:
         raise ValueError(f"rank {rank} out of range for {size} shards")
     return total * rank // size, total * (rank + 1) // size

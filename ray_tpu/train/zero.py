@@ -140,11 +140,24 @@ class ShardedOptimizer:
         elastic reshard (``reshard``) reconstructs its segment from
         the mirror instead of falling back to a disk checkpoint
         restore. 0 disables mirroring.
+      bucket_bytes: split the gradient sync into leaf buckets of
+        about this size and PIPELINE them — the ring starts reducing
+        early buckets while later gradients are still being staged to
+        host (the hidden staging time lands in the
+        ``allreduce_bucket_overlap_s`` histogram). The optimizer
+        shard becomes the concatenation of per-bucket owned slices
+        (still 1/N of the space; all ranks stay bitwise identical —
+        vs the unbucketed step only the ring's reduction order over
+        each element can differ, the usual reshape rounding).
+        Incompatible with ``mirror_interval_steps``/``reshard`` (the
+        elastic plane assumes one contiguous shard): bucketed
+        optimizers recover via checkpoint restore.
     """
 
     def __init__(self, opt, *, param_wire_dtype: Optional[str] = None,
                  grad_quantize: Optional[str] = None, group=None,
-                 mirror_interval_steps: int = 0):
+                 mirror_interval_steps: int = 0,
+                 bucket_bytes: Optional[int] = None):
         if not hasattr(opt, "init") or not hasattr(opt, "update"):
             raise TypeError(
                 "ShardedOptimizer wraps an optax-style transformation "
@@ -159,6 +172,15 @@ class ShardedOptimizer:
         if mirror_interval_steps < 0:
             raise ValueError("mirror_interval_steps must be >= 0")
         self.mirror_interval_steps = int(mirror_interval_steps)
+        if bucket_bytes is not None and bucket_bytes <= 0:
+            raise ValueError("bucket_bytes must be > 0")
+        if bucket_bytes and mirror_interval_steps:
+            raise ValueError(
+                "bucket_bytes is incompatible with "
+                "mirror_interval_steps: peer checkpoints and the "
+                "elastic reshard assume one contiguous shard — "
+                "bucketed optimizers recover via checkpoint restore")
+        self.bucket_bytes = bucket_bytes
         self._g = group
         self._g_resolved = group is not None
         # generation of the train context the group was resolved
@@ -229,6 +251,18 @@ class ShardedOptimizer:
 
     # -- optax-compatible surface ------------------------------------------
 
+    def _bucket_layout(self, leaves):
+        """Per-bucket (leaf_lo, leaf_hi, total, owned_lo, owned_hi)
+        under the configured ``bucket_bytes`` — every rank derives the
+        identical cut from the layout alone."""
+        from ray_tpu.train.collective import _bucket_parts
+        out = []
+        for a, b in _bucket_parts(leaves, self.bucket_bytes):
+            tot = int(sum(l.size for l in leaves[a:b]))
+            lo, hi = self.shard_bounds(tot)
+            out.append((a, b, tot, lo, hi))
+        return out
+
     def init(self, params):
         """Optimizer state for this rank's parameter shard only —
         moment memory is 1/world_size of the replicated footprint
@@ -236,8 +270,20 @@ class ShardedOptimizer:
         leaves, _, _ = _flatten(params)
         wire = self._wire_of(leaves)
         total = int(sum(l.size for l in leaves))
-        lo, hi = self.shard_bounds(total)
         self._total = total
+        if self.bucket_bytes:
+            # the shard is the concatenation of per-bucket owned
+            # slices (non-contiguous in the full flat space, so the
+            # single-slice _bounds bookkeeping stays unset)
+            self._bounds = None
+            shard = np.concatenate(
+                [_slice_leaves(leaves[a:b], wire, lo, hi)
+                 for a, b, _, lo, hi in self._bucket_layout(leaves)]) \
+                if leaves else np.empty(0, wire)
+            state = self.opt.init(shard)
+            self._m["shard_bytes"].set(_tree_bytes(state))
+            return state
+        lo, hi = self.shard_bounds(total)
         self._bounds = (lo, hi)
         state = self.opt.init(_slice_leaves(leaves, wire, lo, hi))
         self._m["shard_bytes"].set(_tree_bytes(state))
@@ -272,6 +318,9 @@ class ShardedOptimizer:
             raise ValueError(
                 f"parameter count changed since init: "
                 f"{self._total} -> {total}")
+        if g is not None and self.bucket_bytes:
+            return self._update_bucketed(grads, state, leaves,
+                                         rebuild, wire, g)
         if g is None:
             gshard, _, gtotal, _ = _flat(grads, wire)
             lo, hi = 0, total
@@ -324,6 +373,71 @@ class ShardedOptimizer:
         if self.mirror_interval_steps and \
                 self._step % self.mirror_interval_steps == 0:
             self._mirror(new_state)
+        return new_params, new_state
+
+    def _update_bucketed(self, grads, state, leaves, rebuild, wire, g):
+        """One bucketed ZeRO-1 step: per-bucket reduce-scatter rounds
+        pipelined against gradient staging (early buckets reduce while
+        later grads are still being staged to host), ONE optimizer
+        update over the concatenated bucket shards, then per-bucket
+        parameter allgathers. Numerically identical to the unbucketed
+        step modulo the shard partitioning — each element reduces the
+        same way, just inside its bucket's round."""
+        from ray_tpu.train.collective import (_pipeline_buckets,
+                                              _raw_leaves, _stage)
+        buckets = self._bucket_layout(leaves)
+        graw = _raw_leaves(grads)
+        if len(graw) != len(leaves):
+            raise ValueError(
+                "gradient layout does not match the parameter layout")
+        q = self.grad_quantize if self.grad_quantize is not None \
+            else _UNSET
+
+        def stage(i):
+            a, b = buckets[i][0], buckets[i][1]
+            return [_stage(l) for l in graw[a:b]]
+
+        outs, _ = _pipeline_buckets(
+            len(buckets), stage,
+            lambda i, staged: self._wrap_peer_lost(
+                lambda: g.reduce_scatter(staged, op="mean",
+                                         quantize=q)))
+        lens = [hi - lo for _, _, _, lo, hi in buckets]
+        for o, ln in zip(outs, lens):
+            if np.asarray(o).size != ln:
+                raise ValueError(
+                    "gradient layout does not match the parameter "
+                    "layout (bucketed shard sizes differ)")
+        gshard = np.concatenate(
+            [np.asarray(o, dtype=wire) for o in outs]) \
+            if outs else np.empty(0, wire)
+        pshard = np.concatenate(
+            [_slice_leaves(leaves[a:b], wire, lo, hi)
+             for a, b, _, lo, hi in buckets]) \
+            if buckets else np.empty(0, wire)
+        updates, new_state = self.opt.update(gshard, state, pshard)
+        new_shard = pshard + np.asarray(updates, dtype=wire)
+        pieces, off = [], 0
+        for ln in lens:
+            pieces.append(np.ascontiguousarray(new_shard[off:off + ln]))
+            off += ln
+        wdt = self.param_wire_dtype
+        fulls, _ = _pipeline_buckets(
+            len(pieces), lambda i: pieces[i],
+            lambda i, piece: self._wrap_peer_lost(
+                lambda: g.allgather(
+                    piece,
+                    wire_dtype=wdt if wdt is not None else _UNSET,
+                    rebuild=False)))
+        # bucket cuts are leaf-aligned: per-bucket flats concatenate
+        # into the full flat value space in order
+        new_flat = np.concatenate(
+            [np.asarray(f, dtype=wire).reshape(-1) for f in fulls]) \
+            if fulls else np.empty(0, wire)
+        new_params = rebuild_from_layout(new_flat, {
+            "rebuild": rebuild,
+            "leaves": [(l.shape, l.size, l.dtype) for l in leaves]})
+        self._step += 1
         return new_params, new_state
 
     # -- elastic reshard + in-memory peer checkpoints ----------------------
@@ -409,6 +523,12 @@ class ShardedOptimizer:
         from ray_tpu.train import reshard as _rs
         from ray_tpu.train.api import get_context
         from ray_tpu.util import events
+        if self.bucket_bytes:
+            raise _rs.ReshardError(
+                "bucketed ShardedOptimizer cannot reshard in place "
+                "(per-bucket shards are not one contiguous segment of "
+                "the flat space) — let this propagate so the "
+                "controller restores from checkpoint")
         ctx = get_context()
         if getattr(self, "_total", None) is None or self._bounds is None:
             raise RuntimeError("reshard() before init()")
@@ -435,8 +555,16 @@ class ShardedOptimizer:
             if info.get("holder") is not None:
                 continue
             osz = int(info.get("old_size") or 1)
-            olo, ohi = _rs.shard_bounds(
-                total, osz, int(info.get("old_rank", d)))
+            onodes = info.get("old_nodes")
+            if onodes:
+                # the old incarnation was hierarchical: its shards
+                # followed the NESTED split, not the flat one
+                from ray_tpu.dag.ring import hier_seg_bounds
+                olo, ohi = hier_seg_bounds(
+                    total, onodes, int(info.get("old_rank", d)))
+            else:
+                olo, ohi = _rs.shard_bounds(
+                    total, osz, int(info.get("old_rank", d)))
             if olo < ohi:
                 raise _rs.ReshardError(
                     f"lost rank {d}'s optimizer shard [{olo}, {ohi}) "

@@ -90,6 +90,10 @@ def collectives_from_events(events, limit: int = 50) -> List[dict]:
         rows.append({
             "kind": e.get("kind", "?"),
             "op": e.get("op"),
+            # hierarchy level of the sub-ring that recorded the span
+            # (intra/inter/bcast; None for a flat ring) — keeps the
+            # table and straggler reads from cross-wiring the levels
+            "level": e.get("level"),
             "group": e.get("group"),
             "cid": e.get("cid"),
             "rank": e.get("rank"),
@@ -115,8 +119,10 @@ def summarize_collectives(rows: List[dict]) -> List[dict]:
     (the one to go look at first)."""
     agg: dict = {}
     for r in rows:
-        a = agg.setdefault((r["kind"], r["op"], r["codec"]), {
+        lv = r.get("level")
+        a = agg.setdefault((r["kind"], r["op"], r["codec"], lv), {
             "kind": r["kind"], "op": r["op"], "codec": r["codec"],
+            "level": lv,
             "rounds": 0, "total_s": 0.0, "max_s": 0.0, "bytes": 0,
             "errors": 0, "stragglers": {}})
         a["rounds"] += 1

@@ -178,6 +178,208 @@ def run_config(mode: str, size_mb: int, nparts: int, rounds: int,
     return res
 
 
+# --- hierarchical (ring-of-rings) bench ----------------------------------
+
+
+def _hier_participant(spec, rank, nbytes, rounds, out_q):
+    """One process, one world rank of a hierarchical group, through
+    the real _Collective (role "hier"). Reports total wire bytes AND
+    the cross-node (inter-leg) bytes the ring-of-rings exists to
+    shrink — metered by allreduce_hier_inter_bytes_total."""
+    from ray_tpu.dag.channel import DATA
+    from ray_tpu.dag.ring import allreduce_metrics
+    from ray_tpu.dag.runtime import _Collective
+
+    n = nbytes // 4
+    # integer-valued fp32: sums are exact, so flat-vs-hier parity is
+    # BITWISE checkable on rank 0
+    value = np.round(np.random.default_rng(rank)
+                     .standard_normal(n) * 8).astype(np.float32)
+    coll = _Collective(spec)
+    metrics = allreduce_metrics()
+    kind, frame = coll.round(DATA, value, None)        # warmup/attach
+    assert kind == DATA
+    wire0 = sum(metrics["bytes"]._values.values())
+    x0 = sum(metrics["hier_inter_bytes"]._values.values())
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        kind, frame = coll.round(DATA, value, None)
+        assert kind == DATA
+    elapsed = time.perf_counter() - t0
+    wire = sum(metrics["bytes"]._values.values()) - wire0
+    inter = sum(metrics["hier_inter_bytes"]._values.values()) - x0
+    out = {"rank": rank, "elapsed_s": elapsed,
+           "wire_bytes": wire / rounds,
+           "inter_bytes": inter / rounds, "digest": None}
+    if rank == 0:
+        from ray_tpu.runtime.serialization import loads_oob
+        got = np.asarray(loads_oob(frame.to_bytes()), np.float64)
+        out["digest"] = float(got.sum())
+        exact = np.zeros(n, np.float64)
+        for r in range(sum(spec["nodes"])):
+            exact += np.round(np.random.default_rng(r)
+                              .standard_normal(n) * 8)
+        out["max_err"] = float(np.abs(got - exact).max())
+    out_q.put(out)
+    for ch in coll.channels():
+        ch.close()
+
+
+def _mk_hier_specs(counts, shm, quantize=None):
+    """Controller-shaped hier specs via the shared builder
+    (dag/ring.py build_hier_specs), over bench shm channels (transport
+    is opaque to the reducers; the inter ring's bytes are metered
+    separately, which is what the cross-node claim is about)."""
+    from ray_tpu.dag.ring import build_hier_specs
+    return build_hier_specs(
+        counts,
+        lambda i, j: shm(8, 2 * MB),
+        lambda i: shm(8, 2 * MB),
+        op="sum", timeout_s=300.0, group="bh", quantize=quantize)
+
+
+def run_hier_config(size_mb, counts, rounds, quantize=None) -> dict:
+    from ray_tpu.dag.channel import ShmRingChannel
+
+    nbytes = size_mb * MB
+    channels = []
+
+    def shm(nslots, slot_bytes):
+        ch = ShmRingChannel(create=True, nslots=nslots,
+                            slot_bytes=slot_bytes)
+        channels.append(ch)
+        return ch.spec()
+
+    specs = _mk_hier_specs(counts, shm, quantize)
+    nparts = sum(counts)
+    ctx = mp.get_context("fork")
+    out_q = ctx.Queue()
+    procs = [ctx.Process(target=_hier_participant,
+                         args=(specs[r], r, nbytes, rounds, out_q))
+             for r in range(nparts)]
+    for p in procs:
+        p.start()
+    outs = [out_q.get(timeout=900) for _ in range(nparts)]
+    for p in procs:
+        p.join(timeout=60)
+    for ch in channels:
+        ch.close()
+        ch.unlink()
+    r0 = next(o for o in outs if o["rank"] == 0)
+    round_s = max(o["elapsed_s"] for o in outs) / rounds
+    return {"mode": "hier" + ("_" + quantize if quantize else ""),
+            "size_mb": size_mb, "nodes": list(counts),
+            "participants": nparts, "rounds": rounds,
+            "round_s": round(round_s, 4),
+            "algbw_gbps": round(nbytes / round_s / 1e9, 3),
+            "wire_bytes_per_participant": int(max(
+                o["wire_bytes"] for o in outs)),
+            "cross_node_bytes": int(sum(
+                o["inter_bytes"] for o in outs)),
+            "max_elementwise_err": r0.get("max_err"),
+            "digest": r0["digest"]}
+
+
+def run_hierarchy(quick: bool) -> dict:
+    """flat-vs-hier cross-node byte accounting per payload size and
+    transport mix, plus the in-situ tuner's chosen regimes per band —
+    the --hierarchy artifact (merged into ALLREDUCE_BENCH.json).
+
+    Cross-node bytes for the flat ring are exact by construction: its
+    per-edge bytes are uniform (the measured per-participant wire),
+    so a placement with E cross-node edges moves wire*E across nodes.
+    Two placements are reported: "sorted" (topology-sorted ranks — L
+    boundary edges, what the train controller wires) and "blind" (the
+    topology-ignorant ring of the motivation — every edge potentially
+    crosses, the worst case a dag compile with arbitrary participant
+    order can produce)."""
+    from ray_tpu.dag import tuner
+
+    layouts = [(64, [2, 2], 2)] if quick else \
+        [(8, [2, 2], 3), (64, [2, 2], 2), (64, [2, 4], 2)]
+    results = []
+    for size_mb, counts, rounds in layouts:
+        L, n = len(counts), sum(counts)
+        flat = run_config("ring", size_mb, n, rounds)
+        results.append(flat)
+        print(json.dumps(flat), file=sys.stderr, flush=True)
+        hier = run_hier_config(size_mb, counts, rounds)
+        results.append(hier)
+        print(json.dumps(hier), file=sys.stderr, flush=True)
+        wire = flat["wire_bytes_per_participant"]
+        hier.update(
+            flat_cross_sorted_bytes=wire * L,
+            flat_cross_blind_bytes=wire * n,
+            hier_vs_flat_sorted_tcp_fraction=round(
+                hier["cross_node_bytes"] / (wire * L), 3),
+            hier_vs_flat_blind_tcp_fraction=round(
+                hier["cross_node_bytes"] / (wire * n), 3))
+    # int8 on the cross-node leg only
+    q = run_hier_config(8 if quick else 64, [2, 2], 2,
+                        quantize="int8")
+    results.append(q)
+    print(json.dumps(q), file=sys.stderr, flush=True)
+
+    # --- tuner: probe a live ring in situ, record the chosen regimes
+    from ray_tpu.dag.channel import ShmRingChannel
+    from ray_tpu.dag.ring import RingReducer
+    import threading
+    chans = [ShmRingChannel(create=True, nslots=8, slot_bytes=2 * MB)
+             for _ in range(4)]
+    reds = [RingReducer(chans[r], chans[(r - 1) % 4], rank=r, size=4,
+                        timeout_s=120.0, group="bench-tuned")
+            for r in range(4)]
+    ths = [threading.Thread(target=tuner.probe_ring, args=(g,))
+           for g in reds[1:]]
+    for t in ths:
+        t.start()
+    prof = tuner.probe_ring(reds[0])
+    for t in ths:
+        t.join()
+    for ch in chans:
+        ch.close()
+        ch.unlink()
+    bands = tuner.table("bench-tuned", 4, hierarchical=True)
+    # sample each measured band at its midpoint: the tuner must pick
+    # star, flat ring, and hierarchical across the three bands
+    s_star = bands[0]["max_bytes"]
+    s_hier = bands[1]["max_bytes"]
+    samples = (max(4096, s_star // 2),
+               int((s_star * s_hier) ** 0.5), 4 * s_hier)
+    regimes = []
+    for pb in samples:
+        impl = tuner.choose_impl(pb, 4, hierarchical=True,
+                                 key="bench-tuned")
+        regimes.append({"payload_bytes": int(pb), "impl": impl,
+                        "chunk_bytes": tuner.tuned_chunk(
+                            "bench-tuned", 4, pb, 2 * MB)})
+    hl = next(r for r in results
+              if r["mode"] == "hier" and r["size_mb"] >= (8 if quick
+                                                          else 64)
+              and r["nodes"] == [2, 2])
+    flat_hl = next(r for r in results
+                   if r["mode"] == "ring"
+                   and r["size_mb"] == hl["size_mb"]
+                   and r["participants"] == 4)
+    return {
+        "bench": "allreduce_hierarchy",
+        "transport": "shm (inter leg metered separately)",
+        "results": results,
+        "tuner_profile": {"alpha_s": round(prof["alpha_s"], 6),
+                          "beta_s_per_gb": round(
+                              prof["beta_s_per_b"] * 1e9, 4)},
+        "tuner_bands": bands,
+        "tuner_regimes": regimes,
+        "hier_cross_node_bytes_64mb_2x2": hl["cross_node_bytes"],
+        "hier_vs_flat_sorted_tcp_fraction_64mb_2x2":
+            hl["hier_vs_flat_sorted_tcp_fraction"],
+        "hier_vs_flat_blind_tcp_fraction_64mb_2x2":
+            hl["hier_vs_flat_blind_tcp_fraction"],
+        "hier_round_vs_flat_64mb_2x2": round(
+            hl["round_s"] / flat_hl["round_s"], 3),
+    }
+
+
 # --- ZeRO-1 sharded-optimizer bench --------------------------------------
 
 
@@ -277,6 +479,92 @@ def _zero_participant(mode: str, spec: dict, rank: int, nbytes: int,
     out_q.put(out)
     for ch in ring.channels():
         ch.close()
+
+
+def _zero_bucketed_participant(spec, rank, nbytes, rounds, out_q):
+    """Bucketed ZeRO step vs its own unbucketed twin on the SAME ring
+    topology: params are 16 equal leaves so the bucket pipeline has
+    real staging to hide; reports step times and the overlap the
+    allreduce_bucket_overlap_s histogram measured."""
+    import optax
+
+    from ray_tpu.dag.ring import RingReducer, allreduce_metrics
+    from ray_tpu.train.zero import ShardedOptimizer
+
+    n_el = nbytes // 4
+    nleaves = 16
+    rows = n_el // nleaves // 8
+    shape = (rows, 8)
+    params = [np.random.default_rng(1234).standard_normal(shape)
+              .astype(np.float32) for _ in range(nleaves)]
+    # grads are NON-contiguous views (a transpose), so staging them to
+    # the wire pays a real per-leaf copy — the host-staging cost that
+    # bucketed sync hides under in-flight ring rounds (the same shape
+    # of cost a jax device->host transfer has)
+    grads = [np.random.default_rng(rank)
+             .standard_normal(shape[::-1]).astype(np.float32).T
+             for _ in range(nleaves)]
+    ring = RingReducer.from_spec(spec)
+    metrics = allreduce_metrics()
+    out = {"rank": rank}
+    for tag, bb in (("unbucketed", None), ("bucketed", 4 * MB)):
+        so = ShardedOptimizer(optax.adamw(1e-3), group=ring,
+                              bucket_bytes=bb)
+        state = so.init(params)
+        p = params
+        p, state = so.update(grads, state, p)          # warmup
+        ov0 = sum(metrics["bucket_overlap"]._sums.values())
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            p, state = so.update(grads, state, p)
+        out[f"{tag}_step_s"] = (time.perf_counter() - t0) / rounds
+        out[f"{tag}_overlap_s"] = (sum(
+            metrics["bucket_overlap"]._sums.values()) - ov0) / rounds
+    out_q.put(out)
+    for ch in ring.channels():
+        ch.close()
+
+
+def run_zero_bucketed(size_mb: int = 64, nparts: int = 4,
+                      rounds: int = 2) -> dict:
+    """The ZERO_BENCH bucketed-overlap row: bucketed vs unbucketed
+    sharded steps at the headline size."""
+    from ray_tpu.dag.channel import ShmRingChannel
+
+    nbytes = size_mb * MB
+    channels = []
+    edges = []
+    for _ in range(nparts):
+        ch = ShmRingChannel(create=True, nslots=8, slot_bytes=2 * MB)
+        channels.append(ch)
+        edges.append(ch.spec())
+    specs = [{"rank": r, "size": nparts, "op": "sum",
+              "timeout_s": 300.0,
+              "to_next": edges[r], "from_prev": edges[(r - 1) % nparts]}
+             for r in range(nparts)]
+    ctx = mp.get_context("fork")
+    out_q = ctx.Queue()
+    procs = [ctx.Process(target=_zero_bucketed_participant,
+                         args=(specs[r], r, nbytes, rounds, out_q))
+             for r in range(nparts)]
+    for p in procs:
+        p.start()
+    outs = [out_q.get(timeout=900) for _ in range(nparts)]
+    for p in procs:
+        p.join(timeout=60)
+    for ch in channels:
+        ch.close()
+        ch.unlink()
+    row = {"mode": "zero_bucketed_overlap", "size_mb": size_mb,
+           "participants": nparts, "rounds": rounds,
+           "bucket_bytes": 4 * MB,
+           "unbucketed_step_s": round(max(
+               o["unbucketed_step_s"] for o in outs), 4),
+           "bucketed_step_s": round(max(
+               o["bucketed_step_s"] for o in outs), 4),
+           "bucket_overlap_s_per_step": round(max(
+               o["bucketed_overlap_s"] for o in outs), 4)}
+    return row
 
 
 def run_zero_config(mode: str, size_mb: int, nparts: int,
@@ -464,7 +752,58 @@ def main():
     ap.add_argument("--trace-overhead", action="store_true",
                     help="A/B trace_level off/round/chunk on the ring "
                          "hot path; writes COLLECTIVE_TRACE_BENCH.json")
+    ap.add_argument("--hierarchy", action="store_true",
+                    help="flat-vs-hierarchical cross-node byte "
+                         "accounting per payload/transport mix + the "
+                         "in-situ tuner's regimes; merged into "
+                         "ALLREDUCE_BENCH.json under 'hierarchy'")
+    ap.add_argument("--zero-bucketed", action="store_true",
+                    help="bucketed-vs-unbucketed ZeRO step overlap "
+                         "row; merged into ZERO_BENCH.json")
     args = ap.parse_args()
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    if args.hierarchy:
+        summary = run_hierarchy(args.quick)
+        out = os.path.join(root, "ALLREDUCE_BENCH.json")
+        try:
+            with open(out) as f:
+                base = json.load(f)
+        except Exception:
+            base = {}
+        base["hierarchy"] = summary
+        with open(out, "w") as f:
+            json.dump(base, f)
+            f.write("\n")
+        print(json.dumps(summary), flush=True)
+        return
+
+    if args.zero_bucketed:
+        size_mb = 8 if args.quick else 64
+        row = run_zero_bucketed(size_mb)
+        out = os.path.join(root, "ZERO_BENCH.json")
+        try:
+            with open(out) as f:
+                base = json.load(f)
+        except Exception:
+            base = {"bench": "zero", "results": []}
+        # one row per size: a re-run replaces, never duplicates
+        base["results"] = [r for r in base.get("results", [])
+                           if not (r.get("mode") == row["mode"]
+                                   and r.get("size_mb") == size_mb)]
+        base["results"].append(row)
+        # headline keys are labeled with the size actually measured —
+        # a --quick run must not overwrite the 64 MB numbers
+        base[f"zero_bucketed_overlap_s_{size_mb}mb_4p"] = \
+            row["bucket_overlap_s_per_step"]
+        base[f"zero_bucketed_step_vs_unbucketed_{size_mb}mb_4p"] = \
+            round(row["bucketed_step_s"] / row["unbucketed_step_s"], 3)
+        with open(out, "w") as f:
+            json.dump(base, f)
+            f.write("\n")
+        print(json.dumps(row), flush=True)
+        return
 
     if args.trace:
         write_trace(args.trace)

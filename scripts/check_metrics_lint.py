@@ -165,18 +165,26 @@ def chaos_knobs() -> list:
                   and f.name.endswith("_failure"))
 
 
-def lint_chaos_knob_tests(tests_dir: str = None,
-                          knobs: list = None) -> list:
-    """Violations for chaos config knobs no pytest exercises: a fault-
-    injection plane nothing injects through rots silently — the rule
-    (reference: rpc_chaos.h is exercised by its own gtest) is that
-    every ``testing_*_failure`` knob appears in at least one test
-    module (by name or RAY_TPU_* env form)."""
+def tuner_knobs() -> list:
+    """Every ``collective_tuner*`` auto-tuner knob in
+    ray_tpu/config.py Config (master switch, probe payload, chunk
+    floor, ...)."""
+    from dataclasses import fields
+
+    from ray_tpu.config import Config
+    return sorted(f.name for f in fields(Config)
+                  if f.name.startswith("collective_tuner"))
+
+
+def _lint_knob_tests(label: str, knobs: list,
+                     tests_dir: str = None) -> list:
+    """THE knob-coverage scan both knob lints share: every named
+    Config knob must appear in at least one test module (by name or
+    RAY_TPU_* env form) — a config surface nothing exercises rots
+    silently."""
     if tests_dir is None:
         tests_dir = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "tests")
-    if knobs is None:
-        knobs = chaos_knobs()
     blob = []
     for fname in sorted(os.listdir(tests_dir)):
         if fname.endswith(".py"):
@@ -185,10 +193,28 @@ def lint_chaos_knob_tests(tests_dir: str = None,
                 blob.append(f.read())
     blob = "\n".join(blob)
     return sorted(
-        f"chaos knob {k!r} (ray_tpu/config.py) has no test exercising "
-        f"it under tests/"
+        f"{label} knob {k!r} (ray_tpu/config.py) has no test "
+        f"exercising it under tests/"
         for k in knobs
         if k not in blob and f"RAY_TPU_{k.upper()}" not in blob)
+
+
+def lint_tuner_knob_tests(tests_dir: str = None,
+                          knobs: list = None) -> list:
+    """Violations for collective-tuner config knobs no pytest
+    exercises (every ``collective_tuner*`` knob, same rule as the
+    chaos knobs)."""
+    return _lint_knob_tests(
+        "tuner", tuner_knobs() if knobs is None else knobs, tests_dir)
+
+
+def lint_chaos_knob_tests(tests_dir: str = None,
+                          knobs: list = None) -> list:
+    """Violations for chaos config knobs no pytest exercises
+    (reference: rpc_chaos.h is exercised by its own gtest): every
+    ``testing_*_failure`` knob."""
+    return _lint_knob_tests(
+        "chaos", chaos_knobs() if knobs is None else knobs, tests_dir)
 
 
 def main() -> int:
@@ -199,6 +225,7 @@ def main() -> int:
     errors += lint_event_categories(found)
     errors += lint_category_caps()
     errors += lint_chaos_knob_tests()
+    errors += lint_tuner_knob_tests()
     if errors:
         print(f"{len(errors)} metric/event lint violation(s):")
         for e in errors:

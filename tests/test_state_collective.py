@@ -188,16 +188,47 @@ def _ring_specs(n, prefix):
 
 
 def test_train_controller_grad_sync_spec_topology():
-    """Controller spec construction (no cluster): same-node adjacent
-    ranks get lazy shm edges, cross-node pairs get TCP, and every
-    rank's from_prev is its predecessor's to_next."""
+    """Controller spec construction (no cluster): a multi-node group
+    with co-located pairs wires the TWO-LEVEL topology (lazy-shm intra
+    rings, TCP ring over node leaders); collective_hierarchy="flat"
+    keeps the one-level ring — same-node adjacent ranks get lazy shm
+    edges, cross-node pairs get TCP, every rank's from_prev is its
+    predecessor's to_next."""
+    from ray_tpu.config import get_config
+    from ray_tpu.train.api import ScalingConfig
     from ray_tpu.train.controller import TrainController
 
     ctrl = TrainController.__new__(TrainController)
+    ctrl.scaling = ScalingConfig(num_workers=4)
     ctrl._workers = [object()] * 4
     ctrl._infos = [{"node_id": "nodeA"}, {"node_id": "nodeA"},
                    {"node_id": "nodeB"}, {"node_id": "nodeB"}]
+    # default ("auto"): 2 nodes x 2 ranks -> ring-of-rings
     specs = ctrl._grad_sync_specs("feedcafe" * 4)
+    assert len(specs) == 4
+    for r, s in enumerate(specs):
+        assert (s["rank"], s["size"]) == (r, 4)
+        assert s["role"] == "hier" and s["nodes"] == [2, 2]
+    assert [s["node"] for s in specs] == [0, 0, 1, 1]
+    assert [s["local"] for s in specs] == [0, 1, 0, 1]
+    for s in specs:       # intra edges: same-node shm, lazily created
+        assert s["intra"]["to_next"].get("lazy")
+        assert s["intra"]["level"] == "intra"
+    # leaders (local 0) carry the TCP inter ring; members don't
+    assert specs[0]["inter"]["to_next"].get("type") == "tcp"
+    assert specs[2]["inter"]["to_next"].get("type") == "tcp"
+    assert specs[0]["inter"]["level"] == "inter"
+    assert specs[1]["inter"] is None and specs[3]["inter"] is None
+    assert specs[0]["inter"]["from_prev"] == \
+        specs[2]["inter"]["to_next"]
+    # forced flat: the one-level ring with per-edge transport choice
+    cfg = get_config()
+    saved = cfg.collective_hierarchy
+    cfg.collective_hierarchy = "flat"
+    try:
+        specs = ctrl._grad_sync_specs("feedcafe" * 4)
+    finally:
+        cfg.collective_hierarchy = saved
     assert len(specs) == 4
     for r, s in enumerate(specs):
         assert (s["rank"], s["size"]) == (r, 4)
@@ -207,6 +238,11 @@ def test_train_controller_grad_sync_spec_topology():
     assert specs[2]["to_next"].get("lazy")
     assert specs[1]["to_next"].get("type") == "tcp"
     assert specs[3]["to_next"].get("type") == "tcp"
+    # all ranks on ONE node: no hierarchy to build, flat ring as-is
+    ctrl._infos = [{"node_id": "nodeA"}] * 4
+    specs = ctrl._grad_sync_specs("feedcafe" * 4)
+    assert all(s.get("role") != "hier" for s in specs)
+    assert all(s["to_next"].get("lazy") for s in specs)
     # single worker: nothing to wire
     ctrl._workers = [object()]
     assert ctrl._grad_sync_specs("x" * 32) == [None]

@@ -1,0 +1,632 @@
+"""Topology-aware hierarchical collectives (dag/ring.py
+HierarchicalReducer), bucketed gradient sync (train/collective.py),
+and the in-situ auto-tuner (dag/tuner.py): ring-of-rings parity vs the
+flat ring, zero-size shards, leader death mid-inter-ring, bucketed ==
+unbucketed, tuner bands + cache invalidation per ring generation.
+Channel-level with thread participants (tier-1, CPU), like
+test_zero_collective_ops.py.
+
+Named late in the alphabet ON PURPOSE: tier-1 is wall-clock bounded
+(870s DOTS_PASSED cutoff) and new modules must not shift earlier
+modules out of the window.
+"""
+
+import json
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from ray_tpu.dag import tuner
+from ray_tpu.dag.channel import ShmRingChannel
+from ray_tpu.dag.ring import (HierarchicalReducer, RingPeerDead,
+                              RingReducer, hier_seg_bounds)
+from ray_tpu.util import events
+
+
+@pytest.fixture(autouse=True)
+def _clean_tuner_and_events():
+    tuner.invalidate()
+    events.clear()
+    yield
+    tuner.invalidate()
+    events.clear()
+
+
+def _mk_chan():
+    return ShmRingChannel(create=True, nslots=4, slot_bytes=1 << 20)
+
+
+def _make_hier(counts, timeout=5.0, group="hg", **inter_kw):
+    """Thread-shaped 2-level group: one intra shm ring per multi-rank
+    node, one shm "inter" ring over the leaders (transport is opaque
+    to the reducers). Yields the world's HierarchicalReducers."""
+    L = len(counts)
+    intra_ch = {i: [_mk_chan() for _ in range(k)] if k > 1 else []
+                for i, k in enumerate(counts)}
+    inter_ch = [_mk_chan() for _ in range(L)]
+    chans = [c for v in intra_ch.values() for c in v] + inter_ch
+    reds = []
+    for i, k in enumerate(counts):
+        for j in range(k):
+            intra = None
+            if k > 1:
+                intra = RingReducer(
+                    intra_ch[i][j], intra_ch[i][(j - 1) % k],
+                    rank=j, size=k, timeout_s=timeout,
+                    group=f"{group}.n{i}", level="intra")
+            inter = None
+            if j == 0:
+                inter = RingReducer(
+                    inter_ch[i], inter_ch[(i - 1) % L],
+                    rank=i, size=L, timeout_s=timeout,
+                    group=f"{group}.x", level="inter", **inter_kw)
+            reds.append(HierarchicalReducer(
+                node=i, local=j, node_counts=counts, intra=intra,
+                inter=inter, op="mean", timeout_s=timeout, group=group))
+    try:
+        yield reds
+    finally:
+        for c in chans:
+            c.close()
+            c.unlink()
+
+
+def _make_flat(n, timeout=5.0, **kw):
+    chans = [_mk_chan() for _ in range(n)]
+    reds = [RingReducer(chans[r], chans[(r - 1) % n], rank=r, size=n,
+                        timeout_s=timeout, **kw) for r in range(n)]
+    try:
+        yield reds
+    finally:
+        for c in chans:
+            c.close()
+            c.unlink()
+
+
+def _all(reds, fn):
+    with ThreadPoolExecutor(len(reds)) as ex:
+        return list(ex.map(fn, reds))
+
+
+def _int_vals(n_ranks, n_el=1003, extra=5):
+    """Integer-valued fp32 pytrees: sums are exact in any association
+    order, so the flat ring and the ring-of-rings must agree BITWISE."""
+    rng = np.random.default_rng(7)
+    return [{"w": np.round(rng.standard_normal(n_el) * 8)
+             .astype(np.float32),
+             "b": np.arange(extra, dtype=np.float32) * (r + 1)}
+            for r in range(n_ranks)]
+
+
+# --- topology / bounds ---------------------------------------------------
+
+
+def test_hier_seg_bounds_tile_and_nest():
+    """The nested two-level split tiles the flat space for even AND
+    uneven node shapes, and nests with the sub-rings' own splits
+    (which the flat N-way split provably does not, e.g. total=2 over
+    3x2 ranks)."""
+    for total in (0, 1, 2, 5, 17, 1003, 12345):
+        for counts in ([2, 2], [3, 1], [2, 2, 2], [1, 1], [4, 2, 1]):
+            n = sum(counts)
+            bounds = [hier_seg_bounds(total, counts, r)
+                      for r in range(n)]
+            assert bounds[0][0] == 0 and bounds[-1][1] == total
+            for (a, b), (c, d) in zip(bounds, bounds[1:]):
+                assert a <= b == c <= d
+    with pytest.raises(ValueError, match="out of range"):
+        hier_seg_bounds(10, [2, 2], 4)
+
+
+# --- parity vs the flat ring ---------------------------------------------
+
+
+def test_hier_allreduce_bitwise_parity_vs_flat_2x2():
+    """2 nodes x 2 ranks: fused hierarchical mean equals the flat
+    ring's BITWISE on exactly-representable data, and all ranks are
+    bitwise identical to each other."""
+    gen = _make_hier([2, 2])
+    reds = next(gen)
+    vals = _int_vals(4)
+    outs = _all(reds, lambda g: g.reduce(vals[g.rank], op="mean"))
+    fgen = _make_flat(4)
+    flat = next(fgen)
+    fouts = _all(flat, lambda g: g.reduce(vals[g.rank], op="mean"))
+    for o in outs:
+        assert np.array_equal(o["w"], fouts[0]["w"])
+        assert np.array_equal(o["b"], fouts[0]["b"])
+        assert o["w"].dtype == np.float32
+    gen.close()
+    fgen.close()
+
+
+def test_hier_codecs_on_inter_leg_bitwise_identical_across_ranks():
+    """int8 / bf16 wire codecs ride the cross-node leg only: results
+    stay bitwise identical across ALL ranks (owner round-trip +
+    verbatim broadcast), and the int8 error stays within the
+    documented (L * max_scale)/2-style bound."""
+    vals = _int_vals(4, n_el=2048, extra=0)
+    exact = sum(v["w"].astype(np.float64) for v in vals) / 4
+    for codec_kw in ({"quantize": "int8"}, {"wire_dtype": "bfloat16"}):
+        gen = _make_hier([2, 2])
+        reds = next(gen)
+        outs = _all(reds, lambda g: g.reduce(
+            vals[g.rank], op="mean", **codec_kw))
+        for o in outs[1:]:
+            assert np.array_equal(o["w"], outs[0]["w"])
+        err = np.abs(outs[0]["w"].astype(np.float64) - exact).max()
+        assert err < 0.25, (codec_kw, err)   # quantized, not garbage
+        gen.close()
+    # fp32 control: exact
+    gen = _make_hier([2, 2])
+    reds = next(gen)
+    outs = _all(reds, lambda g: g.reduce(vals[g.rank], op="mean"))
+    assert np.array_equal(outs[0]["w"], exact.astype(np.float32))
+    gen.close()
+
+
+def test_hier_reduce_scatter_allgather_roundtrip_uneven_nodes():
+    """Standalone RS -> AG over an UNEVEN 3+1 topology: shards tile
+    the flat space at hier_seg_bounds, the allgather rebuilds the full
+    pytree with input leaf dtypes."""
+    counts = [3, 1]
+    gen = _make_hier(counts)
+    reds = next(gen)
+    vals = _int_vals(4)
+    shards = _all(reds, lambda g: g.reduce_scatter(
+        vals[g.rank], op="sum"))
+    total = 1008
+    exact = np.concatenate(
+        [sum(v["w"].astype(np.float64) for v in vals),
+         sum(v["b"].astype(np.float64) for v in vals)])
+    for r, s in enumerate(shards):
+        lo, hi = hier_seg_bounds(total, counts, r)
+        assert s.size == hi - lo
+        assert np.array_equal(np.asarray(s, np.float64), exact[lo:hi])
+    fulls = _all(reds, lambda g: g.allgather(shards[g.rank]))
+    for f in fulls:
+        assert np.array_equal(
+            f["w"], exact[:1003].astype(np.float32))
+        assert f["b"].dtype == np.float32
+    gen.close()
+
+
+def test_hier_zero_size_shards():
+    """total < world size: some ranks own empty shards; the round
+    completes and reassembles exactly (the satellite's degenerate
+    case)."""
+    gen = _make_hier([2, 2])
+    reds = next(gen)
+    tiny = [np.arange(2, dtype=np.float32) * (r + 1) for r in range(4)]
+    shards = _all(reds, lambda g: g.reduce_scatter(
+        tiny[g.rank], op="sum"))
+    assert sorted(s.size for s in shards) == [0, 0, 1, 1]
+    assert np.array_equal(np.concatenate(shards), sum(tiny))
+    fulls = _all(reds, lambda g: g.allgather(shards[g.rank]))
+    for f in fulls:
+        assert np.array_equal(f, sum(tiny))
+    gen.close()
+
+
+# --- failure: leader death mid-inter-ring --------------------------------
+
+
+def test_leader_death_mid_inter_ring_surfaces_everywhere(tmp_path):
+    """Node B's leader dies AFTER the intra legs, i.e. entering the
+    inter ring: every surviving rank — the other leader, its member,
+    and the dead leader's own member — surfaces RingPeerDead with a
+    flight-recorder dump attached."""
+    from ray_tpu.config import get_config
+    cfg = get_config()
+    saved = cfg.collective_flight_dir
+    cfg.collective_flight_dir = str(tmp_path)
+    try:
+        gen = _make_hier([2, 2], timeout=2.0, group="death")
+        reds = next(gen)
+        vals = _int_vals(4)
+
+        def run(g):
+            if g.rank == 2:   # node B's leader: intra legs, then dies
+                # the real path stages a flat vector before the legs
+                flat = np.concatenate(
+                    [vals[2]["w"], vals[2]["b"]]).astype(np.float32)
+                ish = g.intra.reduce_scatter(flat, op="sum")
+                g.intra.allgather(ish, rebuild=False)
+                return "died"
+            with pytest.raises(RingPeerDead) as ei:
+                g.reduce_scatter(vals[g.rank], op="mean")
+            return ei.value
+
+        outs = _all(reds, run)
+        for r, out in enumerate(outs):
+            if r == 2:
+                assert out == "died"
+                continue
+            path = getattr(out, "flight_recorder_path", None)
+            assert path, f"rank {r} has no flight dump"
+            with open(path) as f:
+                dump = json.load(f)
+            assert dump["rounds"], f"rank {r} dump is empty"
+        gen.close()
+    finally:
+        cfg.collective_flight_dir = saved
+
+
+# --- level tags / span hygiene -------------------------------------------
+
+
+def test_spans_carry_level_tags_and_distinct_groups():
+    """Sub-ring spans tag their hierarchy level (intra/inter; the
+    fan-out phase tags bcast) under DISTINCT group ids, so chrome
+    lanes and straggler attribution cannot cross-wire the levels; the
+    collectives table surfaces the level column."""
+    gen = _make_hier([2, 2], group="lv")
+    reds = next(gen)
+    vals = _int_vals(4, n_el=512, extra=0)
+    _all(reds, lambda g: g.reduce(vals[g.rank], op="mean"))
+    evs = [e for e in events.dump() if e.get("cat") == "collective"
+           and e.get("name") == "round"]
+    levels = {e.get("level") for e in evs}
+    assert {"intra", "inter", "bcast"} <= levels, levels
+    by_level_groups = {}
+    for e in evs:
+        by_level_groups.setdefault(e.get("level"), set()).add(
+            e.get("group"))
+    assert by_level_groups["inter"] == {"lv.x"}
+    assert by_level_groups["intra"] == {"lv.n0", "lv.n1"}
+    # bcast rounds ride the intra rings' groups
+    assert by_level_groups["bcast"] <= {"lv.n0", "lv.n1"}
+    from ray_tpu.util.state import collectives_from_events
+    rows = collectives_from_events(evs, limit=1000)
+    assert {"intra", "inter", "bcast"} <= {r["level"] for r in rows}
+    assert any(r["kind"] == "broadcast" for r in rows)
+    gen.close()
+
+
+# --- bucketed gradient sync ----------------------------------------------
+
+
+def test_bucket_parts_deterministic_and_order_preserving():
+    from ray_tpu.train.collective import _bucket_parts
+    leaves = [np.zeros(100, np.float32), np.zeros(300, np.float32),
+              np.zeros(10, np.float32), np.zeros(5000, np.float32),
+              np.zeros(1, np.float32)]
+    parts = _bucket_parts(leaves, 2000)
+    # 400+1200+40 pack; the 20000B leaf rides alone; the tail closes
+    assert parts == [(0, 3), (3, 4), (4, 5)]
+    assert sum(b - a for a, b in parts) == len(leaves)
+    assert parts == _bucket_parts(leaves, 2000)   # deterministic
+    assert _bucket_parts(leaves, 1) == [(i, i + 1)
+                                        for i in range(len(leaves))]
+    with pytest.raises(ValueError, match="bucket_bytes"):
+        _bucket_parts(leaves, 0)
+
+
+def test_bucketed_allreduce_bitwise_equals_unbucketed():
+    """On exactly-representable data (sums exact in any association
+    order) the bucketed sync is bitwise identical to the unbucketed
+    one — bucketing only changes WHEN bytes move — and the hidden
+    staging time lands in allreduce_bucket_overlap_s."""
+    from ray_tpu.dag.ring import allreduce_metrics
+    from ray_tpu.train.collective import _bucketed_allreduce
+    rng = np.random.default_rng(3)
+    vals = [{"a": np.round(rng.standard_normal(4096) * 8)
+             .astype(np.float32),
+             "b": np.round(rng.standard_normal(333) * 8)
+             .astype(np.float32),
+             "c": np.float32(r + 1)} for r in range(3)]
+    gen = _make_flat(3)
+    reds = next(gen)
+    base = _all(reds, lambda g: g.reduce(vals[g.rank], op="mean"))
+    gen.close()
+    m = allreduce_metrics()["bucket_overlap"]
+    count0 = sum(sum(c) for c in m._counts.values())
+    gen = _make_flat(3)
+    reds = next(gen)
+    outs = _all(reds, lambda g: _bucketed_allreduce(
+        g, vals[g.rank], "mean", None, None, 4096))
+    gen.close()
+    for o, b in zip(outs, base):
+        assert np.array_equal(o["a"], b["a"])
+        assert np.array_equal(o["b"], b["b"])
+        assert isinstance(o["c"], float) and o["c"] == b["c"]
+    # the overlap histogram saw the sync (one observation per rank)
+    assert sum(sum(c) for c in m._counts.values()) >= count0 + 3
+
+
+def test_bucketed_zero_optimizer_matches_unbucketed():
+    """ShardedOptimizer(bucket_bytes=...) produces bitwise-identical
+    parameters to the unbucketed optimizer — per-bucket shards change
+    the partitioning, not the math — and refuses the elastic surfaces
+    that assume one contiguous shard."""
+    optax = pytest.importorskip("optax")
+    from ray_tpu.train import reshard as _rs
+    from ray_tpu.train.zero import ShardedOptimizer
+    rng = np.random.default_rng(11)
+    params = rng.standard_normal(3000).astype(np.float32)
+    # integer-valued grads: the mean's sum is exact in any association
+    # order, so the two partitionings must agree BITWISE
+    grads = [np.round(rng.standard_normal(3000) * 8).astype(np.float32)
+             for _ in range(3)]
+
+    def run(bucket_bytes):
+        gen = _make_flat(3)
+        reds = next(gen)
+
+        def one(g):
+            so = ShardedOptimizer(optax.adamw(1e-3), group=g,
+                                  bucket_bytes=bucket_bytes)
+            state = so.init(params)
+            p = params
+            for _ in range(2):
+                p, state = so.update(grads[g.rank], state, p)
+            return p
+        outs = _all(reds, one)
+        gen.close()
+        return outs
+
+    base = run(None)
+    bucketed = run(2048)
+    for b, u in zip(bucketed, base):
+        assert np.array_equal(np.asarray(b), np.asarray(u))
+    with pytest.raises(ValueError, match="mirror"):
+        ShardedOptimizer(optax.adamw(1e-3), bucket_bytes=1024,
+                         mirror_interval_steps=1)
+    so = ShardedOptimizer(optax.adamw(1e-3), bucket_bytes=1024)
+    with pytest.raises(_rs.ReshardError, match="bucketed"):
+        so.reshard(None)
+
+
+# --- the in-situ auto-tuner ----------------------------------------------
+
+
+def test_tuner_bands_star_ring_hier():
+    """A registered profile drives the three-regime decision: star
+    below the measured crossover, flat ring in the middle band,
+    hierarchical on top when the topology exists — and the regime
+    gauge records each decision."""
+    from ray_tpu.dag.ring import allreduce_metrics
+    tuner.register_profile("t1", 4, alpha_s=0.01,
+                           beta_s_per_b=1e-9, hierarchical=True)
+    s_star = tuner.star_crossover(4, 0.01, 1e-9)
+    s_hier = tuner.hier_crossover(4, 0.01, 1e-9)
+    assert 64 * 1024 <= s_star <= 64 << 20
+    assert s_hier >= max(8 << 20, s_star)
+    g = allreduce_metrics()["tuner_regime"]
+    assert tuner.choose_impl(s_star // 2, 4, key="t1") == "star"
+    assert g._values[()] == 0
+    assert tuner.choose_impl(
+        (s_star + s_hier) // 2, 4, key="t1") == "ring"
+    assert g._values[()] == 1
+    assert tuner.choose_impl(2 * s_hier, 4, hierarchical=True,
+                             key="t1") == "hier"
+    assert g._values[()] == 2
+    # no topology -> never hier, whatever the payload
+    assert tuner.choose_impl(2 * s_hier, 4, key="t1") == "ring"
+    # unknown key, no default fallback match for a different size
+    assert tuner.choose_impl(1 << 20, 8, key="t1") is None
+    rows = tuner.table("t1", 4, hierarchical=True)
+    assert [r["impl"] for r in rows] == ["star", "ring", "hier"]
+
+
+def test_tuner_chunk_clamped_to_floor_and_slot():
+    tuner.register_profile("t2", 4, alpha_s=0.009, beta_s_per_b=1e-9)
+    small = tuner.tuned_chunk("t2", 4, 256 * 1024, 1 << 20)
+    big = tuner.tuned_chunk("t2", 4, 1 << 30, 2 << 20)
+    assert small is not None and 4096 <= small <= 1 << 20
+    assert big == 2 << 20                      # clamped to the slot
+    assert tuner.tuned_chunk("nope", 4, 1 << 20, 1 << 20) is None
+
+
+def test_tuner_probes_in_situ_and_invalidates_per_generation():
+    """A tuning-enabled ring probes itself at the FIRST collective
+    (two tiny fused rounds, identical on every rank), caches under its
+    group id, and a new ring generation (fresh group id — what the
+    controller mints per incarnation) re-probes; invalidate() drops
+    the cache explicitly."""
+    vals = [np.round(np.random.default_rng(r).standard_normal(512) * 4)
+            .astype(np.float32) for r in range(3)]
+
+    def run(group):
+        gen = _make_flat(3, group=group, tune=True)
+        reds = next(gen)
+        outs = _all(reds, lambda g: g.reduce(vals[g.rank], op="sum"))
+        gen.close()
+        return outs
+
+    assert tuner.profile_for("gen1", 3) is None
+    outs = run("gen1")
+    exact = sum(v.astype(np.float64) for v in vals)
+    for o in outs:
+        assert np.array_equal(o, exact.astype(np.float32))
+    prof1 = tuner.profile_for("gen1", 3)
+    assert prof1 is not None and prof1["alpha_s"] > 0
+    # generation bump: a NEW group id has no profile -> re-probes
+    assert tuner.profile_for("gen2", 3) is None
+    run("gen2")
+    prof2 = tuner.profile_for("gen2", 3)
+    assert prof2 is not None and prof2 is not prof1
+    # explicit invalidation
+    tuner.invalidate("gen2")
+    assert tuner.profile_for("gen2", 3) is None
+    tuner.invalidate()
+    assert tuner.profile_for("gen1", 3) is None
+
+
+def test_tuner_payload_hint_cached_from_layout():
+    """The per-round tuned-chunk lookup derives the payload hint from
+    the already-flattened layout (ring._payload_hint) instead of
+    re-flattening the pytree to size it — and reuses it across
+    steps."""
+    tuner.register_profile("hint", 3, alpha_s=0.005, beta_s_per_b=1e-9)
+    gen = _make_flat(3, group="hint", tune=True)
+    reds = next(gen)
+    v = [np.zeros(4096, np.float32) for _ in range(3)]
+    _all(reds, lambda g: g.reduce(v[g.rank], op="sum"))
+    for g in reds:
+        assert g._payload_hint == 4096 * 4
+    gen.close()
+
+
+def test_tuner_knob_gates_probing():
+    """Config.collective_tuner=False disables in-situ probing even on
+    tune-flagged rings (the static crossover keeps working); the
+    collective_tuner_probe_bytes / collective_tuner_min_chunk_bytes
+    knobs bound the probe payload and the chunk floor."""
+    from ray_tpu.config import get_config
+    cfg = get_config()
+    saved = cfg.collective_tuner
+    cfg.collective_tuner = False
+    try:
+        gen = _make_flat(3, group="gated", tune=True)
+        reds = next(gen)
+        v = [np.ones(256, np.float32)] * 3
+        _all(reds, lambda g: g.reduce(v[g.rank], op="sum"))
+        gen.close()
+        assert tuner.profile_for("gated", 3) is None
+    finally:
+        cfg.collective_tuner = saved
+    assert cfg.collective_tuner_probe_bytes >= 64 * 1024
+    assert cfg.collective_tuner_min_chunk_bytes >= 4096
+
+
+# --- dag impl resolution --------------------------------------------------
+
+
+def test_resolve_impl_hier_and_tuner_consultation():
+    """_resolve_impl: explicit "hier" needs a real two-level placement
+    (degrades to ring otherwise); with a tuned default profile the
+    payload hint consults the tuner's bands; without one the static
+    crossover still decides (the pre-tuner contract, kept verbatim)."""
+    from ray_tpu.dag import MethodNode, _resolve_impl, allreduce
+
+    def g(**kw):
+        base = {"size": 4, "quantize": None, "impl": None,
+                "payload_bytes": None}
+        base.update(kw)
+        return base
+
+    assert _resolve_impl(g(impl="hier"), hier_ok=True) == "hier"
+    assert _resolve_impl(g(impl="hier"), hier_ok=False) == "ring"
+    assert _resolve_impl(g(), hier_ok=True) == "hier"  # N>2 multi-node
+    assert _resolve_impl(g(size=2), hier_ok=True) == "star"
+    # quantized + multi-node + big payload under a tuned profile:
+    # codec rides the hierarchical cross-node leg
+    tuner.register_profile("", 4, alpha_s=0.01, beta_s_per_b=1e-9,
+                           hierarchical=True)
+    s_h = tuner.hier_crossover(4, 0.01, 1e-9)
+    assert _resolve_impl(g(quantize="int8", payload_bytes=2 * s_h),
+                         hier_ok=True) == "hier"
+    assert _resolve_impl(g(payload_bytes=2 * s_h),
+                         hier_ok=True) == "hier"
+    s_star = tuner.star_crossover(4, 0.01, 1e-9)
+    assert _resolve_impl(g(payload_bytes=s_star // 2)) == "star"
+    tuner.invalidate()
+    # binding surface accepts the new impl
+    nodes = [MethodNode(None, "m", ()), MethodNode(None, "m", ())]
+    assert allreduce(nodes, impl="hier")[0].group["impl"] == "hier"
+    with pytest.raises(ValueError, match="impl"):
+        allreduce(nodes, impl="rings")
+
+
+# --- dag compile wiring ---------------------------------------------------
+
+
+def test_dag_build_hier_group_wiring():
+    """CompiledDag._build_hier_group: co-located members get intra
+    edges among themselves, first-of-node leaders get the inter ring,
+    codec options land on the INTER sub-spec only."""
+    from ray_tpu.dag import CompiledDag
+    cd = CompiledDag.__new__(CompiledDag)
+    cd._coll_timeout = 60.0
+    cd._coll_spec = {}
+    edges = []
+
+    def fake_edge(p, c):
+        edges.append((p, c))
+        return {"edge": (p, c)}
+
+    cd._new_edge = fake_edge
+    g = {"id": "f" * 16, "op": "sum", "quantize": "int8",
+         "chunk_bytes": None}
+    idxs = [10, 11, 12, 13]                 # actor indices, world order
+    by_node = {"A": [0, 1], "B": [2, 3]}    # member positions per node
+    cd._build_hier_group(g, idxs, by_node)
+    lead_a, mem_a = cd._coll_spec[10], cd._coll_spec[11]
+    lead_b, mem_b = cd._coll_spec[12], cd._coll_spec[13]
+    for s in (lead_a, mem_a, lead_b, mem_b):
+        assert s["role"] == "hier" and s["nodes"] == [2, 2]
+        assert s["intra"]["level"] == "intra"
+        # codec confined to the cross-node leg
+        assert "quantize" not in s["intra"]
+    assert lead_a["inter"]["level"] == "inter"
+    assert lead_a["inter"]["quantize"] == "int8"
+    assert mem_a["inter"] is None and mem_b["inter"] is None
+    # intra edges stay within a node's actors; inter connects leaders
+    assert (10, 11) in edges and (11, 10) in edges
+    assert (12, 13) in edges and (13, 12) in edges
+    assert (10, 12) in edges and (12, 10) in edges
+    assert lead_a["inter"]["from_prev"] == lead_b["inter"]["to_next"]
+    assert lead_a["intra"]["group"] != lead_b["intra"]["group"]
+    assert lead_a["group"] == g["id"][:12]
+
+
+# --- train-plane e2e over a real 2-node cluster ---------------------------
+
+
+def test_train_hier_gradient_sync_e2e_two_nodes(tmp_path):
+    """End-to-end: a 2-node x 2-worker group gets the ring-of-rings
+    wired by the controller (lazy-shm intra, TCP leader ring),
+    train.allreduce_gradients — plain and bucketed — reduces exactly
+    over it, and shard_bounds follows the nested hier split."""
+    import ray_tpu
+    from ray_tpu import train
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.config import Config
+    from ray_tpu.train.api import ScalingConfig
+
+    cfg = Config.from_env(num_workers_prestart=0,
+                          default_max_task_retries=0)
+    c = Cluster(config=cfg)
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.address, num_cpus=0, config=cfg)
+    try:
+        def train_fn():
+            import numpy as _np
+
+            from ray_tpu import train as _train
+            ctx = _train.get_context()
+            r = ctx.get_world_rank()
+            # payload well past the tuned-chunk floor: the tuner's
+            # agreed profile (not each rank's private timings) must
+            # drive the chunking or the ring desyncs mid-phase
+            g = {"w": _np.full(200_000, float(r + 1), _np.float32),
+                 "b": _np.arange(8, dtype=_np.float32) * (r + 1)}
+            out = _train.allreduce_gradients(g, op="mean")
+            bout = _train.allreduce_gradients(g, op="mean",
+                                              bucket_bytes=8192)
+            spec = ctx._grad_sync or {}
+            lo, hi = ctx.shard_bounds(4104)
+            _train.report({
+                "rank": r, "w0": float(out["w"][0]),
+                "b3": float(out["b"][3]),
+                "bw0": float(bout["w"][0]),
+                "role": spec.get("role"), "nodes": spec.get("nodes"),
+                "own": [int(lo), int(hi)]})
+
+        res = train.JaxTrainer(
+            train_fn,
+            scaling_config=ScalingConfig(num_workers=4)).fit()
+        assert res.error is None
+        m = res.metrics
+        assert m["w0"] == 2.5                  # mean of 1..4
+        assert m["b3"] == 3.0 * 2.5
+        assert m["bw0"] == m["w0"]             # bucketed == plain
+        assert m["role"] == "hier" and sorted(m["nodes"]) == [2, 2]
+        from ray_tpu.dag.ring import hier_seg_bounds
+        assert tuple(m["own"]) == hier_seg_bounds(4104, m["nodes"], 0)
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
