@@ -735,16 +735,21 @@ def available_resources() -> Dict[str, float]:
 
 
 def timeline(all_nodes: bool = False,
-             chrome_path: Optional[str] = None) -> List[dict]:
+             chrome_path: Optional[str] = None,
+             trace_id: Optional[str] = None) -> List[dict]:
     """Task/actor event timeline (reference: _private/state.py:1010).
 
     ``all_nodes=True`` collects every node's worker span buffers through
     the control service (submit edges + exec spans from
-    util/tracing.py, plus collective ring spans from dag/ring.py) and
-    the head's per-node clock-offset estimates; ``chrome_path=``
-    additionally writes a chrome://tracing / Perfetto JSON file — with
-    cross-node timestamps corrected by the offsets — and the returned
-    records are the chrome-trace events."""
+    util/tracing.py, plus collective ring spans from dag/ring.py and
+    request spans from the serve path) and the head's per-node
+    clock-offset estimates; ``chrome_path=`` additionally writes a
+    chrome://tracing / Perfetto JSON file — with cross-node timestamps
+    corrected by the offsets — and the returned records are the
+    chrome-trace events. ``trace_id=`` narrows either form to ONE
+    request trace (its spans, exec spans of its nested tasks, batch
+    spans linked to it, and — for train-step traces — its steps'
+    collective rounds)."""
     from ray_tpu.util import events
     offsets = None
     if all_nodes:
@@ -761,10 +766,13 @@ def timeline(all_nodes: bool = False,
             evs += events.dump()
     else:
         evs = events.dump()
+    from ray_tpu.util import tracing
     if chrome_path is not None:
-        from ray_tpu.util import tracing
         return tracing.to_chrome(evs, chrome_path,
-                                 clock_offsets=offsets)
+                                 clock_offsets=offsets,
+                                 trace_id=trace_id)
+    if trace_id is not None:
+        evs = tracing.filter_trace(evs, trace_id)
     return evs
 
 

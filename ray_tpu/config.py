@@ -182,6 +182,17 @@ class Config:
     metrics_export_interval_s: float = 5.0
     metrics_port: int = -1                  # -1 off, 0 ephemeral, >0 fixed
     log_dir: str = ""                       # "" = workers inherit stdio
+    # Request tracing (util/tracing.py request layer): tail-based
+    # sampling at the proxy when a request FINISHES. Error /
+    # deadline-exceeded traces and traces slower than
+    # trace_slow_threshold_s are always kept; healthy ones keep with
+    # this probability (deterministic on the trace id). 1.0 = keep
+    # everything (small clusters), 0.0 = only errors/slow survive
+    # (high-QPS production). Segment spans are budget-capped in the
+    # "request" event category either way; sampling gates which traces
+    # SURFACE (root span recorded), not which record.
+    trace_sample_rate: float = 1.0
+    trace_slow_threshold_s: float = 1.0
 
     # --- control-plane fault tolerance ---
     # Directory for durable control tables (GCS-persistence analog,

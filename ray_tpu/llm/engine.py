@@ -28,6 +28,7 @@ import numpy as np
 
 from ray_tpu.llm import model as lm
 from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.util import tracing
 
 
 class KVHandoffError(RuntimeError):
@@ -92,6 +93,12 @@ class _Request:
     admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
     prefill_device_s: float = 0.0           # block_until_ready-bounded
+    # request trace context ambient at submission (the serve replica
+    # binds it before user code): engine queue/prefill/generate spans
+    # parent to the replica's handler span through it. Cleared once the
+    # terminal "generate" span is recorded (one per request).
+    trace: Optional[tracing.TraceContext] = None
+    t_submit_wall: float = field(default_factory=time.time)
     # KV computed by a remote prefill engine (disaggregated serving):
     # {"k","v": (layers, bucket, kvh, hd) numpy, "logits": (vocab,)}
     prefilled: Optional[dict] = None
@@ -304,7 +311,8 @@ class LLMEngine:
                     "(prefill/decode bucket configs disagree)")
         r = _Request(tokens, max_new_tokens, temperature, eos_id,
                      top_p=float(top_p), top_k=int(top_k), stop=stop,
-                     prefilled=prefilled, deadline_ts=deadline_ts)
+                     prefilled=prefilled, deadline_ts=deadline_ts,
+                     trace=tracing.current_context())
         self._waiting.put_nowait(r)
         self._requests += 1
         self._ensure_loop()
@@ -414,12 +422,25 @@ class LLMEngine:
                     top_ps[i] = self._slots[i].top_p
                     top_ks[i] = self._slots[i].top_k
                 t_dec = time.monotonic()
+                t_dec_wall = time.time()
                 out = await loop.run_in_executor(
                     None, self._decode_sync, tokens, temps, top_ps,
                     top_ks, block)
                 self._m["batch"].observe(len(active))
                 self._m["tpot"].observe(
                     (time.monotonic() - t_dec) / block)
+                # one span per decode BLOCK, linked to every member
+                # trace: the block is shared compute, so it belongs to
+                # all of them rather than to one (each member's
+                # waterfall pulls it in via the links)
+                tracing.record_batch_span(
+                    "engine", "decode",
+                    sorted({self._slots[i].trace.trace_id
+                            for i in active
+                            if self._slots[i] is not None
+                            and self._slots[i].trace is not None}),
+                    t_dec_wall, time.time(), block=block,
+                    slots=len(active))
                 for step in range(block):
                     for i in active:
                         r = self._slots[i]
@@ -449,6 +470,12 @@ class LLMEngine:
         n = len(r.tokens)
         r.admitted_at = time.monotonic()
         self._m["queue"].observe(r.admitted_at - r.submitted)
+        if r.trace is not None:
+            # engine hop, segment 1: submit -> slot admission
+            tracing.record_request_span(
+                "engine", "queue", r.trace, r.trace.span_id,
+                r.t_submit_wall,
+                r.t_submit_wall + (r.admitted_at - r.submitted))
         # Bucketed growth runs HERE (executor thread): padding and
         # re-uploading a multi-GB cache on the event loop would stall
         # every in-flight stream. Admits and decode blocks are awaited
@@ -491,6 +518,7 @@ class LLMEngine:
             # forward ran on the remote tier)
             jax.block_until_ready(self._cache["k"])
             r.prefill_device_s = time.monotonic() - t0
+            self._record_prefill_span(r)
             self._slots[slot] = r
             return self._sample_one(logits_np, r)
         t0 = time.monotonic()
@@ -510,8 +538,21 @@ class LLMEngine:
         logits_np = np.asarray(logits)
         jax.block_until_ready(self._cache["k"])
         r.prefill_device_s = time.monotonic() - t0
+        self._record_prefill_span(r)
         self._slots[slot] = r
         return self._sample_one(logits_np, r)
+
+    @staticmethod
+    def _record_prefill_span(r: _Request) -> None:
+        """Engine hop, segment 2: the prefill device compute that
+        produced the first token (block_until_ready-bounded, so the
+        span is the DEVICE portion of TTFT, ending now)."""
+        if r.trace is None:
+            return
+        now = time.time()
+        tracing.record_request_span(
+            "engine", "prefill", r.trace, r.trace.span_id,
+            now - r.prefill_device_s, now, tokens=len(r.tokens))
 
     def _chunked_prefill(self, tokens: List[int]):
         """Prompts past the largest bucket stream through
@@ -606,8 +647,11 @@ class LLMEngine:
             self._ttft_count += 1
             self._m["ttft_wall"].observe(wall)
             # device time is a sub-interval of the wall interval; min()
-            # guards the invariant against clock jitter
-            self._m["ttft_device"].observe(min(r.prefill_device_s, wall))
+            # guards the invariant against clock jitter. The exemplar
+            # links the TTFT bucket to the concrete request trace.
+            self._m["ttft_device"].observe(
+                min(r.prefill_device_s, wall),
+                exemplar=r.trace.trace_id if r.trace else None)
         r.out.append(tok)
         self._tokens_generated += 1
         if r.stream is not None:
@@ -622,7 +666,20 @@ class LLMEngine:
                 or (r.eos_id is not None and tok == r.eos_id)):
             self._finish(r, slot)
 
+    def _record_done(self, r: _Request, error: bool) -> None:
+        """Terminal engine span for one request: submit -> done, with
+        the produced token count. Recorded at most once (finish, fail,
+        and the loop's shutdown sweep can all reach a request)."""
+        if r.trace is None:
+            return
+        tracing.record_request_span(
+            "engine", "generate", r.trace, r.trace.span_id,
+            r.t_submit_wall, time.time(), error=error,
+            tokens=len(r.out))
+        r.trace = None
+
     def _finish(self, r: _Request, slot: Optional[int]):
+        self._record_done(r, error=False)
         if slot is not None and self._slots[slot] is r:
             self._slots[slot] = None
         if r.stream is not None:
@@ -642,6 +699,7 @@ class LLMEngine:
 
     def _fail(self, r: _Request, slot: Optional[int], e: BaseException):
         from ray_tpu.serve.fault import DeadlineExceeded
+        self._record_done(r, error=True)
         # deadline cancellations cross the serve boundary TYPED so the
         # proxy can answer 504 instead of a generic 500
         err = e if isinstance(e, DeadlineExceeded) else RuntimeError(

@@ -201,6 +201,10 @@ class _TaskSpec:
     retries: int
     attempt: int = 0
     stream_id: Optional[ObjectID] = None
+    # traceparent of the REQUEST trace ambient at submission (None
+    # outside one): shipped with the exec RPC so the executor's exec
+    # span joins the request's trace (util/tracing.py request layer)
+    trace: Optional[str] = None
 
 
 class _StreamState:
@@ -1138,7 +1142,8 @@ class CoreContext:
         digest = self.fn_cache.digest_for(fn)
         args_frame = dumps_oob((args, kwargs))
         spec = _TaskSpec(task_id, digest, args_frame, oids, retries,
-                         stream_id=stream_id if streaming else None)
+                         stream_id=stream_id if streaming else None,
+                         trace=tracing.wire_context())
         from ray_tpu.runtime.runtime_env import to_key
         key = LeasePool.shape_key(resources, pg, policy,
                                   to_key(runtime_env))
@@ -1317,7 +1322,8 @@ class CoreContext:
             calls.append({
                 "task_id": s.task_id, "fn_digest": s.digest,
                 "fn_payload": payload, "args_frame": s.args_frame,
-                "return_oids": s.oids, "stream_id": s.stream_id})
+                "return_oids": s.oids, "stream_id": s.stream_id,
+                "trace": s.trace})
         try:
             r = await self.pool.call(
                 lw.worker_addr, "exec_task_batch", calls=calls,
@@ -1466,7 +1472,8 @@ class CoreContext:
         args_frame = dumps_oob((args, kwargs))
         self._stage_put(self._enqueue_actor_call, actor_id,
                         (method, args_frame, oids, max_task_retries, 0,
-                         stream_id, concurrency_group))
+                         stream_id, concurrency_group,
+                         tracing.wire_context()))
         return stream_id if streaming else refs
 
     async def submit_actor_call(self, actor_id: ActorID, method: str,
@@ -1544,20 +1551,21 @@ class CoreContext:
     async def _drive_actor_batch(self, actor_id: ActorID, batch: list):
         if len(batch) == 1:
             (method, args_frame, oids, retries, _att, stream_id,
-             cgroup) = batch[0]
+             cgroup, trace) = batch[0]
             await self._drive_actor_call(
                 actor_id, method, args_frame, oids, retries, stream_id,
-                cgroup)
+                cgroup, trace)
             return
         calls = [{"method": m, "args_frame": af, "return_oids": oids,
-                  "stream_id": sid, "concurrency_group": cg}
-                 for (m, af, oids, _r, _a, sid, cg) in batch]
+                  "stream_id": sid, "concurrency_group": cg,
+                  "trace": tr}
+                 for (m, af, oids, _r, _a, sid, cg, tr) in batch]
         try:
             addr = await self.resolve_actor_addr(actor_id)
             r = await self.pool.call(
                 addr, "actor_call_batch", actor_id=actor_id,
                 calls=calls, owner_addr=self.addr, timeout=None)
-            for res, (_m, _af, oids, _r2, _a, _s, _c) in zip(
+            for res, (_m, _af, oids, _r2, _a, _s, _c, _t) in zip(
                     r["batch"], batch):
                 self._apply_result(oids, res)
         except (rpc.ConnectionLost, OSError) as e:
@@ -1566,7 +1574,7 @@ class CoreContext:
             # back through the pump individually.
             self._actor_addr_cache.pop(actor_id, None)
             retryable = []
-            for (m, af, oids, retries, attempt, sid, cg) in batch:
+            for (m, af, oids, retries, attempt, sid, cg, tr) in batch:
                 if attempt + 1 > retries:
                     self._fail_all(oids, ActorDiedError(
                         f"actor {actor_id} connection lost: {e}"))
@@ -1575,7 +1583,8 @@ class CoreContext:
                             f"actor {actor_id} connection lost: {e}"))
                 else:
                     retryable.append(
-                        (m, af, oids, retries, attempt + 1, sid, cg))
+                        (m, af, oids, retries, attempt + 1, sid, cg,
+                         tr))
             if retryable:
                 await asyncio.sleep(0.2)
                 for call in retryable:
@@ -1583,14 +1592,14 @@ class CoreContext:
         except (rpc.RemoteError, ActorError) as e:
             err = (TaskError(str(e))
                    if isinstance(e, rpc.RemoteError) else e)
-            for (_m, _af, oids, _r2, _a, sid, _c) in batch:
+            for (_m, _af, oids, _r2, _a, sid, _c, _t) in batch:
                 self._fail_all(oids, err)
                 if sid is not None:
                     self.fail_stream(sid, err)
 
     async def _drive_actor_call(self, actor_id, method, args_frame, oids,
                                 retries, stream_id=None,
-                                concurrency_group=None):
+                                concurrency_group=None, trace=None):
         attempt = 0
         while True:
             try:
@@ -1599,7 +1608,7 @@ class CoreContext:
                     addr, "actor_call", actor_id=actor_id, method=method,
                     args_frame=args_frame, return_oids=oids,
                     owner_addr=self.addr, stream_id=stream_id,
-                    concurrency_group=concurrency_group,
+                    concurrency_group=concurrency_group, trace=trace,
                     timeout=None)
                 self._apply_result(oids, r)
                 return

@@ -302,7 +302,7 @@ class WorkerExecutor:
     async def exec_task(self, task_id: TaskID, fn_digest: bytes,
                         fn_payload: Optional[bytes], args_frame: bytes,
                         return_oids: List[ObjectID], owner_addr,
-                        stream_id=None):
+                        stream_id=None, trace=None):
         if task_id in self.cancelled:
             self.cancelled.discard(task_id)
             e0 = TaskError("task cancelled")
@@ -313,6 +313,10 @@ class WorkerExecutor:
         fn = self.ctx.fn_cache.resolve(fn_digest, fn_payload)
         t0, err = time.time(), False
         tok = tracing.current_span.set(task_id.hex())
+        # bind the submitter's request trace so this task's exec span —
+        # and anything the task submits in turn — joins the trace
+        tctx = tracing.parse_traceparent(trace)
+        rtok = tracing.set_request_context(tctx)
         try:
             args, kwargs = await self._resolve_args(args_frame)
             if stream_id is not None:
@@ -329,10 +333,12 @@ class WorkerExecutor:
                 return {"results": []}
             return self._package_error(e, return_oids)
         finally:
+            tracing.reset_request_context(rtok)
             tracing.current_span.reset(tok)
             tracing.record_exec(task_id.hex(), "task",
                                 getattr(fn, "__name__", "?"),
-                                t0, time.time(), error=err)
+                                t0, time.time(), error=err,
+                                trace=tctx.trace_id if tctx else "")
 
     async def exec_task_batch(self, calls: list, owner_addr):
         """Coalesced stateless tasks (see core.py _task_pump). Sync
@@ -374,19 +380,25 @@ class WorkerExecutor:
                 span = c["task_id"].hex()
                 t0 = time.time()
                 tok = tracing.current_span.set(span)
+                tctx = tracing.parse_traceparent(c.get("trace"))
+                rtok = tracing.set_request_context(tctx)
                 try:
                     out[i] = await self._drive_stream(
                         fn, args, kwargs, c["stream_id"], owner_addr)
                 finally:
+                    tracing.reset_request_context(rtok)
                     tracing.current_span.reset(tok)
-                    tracing.record_exec(span, "task",
-                                        getattr(fn, "__name__", "?"),
-                                        t0, time.time())
+                    tracing.record_exec(
+                        span, "task", getattr(fn, "__name__", "?"),
+                        t0, time.time(),
+                        trace=tctx.trace_id if tctx else "")
                 continue
             if inspect.iscoroutinefunction(fn):
                 span = c["task_id"].hex()
                 t0, failed = time.time(), False
                 tok = tracing.current_span.set(span)
+                tctx = tracing.parse_traceparent(c.get("trace"))
+                rtok = tracing.set_request_context(tctx)
                 try:
                     value = await fn(*args, **kwargs)
                 except BaseException as e:  # noqa: BLE001
@@ -396,18 +408,21 @@ class WorkerExecutor:
                     out[i] = await self._package_slot(
                         value, c["return_oids"])
                 finally:
+                    tracing.reset_request_context(rtok)
                     tracing.current_span.reset(tok)
-                    tracing.record_exec(span, "task",
-                                        getattr(fn, "__name__", "?"),
-                                        t0, time.time(), error=failed)
+                    tracing.record_exec(
+                        span, "task", getattr(fn, "__name__", "?"),
+                        t0, time.time(), error=failed,
+                        trace=tctx.trace_id if tctx else "")
             else:
                 sync_items.append((i, fn, args, kwargs,
-                                   c["task_id"].hex()))
+                                   c["task_id"].hex(),
+                                   c.get("trace")))
         if sync_items:
             loop = asyncio.get_running_loop()
             vals = await loop.run_in_executor(
                 self.task_pool, self._run_task_batch_sync, sync_items)
-            for (i, _fn, _a, _k, _s), v in zip(sync_items, vals):
+            for (i, _fn, _a, _k, _s, _t), v in zip(sync_items, vals):
                 c = calls[i]
                 out[i] = await self._package_slot(v, c["return_oids"])
         return {"batch": out}
@@ -425,8 +440,10 @@ class WorkerExecutor:
     @staticmethod
     def _run_task_batch_sync(items):
         vals = []
-        for _i, fn, args, kwargs, span in items:
+        for _i, fn, args, kwargs, span, trace in items:
             tok = tracing.current_span.set(span)
+            tctx = tracing.parse_traceparent(trace)
+            rtok = tracing.set_request_context(tctx)
             t0, failed = time.time(), False
             try:
                 vals.append(fn(*args, **kwargs))
@@ -434,11 +451,13 @@ class WorkerExecutor:
                 failed = True
                 vals.append(_BatchError(e))
             finally:
+                tracing.reset_request_context(rtok)
                 tracing.current_span.reset(tok)
                 tracing.record_exec(span, "task",
                                     getattr(fn, "__name__", "?"),
                                     t0, time.time(), batch=len(items),
-                                    error=failed)
+                                    error=failed,
+                                    trace=tctx.trace_id if tctx else "")
         return vals
 
     async def cancel_task(self, task_id: TaskID):
@@ -499,7 +518,7 @@ class WorkerExecutor:
     async def actor_call(self, actor_id: ActorID, method: str,
                          args_frame: bytes, return_oids: List[ObjectID],
                          owner_addr, stream_id=None,
-                         concurrency_group=None):
+                         concurrency_group=None, trace=None):
         hosted = self.actors.get(actor_id)
         if hosted is None:
             err0 = TaskError(f"actor {actor_id} not hosted here")
@@ -511,6 +530,8 @@ class WorkerExecutor:
         span = return_oids[0].hex() if return_oids else ""
         t0, err = time.time(), False
         tok = tracing.current_span.set(span)
+        tctx = tracing.parse_traceparent(trace)
+        rtok = tracing.set_request_context(tctx)
         try:
             if stream_id is not None:
                 args, kwargs = await self._resolve_args(args_frame)
@@ -579,12 +600,14 @@ class WorkerExecutor:
                 return {"results": []}
             return self._package_error(e, return_oids)
         finally:
+            tracing.reset_request_context(rtok)
             tracing.current_span.reset(tok)
             if method != "__dag_exec_loop__":
                 # the pinned dag loop lives for the dag's whole lifetime —
                 # a span covering it would occlude every real slice
                 tracing.record_exec(span, "actor", method, t0, time.time(),
-                                    error=err)
+                                    error=err,
+                                    trace=tctx.trace_id if tctx else "")
 
     async def actor_call_batch(self, actor_id: ActorID, calls: list,
                                owner_addr):
@@ -616,11 +639,12 @@ class WorkerExecutor:
             spans = [c["return_oids"][0].hex() if c["return_oids"] else ""
                      for c in calls]
             names = [c["method"] for c in calls]
+            traces = [c.get("trace") for c in calls]
             async with hosted.lock:
                 loop = asyncio.get_running_loop()
                 values = await loop.run_in_executor(
                     hosted.executor, self._run_batch_sync, methods,
-                    resolved, spans, names)
+                    resolved, spans, names, traces)
             out = []
             for v, c in zip(values, calls):
                 out.append(await self._package_slot(v, c["return_oids"]))
@@ -632,12 +656,14 @@ class WorkerExecutor:
             self.actor_call(actor_id, c["method"], c["args_frame"],
                             c["return_oids"], owner_addr,
                             c.get("stream_id"),
-                            c.get("concurrency_group"))
+                            c.get("concurrency_group"),
+                            c.get("trace"))
             for c in calls])
         return {"batch": list(out)}
 
     @staticmethod
-    def _run_batch_sync(methods, resolved, spans=None, names=None):
+    def _run_batch_sync(methods, resolved, spans=None, names=None,
+                        traces=None):
         vals = []
         for i, (m, r) in enumerate(zip(methods, resolved)):
             if isinstance(r, _BatchError):  # arg resolution failed
@@ -645,6 +671,9 @@ class WorkerExecutor:
                 continue
             args, kwargs = r
             tok = tracing.current_span.set(spans[i]) if spans else None
+            tctx = tracing.parse_traceparent(traces[i]) if traces \
+                else None
+            rtok = tracing.set_request_context(tctx)
             t0, failed = time.time(), False
             try:
                 vals.append(m(*args, **kwargs))
@@ -652,12 +681,14 @@ class WorkerExecutor:
                 failed = True
                 vals.append(_BatchError(e))
             finally:
+                tracing.reset_request_context(rtok)
                 if tok is not None:
                     tracing.current_span.reset(tok)
                     tracing.record_exec(
                         spans[i], "actor",
                         names[i] if names else getattr(m, "__name__", "?"),
-                        t0, time.time(), batch=len(methods), error=failed)
+                        t0, time.time(), batch=len(methods), error=failed,
+                        trace=tctx.trace_id if tctx else "")
         return vals
 
     async def shutdown_worker(self):

@@ -397,6 +397,81 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Request tracing surface. Without an id: list recent SAMPLED
+    traces (the dashboard /traces table, errors first then slowest).
+    With an id: write that ONE request's clock-offset-corrected
+    cross-node waterfall (request lanes proxy/handle/replica/engine +
+    flow edges, nested task exec spans, linked engine decode blocks,
+    and — for train-step traces — their collective rounds) as a
+    chrome://tracing / Perfetto JSON file, plus a per-hop summary."""
+    import time as _time
+
+    from ray_tpu.util.state import summarize_traces, traces_from_events
+    from ray_tpu.util.tracing import filter_trace, to_chrome
+    addr = _resolve_address(args)
+    r = _call_head(addr, "collect_timeline")
+    evs = r.get("events", [])
+    if not args.trace_id:
+        rows = traces_from_events(evs, limit=args.limit)
+        if args.json:
+            print(json.dumps({"traces": rows,
+                              "summary": summarize_traces(rows)},
+                             default=str, indent=2))
+            return 0
+        if not rows:
+            print("no sampled traces in the timeline (is "
+                  "RAY_TPU_TRACE_REQUESTS=0, or trace_sample_rate 0 "
+                  "with only healthy traffic?)")
+            return 0
+        for t in rows:
+            started = _time.strftime(
+                "%H:%M:%S", _time.localtime(t["start_time"] or 0))
+            status = t.get("status") or "?"
+            print(f"{started}  {t['trace_id']}  {status:8s} "
+                  f"kept={t.get('keep') or '-':7s} "
+                  f"{(t['duration_s'] or 0.0) * 1e3:9.2f} ms  "
+                  f"{t['spans']:3d} spans  "
+                  f"[{','.join(t['components'])}]  "
+                  f"{t.get('deployment') or '-'}")
+        s = summarize_traces(rows)
+        print(f"\n{s['traces']} sampled traces, {s['errors']} errors; "
+              f"mean {s['mean_duration_s'] * 1e3:.2f} ms, max "
+              f"{s['max_duration_s'] * 1e3:.2f} ms. Waterfall: "
+              f"ray-tpu trace <id>")
+        return 0
+    tid = args.trace_id
+    mine = filter_trace(evs, tid)
+    if not mine:
+        print(f"trace {tid!r} not found in the timeline (buffers are "
+              "bounded — old traces age out)", file=sys.stderr)
+        return 1
+    offs = r.get("clock_offsets") or {}
+    recs = to_chrome(evs, args.output, clock_offsets=offs,
+                     trace_id=tid)
+    spans = [x for x in recs if x.get("ph") == "X"]
+    flows = sum(1 for x in recs if x.get("ph") == "s")
+    procs = {(e.get("node"), e.get("pid")) for e in mine
+             if e.get("cat") == "request"}
+    for e in sorted((e for e in mine if e.get("cat") == "request"),
+                    key=lambda e: e.get("ts", 0.0)):
+        status = "ERROR" if e.get("error") else "ok"
+        extra = ""
+        if e.get("root"):
+            extra = (f"  [root: {e.get('status')}, "
+                     f"kept={e.get('keep')}]")
+        elif e.get("links"):
+            extra = f"  [batch x{len(e['links'])}]"
+        print(f"{e.get('component', '?'):8s} {e.get('seg', '?'):10s} "
+              f"{(e.get('dur') or 0.0) * 1e3:9.2f} ms  "
+              f"node={str(e.get('node', ''))[:8] or '-':8s} "
+              f"pid={e.get('pid', '?')}  {status}{extra}")
+    print(f"\nwrote {args.output}: {len(spans)} spans, {flows} flow "
+          f"edges across {len(procs)} process(es) "
+          f"({len(offs)} node clocks)")
+    return 0
+
+
 def cmd_collectives(args) -> int:
     """Summarize recent collective-plane rounds off the cluster
     timeline: op, payload bytes, round time, recv-wait, straggler rank
@@ -570,6 +645,20 @@ def main(argv=None) -> int:
     pt.add_argument("--address")
     pt.add_argument("-o", "--output", default="timeline.json")
     pt.set_defaults(fn=cmd_timeline)
+
+    ptr = sub.add_parser(
+        "trace",
+        help="list recent sampled request traces, or render one "
+             "trace's cross-node waterfall (chrome://tracing JSON)")
+    ptr.add_argument("trace_id", nargs="?",
+                     help="32-hex trace id (from an X-Trace-Id "
+                          "response header, a histogram exemplar, or "
+                          "the list form)")
+    ptr.add_argument("--address")
+    ptr.add_argument("--json", action="store_true")
+    ptr.add_argument("--limit", type=int, default=50)
+    ptr.add_argument("-o", "--output", default="trace.json")
+    ptr.set_defaults(fn=cmd_trace)
 
     pc = sub.add_parser("collectives",
                         help="summarize recent ring collective rounds "

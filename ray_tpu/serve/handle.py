@@ -30,6 +30,7 @@ from ray_tpu.api import ActorHandle
 from ray_tpu.runtime.ids import ActorID
 from ray_tpu.serve import fault
 from ray_tpu.serve.chaos import apply_sync as _chaos_apply, chaos_fire
+from ray_tpu.util import tracing
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 SERVE_NAMESPACE = "serve"
@@ -353,18 +354,24 @@ class DeploymentHandle:
 
     def __init__(self, deployment_name: str, _pin: bytes = None,
                  _model_id: str = None, _stream: bool = False,
-                 _deadline_s: float = None, _deadline_ts: float = None):
+                 _deadline_s: float = None, _deadline_ts: float = None,
+                 _trace: str = None):
         self.deployment_name = deployment_name
         self._pin = _pin
         self._model_id = _model_id
         self._stream = _stream
         self._deadline_s = _deadline_s
         self._deadline_ts = _deadline_ts
+        # traceparent string pinned by the proxy at ingress (rides next
+        # to _deadline_ts); without it the AMBIENT request context is
+        # inherited — composed deployments join their caller's trace
+        self._trace = _trace
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self._pin, self._model_id,
-                 self._stream, self._deadline_s, self._deadline_ts))
+                 self._stream, self._deadline_s, self._deadline_ts,
+                 self._trace))
 
     def pinned(self) -> "DeploymentHandle":
         """A handle bound to ONE replica (picked now) — for stateful
@@ -375,7 +382,8 @@ class DeploymentHandle:
         return DeploymentHandle(self.deployment_name,
                                 router.pick(self._model_id),
                                 self._model_id, self._stream,
-                                self._deadline_s, self._deadline_ts)
+                                self._deadline_s, self._deadline_ts,
+                                self._trace)
 
     def __getattr__(self, name):
         if name.startswith("_") or name in ("deployment_name",):
@@ -397,12 +405,27 @@ class DeploymentHandle:
             return time.time() + float(self._deadline_s)
         return fault.current_deadline_ts()
 
+    def _request_trace_ctx(self) -> Optional[tracing.TraceContext]:
+        """Trace context for ONE call: the proxy-pinned traceparent if
+        set, else the AMBIENT request context (a composed deployment —
+        replica calling another deployment through a nested handle —
+        joins its caller's trace the way it inherits its deadline)."""
+        if self._trace is not None:
+            return tracing.parse_traceparent(self._trace)
+        return tracing.current_context()
+
     def _route(self, method: str, args: tuple, kwargs: dict,
                _policy: fault.RetryPolicy = None,
-               _deadline_ts: float = None, _attempt: int = 0):
+               _deadline_ts: float = None, _attempt: int = 0,
+               _tctx=None):
         router = _router_for(self.deployment_name)
         if _attempt == 0:
             _deadline_ts = self._request_deadline_ts()
+            _tctx = self._request_trace_ctx()
+        t0_wall = time.time()
+        # the submit span id is minted BEFORE the call so the replica's
+        # spans can parent to it through the shipped traceparent
+        sid = tracing.new_span_id() if _tctx is not None else ""
         if self._pin is not None:
             # Pinned: no table refresh — the stream lives or dies with
             # its replica, and a mid-rescale empty routing table must
@@ -417,6 +440,9 @@ class DeploymentHandle:
             meta["multiplexed_model_id"] = self._model_id
         if _deadline_ts is not None:
             meta["deadline_ts"] = _deadline_ts
+        if _tctx is not None:
+            meta["traceparent"] = tracing.format_traceparent(
+                tracing.TraceContext(_tctx.trace_id, sid))
         meta = meta or None
         try:
             # proxy->replica chaos boundary (Config.testing_serve_failure)
@@ -437,6 +463,13 @@ class DeploymentHandle:
                 b = router.breakers.get(rid)
                 if b is not None and b.state == fault.HALF_OPEN:
                     router.record(rid, ok=True)
+                if _tctx is not None:
+                    tracing.record_request_span(
+                        "handle", "submit", _tctx, _tctx.span_id,
+                        t0_wall, time.time(), span_id=sid,
+                        deployment=self.deployment_name,
+                        attempt=_attempt, method=method,
+                        replica=rid.hex()[:12])
                 return gen
             if meta is None:
                 ref = replica.handle_request.remote(method, args, kwargs)
@@ -448,6 +481,12 @@ class DeploymentHandle:
             # idempotent by construction, so reroute under the budgeted
             # policy: jittered backoff, attempt- and deadline-capped.
             router.record(rid, ok=False, infra=True)
+            if _tctx is not None:
+                tracing.record_request_span(
+                    "handle", "submit", _tctx, _tctx.span_id,
+                    t0_wall, time.time(), span_id=sid, error=True,
+                    deployment=self.deployment_name, attempt=_attempt,
+                    method=method, replica=rid.hex()[:12])
             if self._pin is not None:
                 raise  # pinned state died with its replica — no rerouting
             if _policy is None:
@@ -460,8 +499,14 @@ class DeploymentHandle:
                 tags={"reason": "reroute"})
             time.sleep(pause)
             return self._route(method, args, kwargs, _policy,
-                               _deadline_ts, _attempt + 1)
+                               _deadline_ts, _attempt + 1, _tctx)
         router.track(rid, ref)
+        if _tctx is not None:
+            tracing.record_request_span(
+                "handle", "submit", _tctx, _tctx.span_id,
+                t0_wall, time.time(), span_id=sid,
+                deployment=self.deployment_name, attempt=_attempt,
+                method=method, replica=rid.hex()[:12])
         return ref
 
     def options(self, multiplexed_model_id: str = None,
@@ -475,4 +520,4 @@ class DeploymentHandle:
                 and dl == self._deadline_s:
             return self
         return DeploymentHandle(self.deployment_name, self._pin, mid, st,
-                                dl, self._deadline_ts)
+                                dl, self._deadline_ts, self._trace)

@@ -36,6 +36,7 @@ from typing import Dict, Optional, Tuple
 
 from ray_tpu import api
 from ray_tpu.serve import fault
+from ray_tpu.util import tracing
 
 
 class _BadRequest(Exception):
@@ -379,30 +380,55 @@ class HTTPProxy:
                     f"X-Request-Deadline must be > 0, got {budget}")
         return time.time() + budget
 
+    def _trace_headers(self, tctx) -> Optional[Dict[str, str]]:
+        """Response headers naming the request's trace — the client-
+        side handle into `ray-tpu trace <id>` / the /traces page."""
+        if tctx is None:
+            return None
+        return {"X-Trace-Id": tctx.trace_id}
+
     def _error_response(self, writer, e: BaseException,
-                        deadline_ts: float, where: str):
+                        deadline_ts: float, where: str,
+                        tctx=None, t0_wall: Optional[float] = None,
+                        dep: Optional[str] = None):
         """Map a dispatch failure to HTTP: shed -> 503 + Retry-After,
-        spent budget -> 504, anything else -> 500."""
+        spent budget -> 504, anything else -> 500. When the request
+        carries a trace, its TAIL lands here: failed requests always
+        survive sampling (finish_request keeps every error)."""
         self._errors += 1
+        hdrs = self._trace_headers(tctx) or {}
+
+        def finish(status: str, code: int):
+            if tctx is not None and t0_wall is not None:
+                tracing.finish_request(
+                    tctx, t0_wall, time.time(), status=status,
+                    error=True, http_status=code,
+                    **({"deployment": dep} if dep else {}))
         if isinstance(e, _Shed):
             self._shed += 1
+            finish("shed", 503)
+            hdrs["Retry-After"] = str(int(math.ceil(e.retry_after_s)))
             return self._respond(
                 writer, 503, {"error": f"overloaded: {e}"},
-                headers={"Retry-After":
-                         str(int(math.ceil(e.retry_after_s)))})
+                headers=hdrs)
         kind = fault.classify_error(e)
         rem = fault.remaining_s(deadline_ts)
         if kind == "deadline" or \
                 (kind == "timeout" and rem is not None and rem <= 0.05):
             self._fm["deadline"].inc(tags={"where": where})
+            finish("deadline", 504)
             return self._respond(writer, 504,
-                                 {"error": f"deadline exceeded: {e}"})
+                                 {"error": f"deadline exceeded: {e}"},
+                                 headers=hdrs or None)
+        finish("error", 500)
         return self._respond(writer, 500,
-                             {"error": f"{type(e).__name__}: {e}"})
+                             {"error": f"{type(e).__name__}: {e}"},
+                             headers=hdrs or None)
 
     async def _dispatch(self, writer, method, path, headers, body):
         self._requests += 1
         t_arrive = time.monotonic()
+        t_arrive_wall = time.time()
         if path == "/-/healthz":
             return self._respond(writer, 200, {"status": "ok"})
         try:
@@ -410,6 +436,15 @@ class HTTPProxy:
         except _BadRequest as e:
             self._errors += 1
             return self._respond(writer, e.code, {"error": str(e)})
+        # One trace per request: join the client's traceparent (W3C) or
+        # mint a fresh root; threaded alongside the deadline budget
+        # through handle -> replica -> engine. None = tracing disabled.
+        client_ctx = tracing.parse_traceparent(headers.get("traceparent"))
+        if client_ctx is not None:
+            tctx = tracing.TraceContext(client_ctx.trace_id,
+                                        tracing.new_span_id())
+        else:
+            tctx = tracing.mint_context()
         try:
             await self._refresh_routes(deadline_ts)
         except Exception as e:
@@ -420,8 +455,16 @@ class HTTPProxy:
             # invalidated the stale cache for the next refresh.
             if not self._routes:
                 self._errors += 1
+                # pre-dispatch failure, but the trace was already
+                # minted: root it so "errors are always kept" holds
+                # for routing outages too, not just replica failures
+                if tctx is not None:
+                    tracing.finish_request(
+                        tctx, t_arrive_wall, time.time(),
+                        status="error", error=True, http_status=500)
                 return self._respond(
-                    writer, 500, {"error": f"route refresh: {e}"})
+                    writer, 500, {"error": f"route refresh: {e}"},
+                    headers=self._trace_headers(tctx))
             # stamp NOW: stale routes keep serving and the (expensive)
             # failing refresh re-runs at most once per second, not on
             # every request during a controller outage
@@ -431,8 +474,13 @@ class HTTPProxy:
         dep = self._match(path)
         if dep is None:
             self._errors += 1
+            if tctx is not None:
+                tracing.finish_request(
+                    tctx, t_arrive_wall, time.time(),
+                    status="error", error=True, http_status=404)
             return self._respond(writer, 404,
-                                 {"error": f"no route for {path}"})
+                                 {"error": f"no route for {path}"},
+                                 headers=self._trace_headers(tctx))
         ctype = headers.get("content-type", "")
         if body and "json" in ctype:
             arg = json.loads(body)
@@ -442,28 +490,40 @@ class HTTPProxy:
             arg = None
         tags = {"deployment": dep}
         adm = self._admission(dep)
+        tq0_wall = time.time()
         try:
-            await adm.acquire(deadline_ts)
+            queued_s = await adm.acquire(deadline_ts)
         except _Shed as e:
             self._fm["shed"].inc(tags=tags)
-            return self._error_response(writer, e, deadline_ts,
-                                        "proxy")
+            return self._error_response(writer, e, deadline_ts, "proxy",
+                                        tctx, t_arrive_wall, dep)
+        if tctx is not None and queued_s > 0:
+            # admission queueing gets its own segment only when the
+            # request actually waited (zero-wait spans are noise)
+            tracing.record_request_span(
+                "proxy", "queue", tctx, tctx.span_id, tq0_wall,
+                tq0_wall + queued_s, deployment=dep)
         try:
             if "text/event-stream" in headers.get("accept", ""):
                 # SSE token streaming (reference: serve streams LLM
                 # responses over HTTP; the stream rides the core
                 # streaming-return path, one `data:` event per token)
                 return await self._dispatch_stream(
-                    writer, dep, arg, t_arrive, deadline_ts)
+                    writer, dep, arg, t_arrive, deadline_ts,
+                    tctx, t_arrive_wall)
             return await self._dispatch_unary(
-                writer, dep, arg, t_arrive, deadline_ts, tags)
+                writer, dep, arg, t_arrive, deadline_ts, tags,
+                tctx, t_arrive_wall)
         finally:
             adm.release()
 
     async def _dispatch_unary(self, writer, dep, arg, t_arrive,
-                              deadline_ts, tags):
+                              deadline_ts, tags, tctx=None,
+                              t_arrive_wall=None):
         loop = asyncio.get_running_loop()
         from ray_tpu.serve.handle import DeploymentHandle
+        wire = (tracing.format_traceparent(tctx)
+                if tctx is not None else None)
 
         # A DRAINING replica rejects before starting (the request never
         # ran), so rerouting it once is always safe; any other failure
@@ -474,11 +534,13 @@ class HTTPProxy:
             try:
                 # Handle routing + submission is the sync caller API —
                 # run it on a thread; await the result on this loop.
-                h = DeploymentHandle(dep, _deadline_ts=deadline_ts)
+                h = DeploymentHandle(dep, _deadline_ts=deadline_ts,
+                                     _trace=wire)
                 ref = await loop.run_in_executor(
                     None, lambda: h.remote(arg) if arg is not None
                     else h.remote())
                 t_sent = time.monotonic()
+                t_sent_wall = time.time()
                 # queue: parse+admission+routing; handler: replica
                 # time. One sample per REQUEST: the draining retry's
                 # second pass would otherwise re-observe a span that
@@ -489,14 +551,25 @@ class HTTPProxy:
                 if rem is None or rem <= 0:
                     raise fault.DeadlineExceeded(
                         "budget spent before the replica call")
+                failed = True
                 try:
                     result = await api.get_async(ref, timeout=rem)
+                    failed = False
                 finally:
                     # failures and deadline timeouts are the tail the
-                    # histogram exists to show — record, then surface
+                    # histogram exists to show — record, then surface.
+                    # The exemplar links the bucket this sample lands
+                    # in to its concrete trace (`ray-tpu trace <id>`).
                     dt = time.monotonic() - t_sent
-                    self._m["handler"].observe(dt, tags)
+                    self._m["handler"].observe(
+                        dt, tags,
+                        exemplar=tctx.trace_id if tctx else None)
                     self._admission(dep).observe_service(dt)
+                    if tctx is not None:
+                        tracing.record_request_span(
+                            "proxy", "handler", tctx, tctx.span_id,
+                            t_sent_wall, time.time(), deployment=dep,
+                            attempt=attempt, error=failed)
             except BaseException as e:  # noqa: BLE001
                 if attempt == 0 and \
                         fault.classify_error(e) == "draining" and \
@@ -510,12 +583,21 @@ class HTTPProxy:
                     self._fm["retries"].inc(tags={"reason": "draining"})
                     continue
                 return self._error_response(writer, e, deadline_ts,
-                                            "proxy")
-            return self._respond(writer, 200, result)
+                                            "proxy", tctx,
+                                            t_arrive_wall, dep)
+            if tctx is not None and t_arrive_wall is not None:
+                tracing.finish_request(
+                    tctx, t_arrive_wall, time.time(), status="ok",
+                    http_status=200, deployment=dep)
+            return self._respond(writer, 200, result,
+                                 headers=self._trace_headers(tctx))
 
     async def _dispatch_stream(self, writer, dep: str, arg,
                                t_arrive: Optional[float] = None,
-                               deadline_ts: Optional[float] = None) -> str:
+                               deadline_ts: Optional[float] = None,
+                               tctx=None,
+                               t_arrive_wall: Optional[float] = None
+                               ) -> str:
         """Server-sent events over the core streaming-return path: one
         streaming call on the deployment's generate_stream generator;
         each produced token is pushed replica -> proxy through the
@@ -541,19 +623,27 @@ class HTTPProxy:
                           {"error": "stream request needs 'tokens'"})
             return "close"
         try:
-            h = DeploymentHandle(dep, _deadline_ts=deadline_ts)
+            h = DeploymentHandle(
+                dep, _deadline_ts=deadline_ts,
+                _trace=(tracing.format_traceparent(tctx)
+                        if tctx is not None else None))
             # submission is the sync caller API — keep it off the loop
             gen = await loop.run_in_executor(
                 None, lambda: h.options(
                     stream=True).generate_stream.remote(tokens, **kw))
         except BaseException as e:  # noqa: BLE001
-            return self._error_response(writer, e, deadline_ts, "proxy")
+            return self._error_response(writer, e, deadline_ts, "proxy",
+                                        tctx, t_arrive_wall, dep)
         tags = {"deployment": dep}
         t_sent = time.monotonic()
+        t_sent_wall = time.time()
+        status = "ok"
         self._m["queue"].observe(t_sent - (t_arrive or t_sent), tags)
+        tid_hdr = (f"X-Trace-Id: {tctx.trace_id}\r\n".encode()
+                   if tctx is not None else b"")
         writer.write(b"HTTP/1.1 200 OK\r\n"
                      b"Content-Type: text/event-stream\r\n"
-                     b"Cache-Control: no-cache\r\n"
+                     b"Cache-Control: no-cache\r\n" + tid_hdr +
                      b"Connection: close\r\n\r\n")
         try:
             async for ref in gen:
@@ -575,8 +665,10 @@ class HTTPProxy:
             # killing the connection handler with an unhandled exception
             self._errors += 1
             kind = fault.classify_error(e)
-            if kind == "deadline" or (kind == "timeout" and
-                                      deadline_ts is not None):
+            status = "deadline" if kind == "deadline" or (
+                kind == "timeout" and deadline_ts is not None) \
+                else "error"
+            if status == "deadline":
                 self._fm["deadline"].inc(tags={"where": "proxy"})
             gen.close()     # budget spent: stop the replica's stream
             try:
@@ -593,7 +685,17 @@ class HTTPProxy:
             # recorded in the histogram but NOT fed to the admission
             # EWMA (a 60s generation would poison the per-call queue-
             # wait prediction unary sheds are computed from)
-            self._m["handler"].observe(time.monotonic() - t_sent, tags)
+            self._m["handler"].observe(
+                time.monotonic() - t_sent, tags,
+                exemplar=tctx.trace_id if tctx else None)
+            if tctx is not None:
+                tracing.record_request_span(
+                    "proxy", "handler", tctx, tctx.span_id,
+                    t_sent_wall, time.time(), deployment=dep,
+                    error=status != "ok")
+                tracing.finish_request(
+                    tctx, t_arrive_wall or t_sent_wall, time.time(),
+                    status=status, deployment=dep)
         return "close"
 
     def _respond(self, writer, code: int, payload, close: bool = False,

@@ -19,6 +19,7 @@ import cloudpickle
 
 from ray_tpu.serve import fault
 from ray_tpu.serve.chaos import apply_async as _chaos_apply, chaos_fire
+from ray_tpu.util import tracing
 
 
 def replica_metrics() -> dict:
@@ -82,9 +83,12 @@ class Replica:
 
     async def _admit(self, meta: Optional[dict]):
         """Entry gate shared by the unary and streaming paths: serve
-        chaos (replica->engine boundary), drain rejection, and the
-        deadline pre-check + context bind. Returns the deadline reset
-        token (the deadline itself rides fault.current_deadline_ts())."""
+        chaos (replica->engine boundary), drain rejection, the deadline
+        pre-check + context bind, and the TRACE context bind. Returns
+        (deadline token, deadline, incoming trace ctx, trace token,
+        handler span id): the handler span id is minted HERE and bound
+        as the ambient context so the engine — and anything user code
+        submits — parents its spans to this replica's handler span."""
         await _chaos_apply(chaos_fire("replica"), "replica")
         if self._draining:
             # reject BEFORE any user code: the caller can reroute this
@@ -98,7 +102,12 @@ class Replica:
             raise fault.DeadlineExceeded(
                 f"budget spent before replica {self.replica_id} "
                 "started the request")
-        return fault.set_request_deadline(dl), dl
+        pctx = tracing.parse_traceparent((meta or {}).get("traceparent"))
+        hid = tracing.new_span_id() if pctx is not None else ""
+        tr_token = tracing.set_request_context(
+            tracing.TraceContext(pctx.trace_id, hid)) \
+            if pctx is not None else None
+        return fault.set_request_deadline(dl), dl, pctx, tr_token, hid
 
     async def handle_request(self, method: str, args: tuple, kwargs: dict,
                              meta: Optional[dict] = None):
@@ -112,9 +121,12 @@ class Replica:
         import contextvars
 
         from ray_tpu.serve.multiplex import _current_model_id
-        dl_token, dl = await self._admit(meta)
+        dl_token, dl, pctx, tr_token, hid = await self._admit(meta)
         self._ongoing += 1
         t_arrive = time.monotonic()
+        t_arrive_wall = time.time()
+        qdur = [0.0]             # set where the queue phase ends
+        ok = False
         tags = {"deployment": self.deployment_name}
         token = None
         mid = (meta or {}).get("multiplexed_model_id")
@@ -127,7 +139,8 @@ class Replica:
             fn = getattr(self.instance, method)
             if inspect.iscoroutinefunction(fn):
                 t_run = time.monotonic()
-                self._m["queue"].observe(t_run - t_arrive, tags)
+                qdur[0] = t_run - t_arrive
+                self._m["queue"].observe(qdur[0], tags)
                 try:
                     if dl is not None:
                         try:
@@ -156,7 +169,8 @@ class Replica:
                     # queue includes the thread-pool hop; timed on the
                     # worker thread so a saturated pool shows up here
                     t_run = time.monotonic()
-                    self._m["queue"].observe(t_run - t_arrive, tags)
+                    qdur[0] = t_run - t_arrive
+                    self._m["queue"].observe(qdur[0], tags)
                     try:
                         return ctx.run(fn, *args, **kwargs)
                     finally:
@@ -165,11 +179,27 @@ class Replica:
 
                 out = await loop.run_in_executor(None, _run)
             self._processed += 1
+            ok = True
             return out
         except BaseException:
             self._errors += 1
             raise
         finally:
+            if tr_token is not None:
+                tracing.reset_request_context(tr_token)
+            if pctx is not None:
+                # replica hop segments: queue (arrival -> user-code
+                # start) then handler (user code; the span the engine's
+                # spans parent to via the bound context)
+                tracing.record_request_span(
+                    "replica", "queue", pctx, pctx.span_id,
+                    t_arrive_wall, t_arrive_wall + qdur[0],
+                    deployment=self.deployment_name)
+                tracing.record_request_span(
+                    "replica", "handler", pctx, pctx.span_id,
+                    t_arrive_wall + qdur[0], time.time(), span_id=hid,
+                    error=not ok, deployment=self.deployment_name,
+                    method=method, replica=self.replica_id)
             fault.reset_request_deadline(dl_token)
             if token is not None:
                 _current_model_id.reset(token)
@@ -192,9 +222,11 @@ class Replica:
         it, reclaiming its slot); the stream itself is cut the moment
         the budget is spent."""
         from ray_tpu.serve.multiplex import _current_model_id
-        dl_token, dl = await self._admit(meta)
+        dl_token, dl, pctx, tr_token, hid = await self._admit(meta)
         self._ongoing += 1
         t_run = time.monotonic()
+        t_run_wall = time.time()
+        ok = False
         tags = {"deployment": self.deployment_name}
         token = None
         mid = (meta or {}).get("multiplexed_model_id")
@@ -227,9 +259,11 @@ class Replica:
                     f"streaming call to {method!r}, which is not a "
                     "generator method")
             self._processed += 1
+            ok = True
         except GeneratorExit:
             # client walked away mid-stream (gen.close()): a routine
             # disconnect, not a replica failure — don't count it
+            ok = True
             raise
         except BaseException:
             self._errors += 1
@@ -238,6 +272,14 @@ class Replica:
             # a stream's "handler" span covers the whole generation —
             # the stream IS the call
             self._m["handler"].observe(time.monotonic() - t_run, tags)
+            if tr_token is not None:
+                tracing.reset_request_context(tr_token)
+            if pctx is not None:
+                tracing.record_request_span(
+                    "replica", "handler", pctx, pctx.span_id,
+                    t_run_wall, time.time(), span_id=hid,
+                    error=not ok, deployment=self.deployment_name,
+                    method=method, replica=self.replica_id)
             fault.reset_request_deadline(dl_token)
             if token is not None:
                 _current_model_id.reset(token)

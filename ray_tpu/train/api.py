@@ -412,6 +412,65 @@ class TrainContext:
             raise KeyError(f"no dataset shard named {name!r}")
         return shard
 
+    def trace_step(self, name: str = "train_step"):
+        """Context manager tracing ONE training step as a request-plane
+        trace: mints a root trace context (or joins the ambient one),
+        binds it so nested task submissions join, and records a root
+        span tagged with the CURRENT ``collective_step`` — the same tag
+        the ring tracer stamps on this step's collective rounds, so
+        ``ray-tpu trace <id>`` pulls the step's ring lanes into the
+        waterfall next to the step span. Usage::
+
+            with ctx.trace_step() as trace_id:
+                grads = compute(...)
+                params, state = opt.update(grads, state, params)
+        """
+        import contextlib
+
+        from ray_tpu.util import tracing
+
+        @contextlib.contextmanager
+        def _span():
+            # join the ambient trace as a CHILD span (nested
+            # trace_step, or a step opened inside a traced request);
+            # only the outermost mint is the trace's root
+            ambient = tracing.current_context()
+            if ambient is not None:
+                tctx = tracing.TraceContext(ambient.trace_id,
+                                            tracing.new_span_id())
+                parent, root = ambient.span_id, False
+            else:
+                tctx = tracing.mint_context()
+                parent, root = "", True
+            if tctx is None:            # request tracing disabled
+                yield None
+                return
+            tok = tracing.set_request_context(tctx)
+            step = self.collective_step
+            # the ring group id scopes the step tag: filter_trace then
+            # pulls only THIS group's rounds (two jobs sharing a step
+            # index must not cross-wire)
+            group = (self._grad_sync or {}).get("group")
+            t0, ok = time.time(), False
+            try:
+                yield tctx.trace_id
+                ok = True
+            finally:
+                tracing.reset_request_context(tok)
+                extra = {"group": group} if group else {}
+                if root:
+                    # the outermost step span IS the trace's root —
+                    # train-step traces are few and hand-opened, so
+                    # they always surface (unlike serve QPS, which
+                    # the proxy tail-samples)
+                    extra.update(root=True, keep="train",
+                                 status="ok" if ok else "error")
+                tracing.record_request_span(
+                    "train", name, tctx, parent, t0, time.time(),
+                    span_id=tctx.span_id, error=not ok,
+                    step=step, rank=self.rank, **extra)
+        return _span()
+
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
         self._seq += 1

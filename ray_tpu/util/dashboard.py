@@ -37,7 +37,8 @@ th{background:#20242a} .num{text-align:right}
 _NAV = ("<nav><a href='/'>overview</a><a href='/nodes'>nodes</a>"
         "<a href='/actors'>actors</a><a href='/jobs'>jobs</a>"
         "<a href='/pgs'>placement groups</a><a href='/serve'>serve</a>"
-        "<a href='/tasks'>tasks</a><a href='/history'>history</a>"
+        "<a href='/tasks'>tasks</a><a href='/traces'>traces</a>"
+        "<a href='/history'>history</a>"
         "<a href='/profile'>profile</a>"
         "<a href='/metrics'>metrics</a></nav>")
 
@@ -272,6 +273,71 @@ async def _tasks(fetch: Fetch, query: str = "") -> bytes:
     return _page("tasks", body)
 
 
+async def _traces(fetch: Fetch, query: str = "") -> bytes:
+    """Recent SAMPLED request traces (tail-based keep at the proxy:
+    every error/deadline/slow trace plus a trace_sample_rate fraction
+    of healthy ones), errors first then slowest first — the entry
+    point into `ray-tpu trace <id>` waterfalls."""
+    from urllib.parse import parse_qs
+
+    from ray_tpu.util.state import summarize_traces, traces_from_events
+    r = await fetch("collect_timeline")
+    evs = r.get("events", [])
+    q = parse_qs(query or "")
+    tid = (q.get("trace") or [""])[0]
+    if tid:
+        # one trace drilled open: its spans, oldest first
+        from ray_tpu.util.tracing import filter_trace
+        spans = sorted(
+            (e for e in filter_trace(evs, tid)
+             if e.get("cat") == "request"),
+            key=lambda e: e.get("ts", 0.0))
+        rows = []
+        for e in spans:
+            rows.append((
+                _esc(e.get("component", "?")),
+                _esc(e.get("seg", "?")),
+                f"{(e.get('dur') or 0.0) * 1e3:.2f}",
+                _esc(time.strftime("%H:%M:%S",
+                                   time.localtime(e.get("ts") or 0))),
+                _esc(f"{str(e.get('node', ''))[:8]}/pid "
+                     f"{e.get('pid', '?')}"),
+                _state("ok" if not e.get("error") else "ERROR",
+                       good=("ok",)),
+            ))
+        body = (f"<p class=dim>trace <code>{_esc(tid)}</code> — "
+                f"{len(rows)} spans; waterfall: <code>ray-tpu trace "
+                f"{_esc(tid)}</code></p>"
+                + _table(("component", "segment", "duration (ms)",
+                          "started", "where", "status"), rows))
+        return _page(f"trace {tid[:12]}", body)
+    rows_in = traces_from_events(evs, limit=100)
+    s = summarize_traces(rows_in)
+    rows = []
+    for t in rows_in:
+        rows.append((
+            f"<a href='/traces?trace={_esc(t['trace_id'])}'>"
+            f"{_esc(t['trace_id'][:16])}</a>",
+            _state(t.get("status") or "?", good=("ok",)),
+            _esc(t.get("keep") or "-"),
+            _esc(t.get("deployment") or "-"),
+            f"{(t['duration_s'] or 0.0) * 1e3:.1f}",
+            str(t["spans"]),
+            _esc(",".join(t["components"])),
+            _esc(time.strftime(
+                "%H:%M:%S", time.localtime(t["start_time"] or 0))),
+        ))
+    body = (f"<p class=dim>{s['traces']} sampled traces "
+            f"({s['errors']} errors; errors first, then slowest; "
+            f"mean {s['mean_duration_s'] * 1e3:.1f} ms, max "
+            f"{s['max_duration_s'] * 1e3:.1f} ms). Waterfall: "
+            f"<code>ray-tpu trace &lt;id&gt;</code></p>"
+            + _table(("trace", "status", "kept", "deployment",
+                      "duration (ms)", "spans", "components",
+                      "started"), rows))
+    return _page("traces", body)
+
+
 # --- time-series history ----------------------------------------------
 # The reference provisions Prometheus + Grafana for dashboard history
 # (dashboard/modules/metrics/); here a bounded in-process ring sampled
@@ -456,8 +522,8 @@ async def _profile(fetch: Fetch, query: str = "") -> bytes:
 
 _PAGES = {"/": _overview, "/overview": _overview, "/nodes": _nodes,
           "/actors": _actors, "/jobs": _jobs, "/pgs": _pgs,
-          "/serve": _serve, "/tasks": _tasks, "/history": _history,
-          "/profile": _profile}
+          "/serve": _serve, "/tasks": _tasks, "/traces": _traces,
+          "/history": _history, "/profile": _profile}
 
 
 async def render(path: str, fetchers, query: str = "") -> Optional[bytes]:

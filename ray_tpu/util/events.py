@@ -33,16 +33,23 @@ from typing import Deque, Dict, List
 #   worker      worker lifecycle incidents (runtime/agent.py)
 #   cgroup      cgroup attach/availability incidents (runtime/agent.py)
 #   memory      memory-monitor OOM kills (runtime/agent.py)
+#   request     per-request trace spans: proxy/handle/replica/engine
+#               segments + engine batch spans (util/tracing.py request
+#               layer, serve/*, llm/engine.py)
 CATEGORIES = ("trace", "collective", "train", "worker", "cgroup",
-              "memory")
+              "memory", "request")
 
 _DEFAULT_CAP = 65536
 # Dedicated sub-budgets: the key also names the bucket. Everything
 # else shares the "" bucket at _DEFAULT_CAP. "train" is budget-capped
 # like "collective": a crash-looping group emitting restart/reshard
 # spans every few seconds must age out against itself, not evict the
-# task exec spans the timeline is built on.
-_CATEGORY_CAPS: Dict[str, int] = {"collective": 16384, "train": 4096}
+# task exec spans the timeline is built on. "request" likewise: a
+# high-QPS serve path emits ~6 spans per request — a traffic burst
+# must age out against its own bucket, never the task exec or
+# collective spans.
+_CATEGORY_CAPS: Dict[str, int] = {"collective": 16384, "train": 4096,
+                                  "request": 8192}
 
 _BUFS: Dict[str, Deque[dict]] = {}
 _LOCK = threading.Lock()

@@ -12,6 +12,7 @@ live lease/object-store gauges without bookkeeping on the hot path).
 from __future__ import annotations
 
 import asyncio
+import re
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -99,7 +100,17 @@ class Gauge(Metric):
 
 
 class Histogram(Metric):
-    """Fixed-boundary histogram rendered in Prometheus cumulative form."""
+    """Fixed-boundary histogram rendered in Prometheus cumulative form.
+
+    Exemplars: ``observe(..., exemplar=<trace id>)`` keeps the LAST
+    exemplar per bucket and rendering appends it OpenMetrics-style
+    (``... # {trace_id="..."} <value> <ts>``) — a p99 bucket links to a
+    concrete request trace (`ray-tpu trace <id>`) instead of being an
+    anonymous count. Exemplar tails are not legal in the classic
+    Prometheus text format, so the /metrics endpoint strips them
+    unless the caller opts in with ``?exemplars=1`` (see
+    strip_exemplars / MetricsServer) — internally they always render,
+    which is how the worker push path carries them to the head."""
 
     kind = "histogram"
 
@@ -113,12 +124,16 @@ class Histogram(Metric):
         self.boundaries = tuple(sorted(boundaries))
         self._counts: Dict[tuple, List[int]] = {}
         self._sums: Dict[tuple, float] = {}
+        # labels key -> {bucket index: (exemplar id, value, ts)}
+        self._exemplars: Dict[tuple, Dict[int, tuple]] = {}
         if isinstance(existing, Histogram) \
                 and existing.boundaries == self.boundaries:
             self._counts = existing._counts
             self._sums = existing._sums
+            self._exemplars = existing._exemplars
 
-    def observe(self, value: float, tags: Optional[dict] = None):
+    def observe(self, value: float, tags: Optional[dict] = None,
+                exemplar: Optional[str] = None):
         key = _labels_key(tags)
         with _LOCK:
             counts = self._counts.setdefault(
@@ -128,23 +143,35 @@ class Histogram(Metric):
                 i += 1
             counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
+            if exemplar:
+                self._exemplars.setdefault(key, {})[i] = (
+                    str(exemplar), value, time.time())
 
     def render(self, extra: Tuple[Tuple[str, str], ...] = ()) -> str:
         lines = [f"# HELP {self.name} {self.description}",
                  f"# TYPE {self.name} histogram"]
         with _LOCK:
-            items = [(k, list(c), self._sums.get(k, 0.0))
+            items = [(k, list(c), self._sums.get(k, 0.0),
+                      dict(self._exemplars.get(k) or ()))
                      for k, c in self._counts.items()]
-        for key, counts, total in items:
+        for key, counts, total, exemplars in items:
             key = extra + key
             cum = 0
-            for b, c in zip(self.boundaries, counts):
+            for i, (b, c) in enumerate(zip(self.boundaries, counts)):
                 cum += c
                 lk = key + (("le", f"{b:g}"),)
-                lines.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+                ex = exemplars.get(i)
+                tail = (f' # {{trace_id="{ex[0]}"}} {ex[1]:g} '
+                        f"{ex[2]:.3f}") if ex else ""
+                lines.append(
+                    f"{self.name}_bucket{_fmt_labels(lk)} {cum}{tail}")
             cum += counts[-1]
             lk = key + (("le", "+Inf"),)
-            lines.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+            ex = exemplars.get(len(self.boundaries))
+            tail = (f' # {{trace_id="{ex[0]}"}} {ex[1]:g} '
+                    f"{ex[2]:.3f}") if ex else ""
+            lines.append(
+                f"{self.name}_bucket{_fmt_labels(lk)} {cum}{tail}")
             lines.append(f"{self.name}_sum{_fmt_labels(key)} {total:g}")
             lines.append(f"{self.name}_count{_fmt_labels(key)} {cum}")
         return "\n".join(lines)
@@ -189,6 +216,23 @@ def unregister_collector(fn: Callable[[], str]) -> None:
             _COLLECTORS.remove(fn)
         except ValueError:
             pass
+
+
+# An exemplar tail as Histogram.render emits it: ` # {labels} value
+# [ts]`. The classic Prometheus text format (0.0.4) permits only an
+# optional timestamp after the value — a stock scraper REJECTS the
+# whole scrape on the '#'. The serving endpoint strips these unless
+# the client negotiated OpenMetrics; stripping at the ONE serving
+# boundary also covers worker-pushed snapshot text, which is rendered
+# remotely (with exemplars) before the scraper's Accept is known.
+_EXEMPLAR_TAIL_RE = re.compile(
+    r" # \{[^}]*\} \S+( \d+(\.\d+)?)?$", re.MULTILINE)
+
+
+def strip_exemplars(text: str) -> str:
+    """Drop exemplar tails from rendered metric text (classic
+    Prometheus text-format compatibility)."""
+    return _EXEMPLAR_TAIL_RE.sub("", text)
 
 
 def render_all() -> str:
@@ -385,7 +429,21 @@ class MetricsServer:
                 if line in (b"\r\n", b"\n", b""):
                     break
             if path.startswith("/metrics"):
-                body = render_all().encode()
+                # exemplar tails use OpenMetrics syntax the classic
+                # text format does not permit — a stock Prometheus
+                # scrape would reject EVERY sample over the '#'. The
+                # default scrape is therefore always stripped;
+                # ?exemplars=1 is the explicit human/tooling opt-in
+                # (we deliberately do NOT negotiate on Accept: stock
+                # Prometheus advertises openmetrics-text by default,
+                # and this endpoint's counter naming — family name ==
+                # sample name, lint-suffixed `_total` — is not strict
+                # OpenMetrics, so claiming that content type would
+                # break the scrape we just protected).
+                text = render_all()
+                if "exemplars=1" not in (query or ""):
+                    text = strip_exemplars(text)
+                body = text.encode()
                 ctype = "text/plain; version=0.0.4"
                 code = "200 OK"
             elif path.startswith("/healthz"):

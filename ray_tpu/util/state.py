@@ -113,6 +113,75 @@ def collectives_from_events(events, limit: int = 50) -> List[dict]:
     return rows[:limit]
 
 
+def traces_from_events(events, limit: int = 100) -> List[dict]:
+    """Timeline "request" spans -> one row per SAMPLED trace (a trace
+    is sampled iff its proxy-side ROOT span was recorded — util/tracing
+    finish_request's tail-based keep decision; rootless segment spans
+    age out without surfacing). The ONE place the request-span shape is
+    aggregated — `ray-tpu trace` and the dashboard /traces page both
+    render these rows. Sorted errors first, then by duration."""
+    traces: dict = {}
+    for e in events:
+        if e.get("cat") != "request":
+            continue
+        tid = e.get("trace")
+        if not tid:
+            continue            # batch spans belong to many traces
+        t = traces.setdefault(tid, {
+            "trace_id": tid, "root": False, "status": None,
+            "keep": None, "deployment": None, "start_time": None,
+            "duration_s": 0.0, "error": False, "spans": 0,
+            "components": set(), "nodes": set()})
+        t["spans"] += 1
+        t["components"].add(e.get("component", "?"))
+        node = str(e.get("node", ""))[:8]
+        if node:
+            t["nodes"].add(node)
+        if e.get("error"):
+            t["error"] = True
+        if e.get("root"):
+            t["root"] = True
+            t["status"] = e.get("status")
+            t["keep"] = e.get("keep")
+            t["start_time"] = e.get("ts")
+            t["duration_s"] = e.get("dur", 0.0)
+            t["deployment"] = e.get("deployment")
+    rows = [dict(t, components=sorted(t["components"]),
+                 nodes=sorted(t["nodes"]))
+            for t in traces.values() if t["root"]]
+    rows.sort(key=lambda x: (not x["error"], -(x["duration_s"] or 0)))
+    return rows[:limit]
+
+
+def summarize_traces(rows: List[dict]) -> dict:
+    """Roll-up over sampled-trace rows: counts by status/keep reason
+    and latency extremes — the /traces page header and the CLI
+    footer."""
+    out = {"traces": len(rows), "errors": 0,
+           "by_status": {}, "by_keep": {},
+           "max_duration_s": 0.0, "mean_duration_s": 0.0}
+    total = 0.0
+    for r in rows:
+        if r["error"]:
+            out["errors"] += 1
+        s = r.get("status") or "?"
+        out["by_status"][s] = out["by_status"].get(s, 0) + 1
+        k = r.get("keep") or "?"
+        out["by_keep"][k] = out["by_keep"].get(k, 0) + 1
+        d = r.get("duration_s") or 0.0
+        total += d
+        out["max_duration_s"] = max(out["max_duration_s"], d)
+    out["mean_duration_s"] = total / max(1, len(rows))
+    return out
+
+
+def list_traces(limit: int = 100) -> List[dict]:
+    """Recent sampled request traces off the cluster timeline
+    (`ray-tpu trace` with no id, from Python)."""
+    r = _call("collect_timeline")
+    return traces_from_events(r.get("events", []), limit)
+
+
 def summarize_collectives(rows: List[dict]) -> List[dict]:
     """Aggregate collective rows per (kind, op, codec): round count,
     mean/max round time, bytes per round, and the modal straggler rank

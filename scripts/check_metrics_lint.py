@@ -154,31 +154,47 @@ def lint_category_caps() -> list:
         if cat not in events.CATEGORIES)
 
 
-def chaos_knobs() -> list:
-    """Every ``testing_*_failure`` deterministic-fault-injection knob in
-    ray_tpu/config.py Config (rpc, channel, serve, ...)."""
+# THE registry of lint-enforced Config knob families: family label ->
+# (name prefix, name suffix). Every knob matching a family must be
+# exercised by at least one test module — register new families here
+# (one line) instead of cloning the scan.
+KNOB_FAMILIES = {
+    # deterministic fault injection (rpc, channel, serve, ...;
+    # reference: rpc_chaos.h is exercised by its own gtest)
+    "chaos": ("testing_", "_failure"),
+    # collective auto-tuner (master switch, probe payload, chunk floor)
+    "tuner": ("collective_tuner", ""),
+    # request tracing (tail-sampling rate, slow-keep threshold)
+    "trace": ("trace_", ""),
+}
+
+
+def family_knobs(family: str) -> list:
+    """Every ray_tpu/config.py Config knob in one lint family."""
     from dataclasses import fields
 
     from ray_tpu.config import Config
+    prefix, suffix = KNOB_FAMILIES[family]
     return sorted(f.name for f in fields(Config)
-                  if f.name.startswith("testing_")
-                  and f.name.endswith("_failure"))
+                  if f.name.startswith(prefix)
+                  and f.name.endswith(suffix))
+
+
+def chaos_knobs() -> list:
+    return family_knobs("chaos")
 
 
 def tuner_knobs() -> list:
-    """Every ``collective_tuner*`` auto-tuner knob in
-    ray_tpu/config.py Config (master switch, probe payload, chunk
-    floor, ...)."""
-    from dataclasses import fields
+    return family_knobs("tuner")
 
-    from ray_tpu.config import Config
-    return sorted(f.name for f in fields(Config)
-                  if f.name.startswith("collective_tuner"))
+
+def trace_knobs() -> list:
+    return family_knobs("trace")
 
 
 def _lint_knob_tests(label: str, knobs: list,
                      tests_dir: str = None) -> list:
-    """THE knob-coverage scan both knob lints share: every named
+    """THE knob-coverage scan every knob family shares: each named
     Config knob must appear in at least one test module (by name or
     RAY_TPU_* env form) — a config surface nothing exercises rots
     silently."""
@@ -199,20 +215,23 @@ def _lint_knob_tests(label: str, knobs: list,
         if k not in blob and f"RAY_TPU_{k.upper()}" not in blob)
 
 
+def lint_knob_tests(families=None, tests_dir: str = None) -> list:
+    """Violations across ALL registered knob families (or the named
+    subset) — main() runs this one scan instead of per-family copies."""
+    out = []
+    for fam in (families if families is not None else KNOB_FAMILIES):
+        out += _lint_knob_tests(fam, family_knobs(fam), tests_dir)
+    return sorted(out)
+
+
 def lint_tuner_knob_tests(tests_dir: str = None,
                           knobs: list = None) -> list:
-    """Violations for collective-tuner config knobs no pytest
-    exercises (every ``collective_tuner*`` knob, same rule as the
-    chaos knobs)."""
     return _lint_knob_tests(
         "tuner", tuner_knobs() if knobs is None else knobs, tests_dir)
 
 
 def lint_chaos_knob_tests(tests_dir: str = None,
                           knobs: list = None) -> list:
-    """Violations for chaos config knobs no pytest exercises
-    (reference: rpc_chaos.h is exercised by its own gtest): every
-    ``testing_*_failure`` knob."""
     return _lint_knob_tests(
         "chaos", chaos_knobs() if knobs is None else knobs, tests_dir)
 
@@ -224,8 +243,7 @@ def main() -> int:
     found = scan_event_categories()
     errors += lint_event_categories(found)
     errors += lint_category_caps()
-    errors += lint_chaos_knob_tests()
-    errors += lint_tuner_knob_tests()
+    errors += lint_knob_tests()
     if errors:
         print(f"{len(errors)} metric/event lint violation(s):")
         for e in errors:

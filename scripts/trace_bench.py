@@ -1,0 +1,174 @@
+"""Request-tracing overhead A/B: serve throughput traced vs untraced.
+
+Method: the COLLECTIVE_TRACE_BENCH recipe — min-of-3 INTERLEAVED
+(off, on, off, on, ...) so drift hits both arms equally; the headline
+is best-of-reps throughput per arm. Each rep is a fresh one-node
+cluster + echo deployment driven closed-loop over the REAL HTTP proxy
+path (proxy -> handle -> replica and back): an echo handler is the
+most tracing-sensitive workload — there is no model time to hide the
+per-request span records behind.
+
+Arms:
+  off  RAY_TPU_TRACE_TASKS=0 RAY_TPU_TRACE_REQUESTS=0 (tracing off;
+       task events stay on, as in production-off)
+  on   defaults: task tracing on, request tracing on at the DEFAULT
+       sampling knobs (Config.trace_sample_rate)
+
+Tracing flags are read at process import, so each (rep, arm) runs in a
+fresh subprocess (the workers a cluster spawns inherit its env).
+
+Run from the repo root: python scripts/trace_bench.py
+Commit the aggregate JSON to TRACE_BENCH.json.
+"""
+
+import argparse
+import http.client
+import json
+import os
+import statistics
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+
+def one_run(requests: int, concurrency: int) -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=max(4, concurrency))
+
+    @serve.deployment(max_ongoing_requests=concurrency)
+    class Echo:
+        async def __call__(self, v=None):
+            return {"ok": True, "n": len(v or {})}
+
+    serve.run(Echo.bind(), name="bench", route_prefix="/bench")
+    addr = serve.proxy_address()
+    body = json.dumps({"k": 1}).encode()
+
+    def post(conn):
+        conn.request("POST", "/bench", body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 200, r.status
+
+    # warm: routing table, admission, handle router, connections
+    warm = http.client.HTTPConnection(addr["host"], addr["port"],
+                                      timeout=30)
+    for _ in range(10):
+        post(warm)
+    warm.close()
+
+    lat = [None] * requests
+    idx = {"v": 0}
+    lock = threading.Lock()
+
+    def worker():
+        conn = http.client.HTTPConnection(addr["host"], addr["port"],
+                                          timeout=30)
+        while True:
+            with lock:
+                i = idx["v"]
+                if i >= requests:
+                    break
+                idx["v"] += 1
+            t0 = time.monotonic()
+            post(conn)
+            lat[i] = time.monotonic() - t0
+        conn.close()
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    lats = sorted(x for x in lat if x is not None)
+    out = {
+        "requests": len(lats),
+        "elapsed_s": round(elapsed, 4),
+        "req_per_s": round(len(lats) / elapsed, 2),
+        "p50_ms": round(statistics.median(lats) * 1e3, 3),
+        "p99_ms": round(lats[int(len(lats) * 0.99) - 1] * 1e3, 3),
+    }
+    serve.shutdown()
+    ray_tpu.shutdown()
+    return out
+
+
+ARMS = {
+    "off": {"RAY_TPU_TRACE_TASKS": "0", "RAY_TPU_TRACE_REQUESTS": "0"},
+    "on": {},
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=300)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--one-run", action="store_true",
+                    help="internal: run one arm in THIS process and "
+                         "print its JSON line")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the aggregate JSON here too")
+    args = ap.parse_args()
+    if args.one_run:
+        print("RESULT " + json.dumps(
+            one_run(args.requests, args.concurrency)))
+        return 0
+    results = []
+    for rep in range(args.reps):
+        for arm, env in ARMS.items():       # interleaved: off, on, ...
+            child_env = dict(os.environ)
+            child_env.pop("PYTHONPATH", None)
+            child_env.update(env)
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--one-run", "--requests", str(args.requests),
+                 "--concurrency", str(args.concurrency)],
+                env=child_env, capture_output=True, text=True,
+                timeout=900)
+            line = next((ln for ln in p.stdout.splitlines()
+                         if ln.startswith("RESULT ")), None)
+            if p.returncode != 0 or line is None:
+                print(p.stdout[-2000:], p.stderr[-2000:],
+                      file=sys.stderr)
+                raise RuntimeError(f"run failed: rep={rep} arm={arm}")
+            r = {"arm": arm, "rep": rep, **json.loads(line[7:])}
+            print(json.dumps(r))
+            results.append(r)
+    best = {arm: max((r for r in results if r["arm"] == arm),
+                     key=lambda r: r["req_per_s"])
+            for arm in ARMS}
+    agg = {
+        "bench": "request_trace_overhead",
+        "method": "min-of-3 interleaved closed-loop over the HTTP "
+                  "proxy (echo deployment; best rep per arm)",
+        "requests_per_rep": args.requests,
+        "concurrency": args.concurrency,
+        "reps": args.reps,
+        "results": results,
+        "best_req_per_s": {a: best[a]["req_per_s"] for a in best},
+        "traced_on_vs_off_throughput": round(
+            best["on"]["req_per_s"] / best["off"]["req_per_s"], 4),
+        "traced_on_vs_off_p50": round(
+            best["on"]["p50_ms"] / best["off"]["p50_ms"], 4),
+    }
+    print(json.dumps(agg, indent=2))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(agg, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,21 @@ def test_framework_metrics_pass_lint():
     assert errors == []
 
 
+def test_knob_families_fold_into_one_shared_scan():
+    """The chaos/tuner/trace knob lints are ONE registry-driven scan
+    (lint_knob_tests over KNOB_FAMILIES), not per-family copies; the
+    legacy per-family entry points stay as thin wrappers."""
+    mod = _load_linter()
+    assert set(mod.KNOB_FAMILIES) >= {"chaos", "tuner", "trace"}
+    assert mod.lint_knob_tests() == []
+    # the fold is real: family wrappers and the shared scan agree
+    assert mod.lint_knob_tests(families=["tuner"]) \
+        == mod.lint_tuner_knob_tests()
+    assert mod.lint_knob_tests(families=["chaos"]) \
+        == mod.lint_chaos_knob_tests()
+    assert mod.family_knobs("trace") == mod.trace_knobs()
+
+
 def test_tuner_knobs_enumerated_and_exercised():
     """Every Config collective_tuner* knob is exercised by at least
     one test module — a tuned decision surface nothing validates rots

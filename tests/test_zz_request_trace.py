@@ -1,0 +1,652 @@
+"""End-to-end request tracing (util/tracing.py request layer, serve
+proxy/handle/replica threading, llm engine spans, tail-based sampling,
+`ray-tpu trace` surfaces): one W3C-style trace id follows a request
+from the proxy's HTTP boundary through the handle, replica, engine
+batch slots, and nested tasks. Late-alphabet module name keeps the
+tier-1 870 s cutoff stable."""
+
+import asyncio
+import http.client
+import json
+import os
+import time
+
+import pytest
+
+from ray_tpu.util import events, tracing
+
+
+def _clean_events():
+    events.clear()
+
+
+# -- trace context: mint / parse / format ------------------------------------
+
+def test_traceparent_mint_format_parse_roundtrip():
+    ctx = tracing.mint_context()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    int(ctx.trace_id, 16), int(ctx.span_id, 16)   # valid hex
+    wire = tracing.format_traceparent(ctx)
+    assert wire == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    back = tracing.parse_traceparent(wire)
+    assert back == ctx
+    assert back.trace_id == ctx.trace_id
+    # ids are unique per mint
+    assert tracing.mint_context().trace_id != ctx.trace_id
+
+
+def test_parse_traceparent_rejects_malformed_and_zero_ids():
+    for bad in (None, "", "junk", "00-abc-def-01",
+                "00-" + "g" * 32 + "-" + "1" * 16 + "-01",   # not hex
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # zero trace
+                "00-" + "1" * 32 + "-" + "0" * 16 + "-01",   # zero span
+                "zz-" + "1" * 32 + "-" + "2" * 16 + "-01"):  # bad ver
+        assert tracing.parse_traceparent(bad) is None, bad
+    # case-insensitive per W3C: upper-case hex parses, lowered
+    up = "00-" + "A" * 32 + "-" + "B" * 16 + "-01"
+    ctx = tracing.parse_traceparent(up)
+    assert ctx is not None and ctx.trace_id == "a" * 32
+
+
+def test_context_bind_wire_and_trace_id():
+    assert tracing.current_context() is None
+    assert tracing.current_trace_id() == ""
+    assert tracing.wire_context() is None
+    ctx = tracing.mint_context()
+    tok = tracing.set_request_context(ctx)
+    try:
+        assert tracing.current_context() == ctx
+        assert tracing.current_trace_id() == ctx.trace_id
+        assert tracing.parse_traceparent(tracing.wire_context()) == ctx
+    finally:
+        tracing.reset_request_context(tok)
+    assert tracing.current_context() is None
+
+
+# -- tail-based sampling -----------------------------------------------------
+
+def test_sampling_keeps_errors_and_slow_always():
+    tid = "f" * 32
+    assert not tracing.sample_keep(tid, rate=0.0)
+    assert tracing.sample_keep(tid, rate=0.0, error=True)
+    assert tracing.sample_keep(tid, rate=0.0, slow=True)
+    assert tracing.sample_keep(tid, rate=1.0)
+
+
+def test_sampling_is_deterministic_on_the_trace_id():
+    # the low 8 hex digits decide: ...00000000 hashes to fraction 0
+    # (kept at any rate > 0), ...ffffffff to ~1 (dropped below 1.0)
+    low = "a" * 24 + "0" * 8
+    high = "a" * 24 + "f" * 8
+    assert tracing.sample_keep(low, rate=0.01)
+    assert not tracing.sample_keep(high, rate=0.99)
+    for tid in (low, high, tracing.mint_context().trace_id):
+        first = tracing.sample_keep(tid, rate=0.5)
+        assert all(tracing.sample_keep(tid, rate=0.5) == first
+                   for _ in range(5))
+
+
+def test_finish_request_roots_only_sampled_traces():
+    """The tail decision gates the ROOT span: at trace_sample_rate=0 a
+    healthy trace records nothing (it never surfaces) while an errored
+    one records the root that makes it visible (traces_from_events)."""
+    from ray_tpu.config import Config, set_config
+    from ray_tpu.util.state import summarize_traces, traces_from_events
+    _clean_events()
+    try:
+        set_config(Config.from_env(trace_sample_rate=0.0,
+                                   trace_slow_threshold_s=60.0))
+        t0 = time.time() - 0.01
+        healthy = tracing.mint_context()
+        assert tracing.finish_request(healthy, t0, time.time(),
+                                      status="ok") is False
+        errored = tracing.mint_context()
+        assert tracing.finish_request(errored, t0, time.time(),
+                                      status="error", error=True)
+        deadline = tracing.mint_context()
+        assert tracing.finish_request(deadline, t0, time.time(),
+                                      status="deadline")
+        # slow-over-threshold is kept even when healthy
+        set_config(Config.from_env(trace_sample_rate=0.0,
+                                   trace_slow_threshold_s=0.001))
+        slow = tracing.mint_context()
+        assert tracing.finish_request(slow, time.time() - 1.0,
+                                      time.time(), status="ok")
+    finally:
+        set_config(Config.from_env())
+    rows = traces_from_events(events.dump())
+    ids = {r["trace_id"] for r in rows}
+    assert healthy.trace_id not in ids
+    assert {errored.trace_id, deadline.trace_id, slow.trace_id} <= ids
+    by_id = {r["trace_id"]: r for r in rows}
+    assert by_id[errored.trace_id]["status"] == "error"
+    assert by_id[errored.trace_id]["error"]
+    assert by_id[deadline.trace_id]["status"] == "deadline"
+    assert by_id[slow.trace_id]["keep"] == "slow"
+    s = summarize_traces(rows)
+    assert s["traces"] == len(rows) and s["errors"] >= 2
+    # errors sort before the (slower) healthy-slow trace
+    assert rows[0]["error"]
+
+
+# -- span recording + category budget ----------------------------------------
+
+def test_request_spans_record_and_filter_by_trace():
+    _clean_events()
+    ctx = tracing.mint_context()
+    other = tracing.mint_context()
+    t0 = time.time()
+    sid = tracing.record_request_span("proxy", "handler", ctx,
+                                      ctx.span_id, t0, t0 + 0.01,
+                                      deployment="d")
+    tracing.record_request_span("replica", "handler", ctx, sid,
+                                t0 + 0.002, t0 + 0.008)
+    tracing.record_request_span("proxy", "handler", other,
+                                other.span_id, t0, t0 + 0.5)
+    tracing.record_batch_span("engine", "decode",
+                              [ctx.trace_id], t0, t0 + 0.004, block=8)
+    mine = tracing.filter_trace(events.dump(), ctx.trace_id)
+    comps = {(e.get("component"), e.get("name")) for e in mine}
+    assert ("proxy", "span") in comps
+    assert ("replica", "span") in comps
+    assert ("engine", "batch") in comps            # via links
+    assert not any(e.get("trace") == other.trace_id for e in mine)
+
+
+def test_filter_trace_pulls_step_tagged_collective_rounds():
+    """A train-step trace references its collective rounds through the
+    collective_step tag (TrainContext.collective_step -> ring spans).
+    A step span carrying its ring GROUP id matches only that group's
+    rounds (incl. hierarchical `<group>.n<i>`/`<group>.x` sub-rings) —
+    another job sharing the step index must not cross-wire in."""
+    _clean_events()
+    ctx = tracing.mint_context()
+    t0 = time.time()
+    tracing.record_request_span("train", "train_step", ctx, "",
+                                t0, t0 + 1.0, step=7, group="ga")
+    for grp in ("ga", "ga.n0", "ga.x", "gb"):
+        events.record("collective", "round", kind="allreduce", step=7,
+                      rank=0, size=2, ts=t0 + 0.1, dur=0.05, group=grp)
+    events.record("collective", "round", kind="allreduce", step=8,
+                  rank=0, size=2, ts=t0 + 0.9, dur=0.05, group="ga")
+    mine = tracing.filter_trace(events.dump(), ctx.trace_id)
+    rounds = [(e.get("step"), e.get("group")) for e in mine
+              if e.get("cat") == "collective"]
+    assert sorted(rounds) == [(7, "ga"), (7, "ga.n0"), (7, "ga.x")]
+    # a group-LESS step span falls back to step-only matching
+    _clean_events()
+    ctx2 = tracing.mint_context()
+    tracing.record_request_span("train", "train_step", ctx2, "",
+                                t0, t0 + 1.0, step=7)
+    events.record("collective", "round", kind="allreduce", step=7,
+                  rank=0, size=2, ts=t0 + 0.1, dur=0.05, group="gb")
+    mine = tracing.filter_trace(events.dump(), ctx2.trace_id)
+    assert any(e.get("cat") == "collective" for e in mine)
+
+
+def test_trace_step_roots_once_and_nests_as_child_spans():
+    """Only the OUTERMOST trace_step roots the trace; a nested one (or
+    one opened inside a traced request) records a child span with its
+    own id parented to the outer span — no duplicate roots, no span-id
+    collision."""
+    from ray_tpu.train.api import TrainContext
+    _clean_events()
+    tctx = TrainContext(0, 1, 0, 0, None)
+    tctx.collective_step = 3
+    with tctx.trace_step("step") as outer_tid:
+        with tctx.trace_step("forward") as inner_tid:
+            pass
+    assert inner_tid == outer_tid            # one trace
+    spans = [e for e in events.dump() if e.get("cat") == "request"
+             and e.get("trace") == outer_tid]
+    roots = [e for e in spans if e.get("root")]
+    assert len(spans) == 2 and len(roots) == 1
+    outer = roots[0]
+    inner = next(e for e in spans if not e.get("root"))
+    assert outer["seg"] == "step" and inner["seg"] == "forward"
+    assert inner["span"] != outer["span"]
+    assert inner["parent"] == outer["span"]
+    assert outer["step"] == 3 and inner["step"] == 3
+    _clean_events()
+
+
+def test_request_category_cannot_evict_task_or_collective_spans():
+    """The "request" sub-budget (util/events.py _CATEGORY_CAPS): a
+    high-QPS span flood ages out against itself, never the task exec
+    spans `ray-tpu timeline` is built on (the PR 5 budget pattern)."""
+    _clean_events()
+    tracing.record_exec("aa" * 8, "task", "keep_me", 1.0, 2.0)
+    events.record("collective", "round", kind="allreduce", rank=0,
+                  ts=1.0, dur=0.1)
+    ctx = tracing.mint_context()
+    cap = events._CATEGORY_CAPS["request"]
+    for i in range(cap + 500):
+        tracing.record_request_span("proxy", "handler", ctx,
+                                    ctx.span_id, 1.0, 1.1)
+    evs = events.dump()
+    cats = [e.get("cat") for e in evs]
+    assert cats.count("request") == cap          # aged against itself
+    assert any(e.get("cat") == "trace" and e.get("target") == "keep_me"
+               for e in evs)
+    assert any(e.get("cat") == "collective" for e in evs)
+    # the aggregation-point buffer applies the same sub-budget
+    buf = events.CategoryBuffer(maxlen=events._DEFAULT_CAP)
+    buf.extend(evs)
+    agg = [e.get("cat") for e in buf.dump()]
+    assert agg.count("request") == cap
+    assert agg.count("trace") >= 1
+    _clean_events()
+
+
+# -- chrome rendering --------------------------------------------------------
+
+def _req_ev(node, pid, comp, seg, trace, span, parent, ts, dur, **kw):
+    return {"cat": "request", "name": "span", "node": node, "pid": pid,
+            "component": comp, "seg": seg, "trace": trace, "span": span,
+            "parent": parent, "ts": ts, "dur": dur, **kw}
+
+
+def test_to_chrome_request_lanes_and_forward_flow_edges_under_skew():
+    """Two processes on nodes whose clocks disagree by 80 ms: with the
+    collected offsets applied, request lanes merge onto one corrected
+    axis and every parent->child flow edge points forward in time."""
+    t = 1000.0
+    skew = 0.08
+    ctx = tracing.mint_context()
+    sid_root, sid_h, sid_r = (tracing.new_span_id() for _ in range(3))
+    evs = [
+        _req_ev("aaaa", 1, "proxy", "request", ctx.trace_id, sid_root,
+                "", t, 0.2, root=True, status="ok", keep="sampled"),
+        _req_ev("aaaa", 1, "handle", "submit", ctx.trace_id, sid_h,
+                sid_root, t + 0.002, 0.004),
+        # replica node's clock runs AHEAD by `skew`: raw child ts
+        # precedes the parent's — only the offsets fix the ordering
+        _req_ev("bbbb", 2, "replica", "handler", ctx.trace_id, sid_r,
+                sid_h, t + 0.01 + skew, 0.15),
+    ]
+    recs = tracing.to_chrome(evs, clock_offsets={"aaaa": 0.0,
+                                                 "bbbb": skew})
+    lanes = {(r["pid"], r["tid"]) for r in recs if r["ph"] == "X"}
+    assert ("node:aaaa", "req:proxy") in lanes
+    assert ("node:aaaa", "req:handle") in lanes
+    assert ("node:bbbb", "req:replica") in lanes
+    starts = {r["id"]: r for r in recs if r["ph"] == "s"}
+    finishes = {r["id"]: r for r in recs if r["ph"] == "f"}
+    assert len(starts) == 2 and starts.keys() == finishes.keys()
+    for fid, s in starts.items():
+        assert finishes[fid]["ts"] >= s["ts"], (s, finishes[fid])
+
+
+def test_to_chrome_trace_id_filter_reuses_the_renderer():
+    ctx, other = tracing.mint_context(), tracing.mint_context()
+    evs = [
+        _req_ev("aaaa", 1, "proxy", "request", ctx.trace_id, "a" * 16,
+                "", 1.0, 0.1, root=True),
+        _req_ev("aaaa", 1, "proxy", "request", other.trace_id,
+                "b" * 16, "", 1.0, 0.5, root=True),
+        {"cat": "trace", "name": "exec", "task": "cc" * 8,
+         "kind": "task", "target": "nested", "ts": 1.01, "dur": 0.02,
+         "pid": 3, "trace": ctx.trace_id},
+    ]
+    recs = tracing.to_chrome(evs, trace_id=ctx.trace_id)
+    spans = [r for r in recs if r["ph"] == "X"]
+    assert len(spans) == 2
+    assert {r["args"].get("trace") for r in spans} == {ctx.trace_id}
+    assert any(r["name"] == "nested" for r in spans)
+
+
+# -- exemplars ---------------------------------------------------------------
+
+def test_histogram_exemplar_kept_per_bucket_and_rendered():
+    from ray_tpu.util import metrics as m
+    h = m.Histogram("zz_req_trace_test_s", "t", boundaries=(0.1, 1.0))
+    tid = tracing.mint_context().trace_id
+    h.observe(0.05, exemplar=tid)              # bucket 0 (le 0.1)
+    h.observe(0.5)                             # bucket 1, no exemplar
+    h.observe(5.0, exemplar="ee" * 16)         # +Inf bucket
+    out = h.render()
+    assert f'# {{trace_id="{tid}"}} 0.05' in out
+    assert f'trace_id="{"ee" * 16}"' in out
+    # the last exemplar per bucket wins
+    tid2 = tracing.mint_context().trace_id
+    h.observe(0.06, exemplar=tid2)
+    out = h.render()
+    assert tid2 in out and tid not in out
+    # the push path (render_labeled) carries exemplars to the head:
+    # they ride the sample line, not a stripped comment line
+    labeled = m.render_labeled({"node": "n1"})
+    assert tid2 in labeled
+    # exemplar tails are OpenMetrics-only syntax: the classic text
+    # format strips them (a stock Prometheus scrape would otherwise
+    # reject every sample over the '#') while values/counts survive
+    stripped = m.strip_exemplars(out)
+    assert "trace_id=" not in stripped
+    assert 'zz_req_trace_test_s_bucket{le="0.1"} 2' in stripped
+    assert m.strip_exemplars(labeled).count("trace_id=") == 0
+    with m._LOCK:
+        m._REGISTRY.pop("zz_req_trace_test_s", None)
+
+
+def test_metrics_endpoint_strips_exemplars_unless_opted_in():
+    """The DEFAULT /metrics scrape must stay parseable by a stock
+    Prometheus text-format parser (exemplar tails stripped — even for
+    a scraper advertising OpenMetrics in Accept, which stock
+    Prometheus does by default); ?exemplars=1 is the explicit opt-in
+    that includes the tails."""
+    import urllib.request
+
+    from ray_tpu.util import metrics as m
+    h = m.Histogram("zz_req_trace_srv_s", "t", boundaries=(1.0,))
+    h.observe(0.5, exemplar="ab" * 16)
+
+    async def go():
+        srv = m.MetricsServer()
+        host, port = await srv.start("127.0.0.1", 0)
+
+        def fetch(path="/metrics", accept=None):
+            req = urllib.request.Request(
+                f"http://{host}:{port}{path}",
+                headers={"Accept": accept} if accept else {})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.headers.get("Content-Type"), r.read().decode()
+        loop = asyncio.get_running_loop()
+        classic = await loop.run_in_executor(None, fetch)
+        negotiated = await loop.run_in_executor(
+            None, lambda: fetch(
+                accept="application/openmetrics-text;version=1.0.0"))
+        opted = await loop.run_in_executor(
+            None, lambda: fetch("/metrics?exemplars=1"))
+        await srv.stop()
+        return classic, negotiated, opted
+
+    classic, negotiated, opted = asyncio.run(go())
+    for ct, body in (classic, negotiated):
+        assert ct.startswith("text/plain")
+        assert "zz_req_trace_srv_s_bucket" in body
+        assert "trace_id=" not in body
+    ct, body = opted
+    assert f'trace_id="{"ab" * 16}"' in body
+    with m._LOCK:
+        m._REGISTRY.pop("zz_req_trace_srv_s", None)
+
+
+# -- engine spans + batch links ----------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+
+    from ray_tpu.models import llama
+    cfg = llama.tiny(vocab_size=64, dim=32, n_layers=2, n_heads=2,
+                     n_kv_heads=2, ffn_dim=64, dtype="float32",
+                     logits_dtype="float32", attn_impl="reference")
+    return cfg, llama.init_params(jax.random.PRNGKey(0), cfg)
+
+
+def test_engine_records_queue_prefill_generate_and_linked_batch_spans(
+        tiny_model):
+    from ray_tpu.llm import LLMEngine
+    cfg, params = tiny_model
+    _clean_events()
+    t1 = "aa" * 16
+    t2 = "bb" * 16
+
+    async def go():
+        eng = LLMEngine(cfg, params, max_slots=2, max_len=64,
+                        prefill_buckets=(8,), cache_dtype="float32",
+                        steps_per_sync=4)
+
+        async def one(tid):
+            tok = tracing.set_request_context(
+                tracing.TraceContext(tid, tracing.new_span_id()))
+            try:
+                return await eng.generate([3, 5, 7],
+                                          max_new_tokens=12)
+            finally:
+                tracing.reset_request_context(tok)
+
+        await asyncio.gather(one(t1), one(t2))
+        await eng.stop()
+
+    asyncio.run(go())
+    evs = [e for e in events.dump() if e.get("cat") == "request"]
+    for tid in (t1, t2):
+        segs = {e["seg"] for e in evs if e.get("trace") == tid}
+        assert {"queue", "prefill", "generate"} <= segs, (tid, segs)
+        gen = [e for e in evs if e.get("trace") == tid
+               and e["seg"] == "generate"]
+        assert len(gen) == 1 and gen[0]["tokens"] == 12
+    batches = [e for e in evs if e.get("name") == "batch"]
+    assert batches, "no decode block spans"
+    linked = set()
+    for b in batches:
+        assert b["seg"] == "decode" and b["links"]
+        linked.update(b["links"])
+    assert linked == {t1, t2}
+    # the TTFT histogram carries a trace-id exemplar for its bucket
+    from ray_tpu.util import metrics as m
+    ttft = m._REGISTRY["llm_ttft_device_s"]
+    assert any(x[0] in (t1, t2)
+               for ex in ttft._exemplars.values() for x in ex.values())
+    _clean_events()
+
+
+def test_engine_failed_request_span_is_errored(tiny_model):
+    from ray_tpu.llm import LLMEngine
+    from ray_tpu.serve import fault
+    cfg, params = tiny_model
+    _clean_events()
+    tid = "cd" * 16
+
+    async def go():
+        eng = LLMEngine(cfg, params, max_slots=1, max_len=64,
+                        prefill_buckets=(8,), cache_dtype="float32")
+        tok = tracing.set_request_context(
+            tracing.TraceContext(tid, tracing.new_span_id()))
+        try:
+            with pytest.raises(fault.DeadlineExceeded):
+                await eng.generate([1, 2], max_new_tokens=4,
+                                   deadline_ts=time.time() + 0.05)
+        finally:
+            tracing.reset_request_context(tok)
+        await eng.stop()
+
+    asyncio.run(go())
+    gen = [e for e in events.dump() if e.get("cat") == "request"
+           and e.get("trace") == tid and e.get("seg") == "generate"]
+    assert len(gen) == 1 and gen[0]["error"]
+    _clean_events()
+
+
+# -- knob lint ---------------------------------------------------------------
+
+def test_trace_knobs_enumerated_and_exercised():
+    """The folded knob lint (check_metrics_lint.lint_knob_tests) scans
+    every registered family — chaos, tuner, AND the new trace knobs —
+    with one shared helper; expected names are assembled at runtime so
+    this file's own text can't satisfy the grep."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "scripts", "check_metrics_lint.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_lint", os.path.abspath(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert set(mod.KNOB_FAMILIES) >= {"chaos", "tuner", "trace"}
+    expect = {"_".join(["trace", "sample", "rate"]),
+              "_".join(["trace", "slow", "threshold", "s"])}
+    assert expect <= set(mod.trace_knobs()), mod.trace_knobs()
+    assert mod.lint_knob_tests() == []
+    assert mod.lint_knob_tests(families=["trace"]) == []
+    bogus = "_".join(["trace", "no", "such", "knob"])
+    errs = mod._lint_knob_tests("trace", [bogus])
+    assert len(errs) == 1 and bogus in errs[0]
+
+
+# -- live-cluster e2e --------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    # trace_sample_rate=0: ONLY error/deadline/slow traces surface in
+    # the sampled list — the e2e asserts both sides of the tail
+    # decision (healthy waterfalls still render; they just don't list)
+    env = {"RAY_TPU_TRACE_SAMPLE_RATE": "0.0",
+           "RAY_TPU_TRACE_SLOW_THRESHOLD_S": "30.0",
+           "RAY_TPU_SERVE_DEFAULT_DEADLINE_S": "60"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    import ray_tpu
+    ray_tpu.init(num_cpus=8)
+    yield
+    from ray_tpu import serve
+    serve.shutdown()
+    ray_tpu.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _post(addr, path, payload, deadline_s=None, traceparent=None):
+    conn = http.client.HTTPConnection(addr["host"], addr["port"],
+                                      timeout=60)
+    headers = {"Content-Type": "application/json"}
+    if deadline_s is not None:
+        headers["X-Request-Deadline"] = str(deadline_s)
+    if traceparent:
+        headers["traceparent"] = traceparent
+    conn.request("POST", path, body=json.dumps(payload),
+                 headers=headers)
+    r = conn.getresponse()
+    out = {"status": r.status, "body": r.read(),
+           "trace_id": r.getheader("X-Trace-Id")}
+    conn.close()
+    return out
+
+
+def _collect_trace(tid, want, timeout_s=30.0):
+    """Poll the cluster timeline until the trace's request spans cover
+    ``want`` components (worker event buffers flush every ~1 s)."""
+    import ray_tpu
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        evs = ray_tpu.timeline(all_nodes=True, trace_id=tid)
+        comps = {e.get("component") for e in evs
+                 if e.get("cat") == "request"}
+        if want <= comps:
+            return evs
+        time.sleep(0.5)
+    raise AssertionError(
+        f"trace {tid}: components {comps} never covered {want}")
+
+
+@pytest.mark.slow
+def test_one_http_request_yields_a_cross_process_waterfall_e2e(
+        cluster, tmp_path):
+    """The acceptance drive: one HTTP request proxy -> handle ->
+    replica -> engine on a live cluster yields ONE trace id whose
+    waterfall has spans from >= 4 components across >= 2 processes
+    with clock-corrected flow edges that never run backwards."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(max_ongoing_requests=4)
+    class Gen:
+        def __init__(self):
+            import jax
+
+            from ray_tpu.llm import LLMEngine
+            from ray_tpu.models import llama
+            cfg = llama.tiny(vocab_size=64, dim=32, n_layers=2,
+                             n_heads=2, n_kv_heads=2, ffn_dim=64,
+                             dtype="float32", logits_dtype="float32",
+                             attn_impl="reference")
+            params = llama.init_params(jax.random.PRNGKey(0), cfg)
+            self.eng = LLMEngine(cfg, params, max_slots=2, max_len=64,
+                                 prefill_buckets=(8,),
+                                 cache_dtype="float32")
+
+        async def __call__(self, v=None):
+            out = await self.eng.generate((v or {}).get("tokens",
+                                                        [3, 5, 7]),
+                                          max_new_tokens=6)
+            return {"n": len(out["tokens"])}
+
+    serve.run(Gen.bind(), name="app_trace", route_prefix="/gen")
+    addr = serve.proxy_address()
+    r = _post(addr, "/gen", {"tokens": [3, 5, 7]}, deadline_s=30)
+    assert r["status"] == 200, r
+    tid = r["trace_id"]
+    assert tid and len(tid) == 32
+    evs = _collect_trace(
+        tid, {"proxy", "handle", "replica", "engine"})
+    req = [e for e in evs if e.get("cat") == "request"]
+    comps = {e["component"] for e in req}
+    assert {"proxy", "handle", "replica", "engine"} <= comps
+    procs = {(str(e.get("node", ""))[:8], e.get("pid")) for e in req}
+    assert len(procs) >= 2, procs
+    # clock-corrected chrome waterfall: request lanes + forward flows
+    out = str(tmp_path / "trace.json")
+    recs = ray_tpu.timeline(all_nodes=True, chrome_path=out,
+                            trace_id=tid)
+    lanes = {x["tid"] for x in recs if x.get("ph") == "X"}
+    assert {"req:proxy", "req:handle", "req:replica",
+            "req:engine"} <= lanes, lanes
+    starts = {x["id"]: x for x in recs if x.get("ph") == "s"}
+    finishes = {x["id"]: x for x in recs if x.get("ph") == "f"}
+    assert starts, "no flow edges"
+    for fid, s in starts.items():
+        assert finishes[fid]["ts"] >= s["ts"]
+    with open(out) as f:
+        assert json.load(f)["traceEvents"]
+    # sampled OUT at rate 0: the healthy trace renders but isn't listed
+    from ray_tpu.util.state import traces_from_events
+    assert tid not in {t["trace_id"] for t in traces_from_events(
+        ray_tpu.timeline(all_nodes=True))}
+    # a client traceparent is JOINED, not replaced
+    sent = tracing.mint_context()
+    r2 = _post(addr, "/gen", {"tokens": [2, 4]}, deadline_s=30,
+               traceparent=tracing.format_traceparent(sent))
+    assert r2["status"] == 200 and r2["trace_id"] == sent.trace_id
+    serve.delete("app_trace")
+
+
+@pytest.mark.slow
+def test_error_and_deadline_traces_survive_rate_zero_sampling_e2e(
+        cluster):
+    """An injected replica error and an expired deadline each produce
+    a trace that survives tail sampling at trace_sample_rate=0."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(max_ongoing_requests=4)
+    class Flaky:
+        async def __call__(self, v=None):
+            v = v or {}
+            if v.get("boom"):
+                raise ValueError("injected replica failure")
+            await asyncio.sleep(float(v.get("sleep", 0)))
+            return "ok"
+
+    serve.run(Flaky.bind(), name="app_err", route_prefix="/err")
+    addr = serve.proxy_address()
+    r_err = _post(addr, "/err", {"boom": True}, deadline_s=30)
+    assert r_err["status"] == 500 and r_err["trace_id"]
+    r_dl = _post(addr, "/err", {"sleep": 10}, deadline_s=0.5)
+    assert r_dl["status"] == 504 and r_dl["trace_id"]
+    from ray_tpu.util.state import traces_from_events
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        rows = {t["trace_id"]: t for t in traces_from_events(
+            ray_tpu.timeline(all_nodes=True))}
+        if r_err["trace_id"] in rows and r_dl["trace_id"] in rows:
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError(f"error/deadline traces never listed: "
+                             f"{list(rows)[:5]}")
+    assert rows[r_err["trace_id"]]["error"]
+    assert rows[r_err["trace_id"]]["status"] == "error"
+    assert rows[r_dl["trace_id"]]["status"] == "deadline"
+    serve.delete("app_err")
