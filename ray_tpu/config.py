@@ -193,6 +193,26 @@ class Config:
     # SURFACE (root span recorded), not which record.
     trace_sample_rate: float = 1.0
     trace_slow_threshold_s: float = 1.0
+    # Device-plane observability (util/devmon.py; master switch is the
+    # RAY_TPU_DEVMON env var, read at process start like the tracing
+    # flags). A function compiled >= devmon_recompile_threshold times
+    # within devmon_recompile_window_s seconds flags a recompile STORM
+    # (xla_recompile_storms_total counter + a log naming the function)
+    # — the silent mid-serving recompile loop no host profiler can
+    # see. 0 disables the gate.
+    # The default sits above the engine's LEGITIMATE warmup variants
+    # (one compile per prefill bucket; log2(steps_per_sync)+1 decode
+    # block variants x2 filter modes) so healthy cold starts don't
+    # flag; a real storm — an unbucketed shape reaching a jit boundary
+    # on the request path — blows past it within a few requests.
+    devmon_recompile_threshold: int = 10
+    devmon_recompile_window_s: float = 60.0
+    # HBM snapshot cadence (per-device used/limit/peak gauges + the
+    # "device" events behind `/devices` and `ray-tpu devices`), and
+    # the trailing horizon the device_duty_cycle gauge integrates
+    # device-compute windows over.
+    devmon_hbm_interval_s: float = 5.0
+    devmon_duty_horizon_s: float = 30.0
 
     # --- control-plane fault tolerance ---
     # Directory for durable control tables (GCS-persistence analog,

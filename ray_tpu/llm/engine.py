@@ -28,7 +28,18 @@ import numpy as np
 
 from ray_tpu.llm import model as lm
 from ray_tpu.models.llama import LlamaConfig
-from ray_tpu.util import tracing
+from ray_tpu.util import devmon, tracing
+
+
+def _jx():
+    """Lazy ``(jax, jax.numpy)`` accessor. jax must not be imported at
+    module import time (worker processes import ray_tpu.llm without
+    ever touching a backend), and every device-path method used to
+    re-import it function-locally — this is the ONE copy of that
+    idiom; device methods open with ``jax, jnp = _jx()``."""
+    import jax
+    import jax.numpy as jnp
+    return jax, jnp
 
 
 class KVHandoffError(RuntimeError):
@@ -48,6 +59,11 @@ def engine_metrics() -> dict:
       llm_ttft_wall_s    submit -> first token, wall clock
       llm_tpot_s         decode wall time per output token
       llm_batch_size     active decode slots per step block
+
+    HBM attribution (the engine half of util/devmon.py's device plane):
+
+      llm_kv_cache_bytes           live KV cache bytes on device
+      llm_kv_cache_headroom_bytes  growth left before max_len capacity
     """
     from ray_tpu.util import metrics as m
     return {
@@ -68,6 +84,14 @@ def engine_metrics() -> dict:
         "batch": m.Histogram(
             "llm_batch_size", "Active decode slots per step block",
             boundaries=(1, 2, 4, 8, 16, 32, 64, 128, 256)),
+        "kv_bytes": m.Gauge(
+            "llm_kv_cache_bytes",
+            "Bytes of the engine's static KV cache currently on device"),
+        "kv_headroom": m.Gauge(
+            "llm_kv_cache_headroom_bytes",
+            "Bytes of bucketed KV growth left before the cache reaches "
+            "its max_len capacity (0 = fully grown; watch next to "
+            "device_hbm_used_bytes for OOM creep)"),
     }
 
 
@@ -119,7 +143,11 @@ class LLMEngine:
         model larger than one chip's HBM serves (reference:
         llm/_internal/serve/configs/llm_config.py:181-186
         tensor_parallel_size + placement bundles per replica)."""
-        import jax.numpy as jnp
+        jax, jnp = _jx()
+        # jax is live in this process from here on: hook the compile
+        # listeners now so even the cache-init compiles are spanned
+        # (idempotent; no-op under RAY_TPU_DEVMON=0)
+        devmon.install()
         if mesh is not None and getattr(cfg, "attn_impl", "auto") in (
                 "auto", "flash", "flash_interpret", "ring"):
             # Tensor-parallel serving shards the head dim via GSPMD,
@@ -141,7 +169,6 @@ class LLMEngine:
         self.buckets = tuple(sorted(b for b in prefill_buckets
                                     if b <= max_len)) or (max_len,)
         self.detokenize = detokenize
-        import jax
         # Bucketed KV growth (the dense-cache answer to paged KV —
         # reference capability: vLLM's paged cache bounds HBM by live
         # tokens): the cache starts at a small length and DOUBLES, up
@@ -167,6 +194,7 @@ class LLMEngine:
         # histograms, pushed to the head from worker processes); the
         # scalar counters below feed the legacy `stats` surface.
         self._m = engine_metrics()
+        self._kv_account()
         self._requests = 0
         self._tokens_generated = 0
         self._ttft_sum = 0.0
@@ -182,6 +210,26 @@ class LLMEngine:
                 "ttft_count": self._ttft_count,
                 "cache_len": self._cache_len}
 
+    def _kv_per_token_bytes(self) -> float:
+        """Device bytes one KV position of one slot costs (both k and
+        v, all layers) — the unit request-level HBM attribution is
+        priced in."""
+        n = self._cache["k"].nbytes + self._cache["v"].nbytes
+        return n / float(self.max_slots * self._cache_len)
+
+    def _kv_account(self) -> None:
+        """Publish the engine's explicit KV HBM attribution: live cache
+        bytes + the growth headroom still unspent before max_len
+        capacity. Called at init and after every bucketed growth; the
+        gauges ride the worker's metrics push to the head next to
+        util/devmon.py's device_hbm_* series."""
+        cur = self._cache["k"].nbytes + self._cache["v"].nbytes
+        per_tok = self._kv_per_token_bytes()
+        headroom = per_tok * self.max_slots \
+            * (self.max_len - self._cache_len)
+        self._m["kv_bytes"].set(cur)
+        self._m["kv_headroom"].set(headroom)
+
     def _grow_cache(self, need: int) -> None:
         """Double the per-slot KV length (bucketed) until >= need,
         capped at max_len; active slots' KV is preserved (zero-pad on
@@ -193,8 +241,7 @@ class LLMEngine:
         pad = new_len - self._cache_len
         if pad <= 0:
             return
-        import jax
-        import jax.numpy as jnp
+        jax, jnp = _jx()
         c = self._cache
         widths = ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
         k, v = jnp.pad(c["k"], widths), jnp.pad(c["v"], widths)
@@ -205,6 +252,7 @@ class LLMEngine:
             k, v = jax.device_put(k, s), jax.device_put(v, s)
         self._cache = {"k": k, "v": v, "length": c["length"]}
         self._cache_len = new_len
+        self._kv_account()
 
     # --- public API -----------------------------------------------------
 
@@ -421,26 +469,45 @@ class LLMEngine:
                     temps[i] = self._slots[i].temperature
                     top_ps[i] = self._slots[i].top_p
                     top_ks[i] = self._slots[i].top_k
+                member_traces = sorted(
+                    {self._slots[i].trace.trace_id
+                     for i in active
+                     if self._slots[i] is not None
+                     and self._slots[i].trace is not None})
+                first_ctx = next(
+                    (self._slots[i].trace for i in active
+                     if self._slots[i] is not None
+                     and self._slots[i].trace is not None), None)
                 t_dec = time.monotonic()
                 t_dec_wall = time.time()
                 out = await loop.run_in_executor(
                     None, self._decode_sync, tokens, temps, top_ps,
-                    top_ks, block)
-                self._m["batch"].observe(len(active))
+                    top_ks, block, first_ctx)
+                # the block belongs to every member trace; the
+                # EXEMPLAR can only name one — use the SAME member
+                # whose context was bound inside _decode_sync, so
+                # following the exemplar (`ray-tpu trace <id>`) shows
+                # any decode-path compile span stamped during this
+                # block, not a sibling's waterfall
+                ex = first_ctx.trace_id if first_ctx is not None \
+                    else None
+                self._m["batch"].observe(len(active), exemplar=ex)
                 self._m["tpot"].observe(
-                    (time.monotonic() - t_dec) / block)
+                    (time.monotonic() - t_dec) / block, exemplar=ex)
                 # one span per decode BLOCK, linked to every member
                 # trace: the block is shared compute, so it belongs to
                 # all of them rather than to one (each member's
                 # waterfall pulls it in via the links)
                 tracing.record_batch_span(
-                    "engine", "decode",
-                    sorted({self._slots[i].trace.trace_id
-                            for i in active
-                            if self._slots[i] is not None
-                            and self._slots[i].trace is not None}),
+                    "engine", "decode", member_traces,
                     t_dec_wall, time.time(), block=block,
                     slots=len(active))
+                # the same interval is a device-compute window (the
+                # decode block is block_until_ready-bounded by the
+                # host transfer of its sampled tokens)
+                devmon.record_device_window(
+                    "decode", t_dec_wall, time.time(),
+                    trace=ex or "")
                 for step in range(block):
                     for i in active:
                         r = self._slots[i]
@@ -461,12 +528,26 @@ class LLMEngine:
                     self._finish(r, i)
 
     def _admit_sync(self, slot: int, r: _Request) -> int:
+        """Prefill entry (executor thread): binds the request's trace
+        context for the duration of the admit so any XLA compile it
+        triggers (a cold shape bucket, a cache growth) is stamped with
+        the request's trace id — util/devmon.py's compile listener
+        reads the ambient context, and the span then rides this
+        request's `ray-tpu trace` waterfall as a dev:compile lane."""
+        if r.trace is None:
+            return self._admit_impl(slot, r)
+        tok = tracing.set_request_context(r.trace)
+        try:
+            return self._admit_impl(slot, r)
+        finally:
+            tracing.reset_request_context(tok)
+
+    def _admit_impl(self, slot: int, r: _Request) -> int:
         """Prefill (executor thread): pad to bucket, fill cache slot.
         Returns the first sampled token. Remotely-prefilled requests
         skip the forward pass: their shipped KV is written straight
         into the slot."""
-        import jax
-        import jax.numpy as jnp
+        jax, jnp = _jx()
         n = len(r.tokens)
         r.admitted_at = time.monotonic()
         self._m["queue"].observe(r.admitted_at - r.submitted)
@@ -546,10 +627,14 @@ class LLMEngine:
     def _record_prefill_span(r: _Request) -> None:
         """Engine hop, segment 2: the prefill device compute that
         produced the first token (block_until_ready-bounded, so the
-        span is the DEVICE portion of TTFT, ending now)."""
+        span is the DEVICE portion of TTFT, ending now). The same
+        interval feeds the duty-cycle estimator as a device window."""
+        now = time.time()
+        devmon.record_device_window(
+            "prefill", now - r.prefill_device_s, now,
+            trace=r.trace.trace_id if r.trace is not None else "")
         if r.trace is None:
             return
-        now = time.time()
         tracing.record_request_span(
             "engine", "prefill", r.trace, r.trace.span_id,
             now - r.prefill_device_s, now, tokens=len(r.tokens))
@@ -560,8 +645,7 @@ class LLMEngine:
         accumulated KV of the pieces before it. Returns (last-token
         logits, {"k","v"} (layers, max_len, kvh, hd)) — the same shape
         contract as lm.prefill, so the cache write is identical."""
-        import jax
-        import jax.numpy as jnp
+        jax, jnp = _jx()
         cdt = self._cache["k"].dtype
         chunk = self.buckets[-1]
         # accumulator length is a BUCKET MULTIPLE >= the current cache
@@ -595,10 +679,28 @@ class LLMEngine:
 
     def _decode_sync(self, tokens: np.ndarray, temps: np.ndarray,
                      top_ps: np.ndarray, top_ks: np.ndarray,
+                     block: int,
+                     trace_ctx: Optional[tracing.TraceContext] = None
+                     ) -> np.ndarray:
+        """Returns (block, slots) int32 sampled tokens. ``trace_ctx``
+        (the first member trace of the batch) is bound while the block
+        runs so a decode-path XLA compile — a new block-size variant,
+        a filter toggle — stamps a member's trace id onto its
+        dev:compile span instead of vanishing into unattributed time."""
+        if trace_ctx is None:
+            return self._decode_impl(tokens, temps, top_ps, top_ks,
+                                     block)
+        tok = tracing.set_request_context(trace_ctx)
+        try:
+            return self._decode_impl(tokens, temps, top_ps, top_ks,
+                                     block)
+        finally:
+            tracing.reset_request_context(tok)
+
+    def _decode_impl(self, tokens: np.ndarray, temps: np.ndarray,
+                     top_ps: np.ndarray, top_ks: np.ndarray,
                      block: int) -> np.ndarray:
-        """Returns (block, slots) int32 sampled tokens."""
-        import jax
-        import jax.numpy as jnp
+        jax, jnp = _jx()
         self._step += block
         key = jax.random.fold_in(self._key, self._step)
         # The top-p/top-k filters cost two O(V log V) vocab sorts per
@@ -668,14 +770,19 @@ class LLMEngine:
 
     def _record_done(self, r: _Request, error: bool) -> None:
         """Terminal engine span for one request: submit -> done, with
-        the produced token count. Recorded at most once (finish, fail,
-        and the loop's shutdown sweep can all reach a request)."""
+        the produced token count and the request's KV high-watermark
+        (prompt + generated positions priced at the cache's per-token
+        bytes) — the trace drill-down shows what the request cost in
+        HBM, not just time. Recorded at most once (finish, fail, and
+        the loop's shutdown sweep can all reach a request)."""
         if r.trace is None:
             return
         tracing.record_request_span(
             "engine", "generate", r.trace, r.trace.span_id,
             r.t_submit_wall, time.time(), error=error,
-            tokens=len(r.out))
+            tokens=len(r.out),
+            kv_bytes=int(self._kv_per_token_bytes()
+                         * (len(r.tokens) + len(r.out))))
         r.trace = None
 
     def _finish(self, r: _Request, slot: Optional[int]):

@@ -749,6 +749,17 @@ async def _amain():
         labels={"node": node_id.hex()[:12], "worker": wid.hex()[:12]},
         interval_s=ctx.config.metrics_export_interval_s))
 
+    # Device-plane observability (util/devmon.py): the monitor loop
+    # hooks the XLA compile listeners the tick after jax first appears
+    # in this process (it never imports jax itself — non-jax workers
+    # pay nothing) and snapshots per-device HBM + duty cycle; the
+    # gauges ride the metrics push above, the "device" events ride the
+    # event flush to the agent. RAY_TPU_DEVMON=0 disables it all.
+    from ray_tpu.util import devmon as _devmon
+    if _devmon.enabled():
+        asyncio.ensure_future(_devmon.monitor_loop(
+            ctx.config.devmon_hbm_interval_s))
+
     await ctx.pool.call(agent, "worker_ready", worker_id=wid, addr=ctx.addr)
     await asyncio.Event().wait()  # serve forever; agent kills us
 

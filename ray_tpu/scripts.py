@@ -522,6 +522,58 @@ def cmd_collectives(args) -> int:
     return 0
 
 
+def cmd_devices(args) -> int:
+    """Device-plane summary off the cluster timeline (util/devmon.py
+    events): per-device HBM occupancy + duty cycle, XLA compile
+    aggregates per function, and recompile-storm flags — the
+    accelerator companion to `ray-tpu collectives` / `ray-tpu trace`
+    (same rows the dashboard /devices page renders)."""
+    import time as _time
+
+    from ray_tpu.util.state import devices_from_events, summarize_devices
+    addr = _resolve_address(args)
+    r = _call_head(addr, "collect_timeline")
+    rows = devices_from_events(r.get("events", []), limit=args.limit)
+    s = summarize_devices(rows)
+    if args.json:
+        print(json.dumps({"rows": rows, "summary": s},
+                         default=str, indent=2))
+        return 0
+    if not rows:
+        print("no device events in the timeline (is RAY_TPU_DEVMON=0, "
+              "or has no jax-using worker run yet?)")
+        return 0
+    for d in s["devices"]:
+        seen = _time.strftime("%H:%M:%S",
+                              _time.localtime(d["start_time"] or 0))
+        lim = (f"{(d['limit'] or 0) / 1e9:8.2f} GB"
+               if d["limit"] else "       ? GB")
+        print(f"{seen}  {str(d['device']):10s} "
+              f"node={str(d['node_id'] or '')[:8]:8s} "
+              f"pid={d['pid'] or '?':<7} "
+              f"used {(d['used'] or 0) / 1e6:10.2f} MB / {lim}  "
+              f"peak {(d['peak'] or 0) / 1e6:10.2f} MB  "
+              f"duty {(d['duty'] or 0.0) * 100:5.1f}%  "
+              f"[{d['source']}]")
+    if s["compiles"]:
+        print()
+        for c in s["compiles"]:
+            print(f"compile  {c['fn'][:40]:40s} x{c['compiles']:<4d} "
+                  f"(+{c['cache_hits']} cache hits)  "
+                  f"mean {c['mean_s'] * 1e3:9.2f} ms  "
+                  f"max {c['max_s'] * 1e3:9.2f} ms")
+    for st in s["storms"]:
+        print(f"RECOMPILE STORM  {st['fn']!r}: {st['count']} compiles "
+              f"in {st['window_s']:g}s window "
+              f"(node={str(st['node_id'] or '')[:8]})")
+    print(f"\n{len(s['devices'])} device(s), "
+          f"{s['hbm_used_bytes'] / 1e6:.2f} MB HBM in use, "
+          f"{s['compile_total_s']:.2f} s total compile time, "
+          f"{len(s['storms'])} storm flag(s). Waterfall with compile "
+          f"lanes: ray-tpu trace <id>")
+    return 0
+
+
 def cmd_job(args) -> int:
     from ray_tpu.job_submission import JobSubmissionClient
     addr = _resolve_address(args)
@@ -659,6 +711,15 @@ def main(argv=None) -> int:
     ptr.add_argument("--limit", type=int, default=50)
     ptr.add_argument("-o", "--output", default="trace.json")
     ptr.set_defaults(fn=cmd_trace)
+
+    pdv = sub.add_parser(
+        "devices",
+        help="per-device HBM / duty cycle / XLA compile summary "
+             "(recompile storms flagged)")
+    pdv.add_argument("--address")
+    pdv.add_argument("--json", action="store_true")
+    pdv.add_argument("--limit", type=int, default=500)
+    pdv.set_defaults(fn=cmd_devices)
 
     pc = sub.add_parser("collectives",
                         help="summarize recent ring collective rounds "

@@ -58,6 +58,13 @@ class Replica:
         init_args = tuple(resolve(a) for a in init_args)
         init_kwargs = {k: resolve(v) for k, v in init_kwargs.items()}
         self.instance = cls(*init_args, **init_kwargs)
+        # user __init__ above typically binds the model (first jax
+        # import in this process): hook the devmon compile listeners
+        # now so serving-path recompiles are spanned even when the
+        # replica runs somewhere without the worker monitor loop
+        # (in-process test clusters). Idempotent; no-op without jax.
+        from ray_tpu.util import devmon
+        devmon.install()
         self._ongoing = 0
         self._processed = 0
         self._errors = 0

@@ -427,7 +427,7 @@ class TrainContext:
         """
         import contextlib
 
-        from ray_tpu.util import tracing
+        from ray_tpu.util import devmon, tracing
 
         @contextlib.contextmanager
         def _span():
@@ -442,8 +442,15 @@ class TrainContext:
             else:
                 tctx = tracing.mint_context()
                 parent, root = "", True
-            if tctx is None:            # request tracing disabled
-                yield None
+            if tctx is None:            # request tracing disabled —
+                # the duty-cycle window still records (devmon has its
+                # own RAY_TPU_DEVMON switch; tracing off must not
+                # silently zero the train plane's duty signal)
+                t0 = time.time()
+                try:
+                    yield None
+                finally:
+                    devmon.record_device_window(name, t0, time.time())
                 return
             tok = tracing.set_request_context(tctx)
             step = self.collective_step
@@ -457,6 +464,14 @@ class TrainContext:
                 ok = True
             finally:
                 tracing.reset_request_context(tok)
+                # the step interval doubles as a duty window for
+                # util/devmon.py. NOTE: unlike engine prefill/decode
+                # windows (block_until_ready-bounded), a step window
+                # includes the step's HOST work — it is an UPPER bound
+                # on device time; a duty of ~1.0 here means "steps
+                # back-to-back", not necessarily "MXU busy".
+                devmon.record_device_window(name, t0, time.time(),
+                                            trace=tctx.trace_id)
                 extra = {"group": group} if group else {}
                 if root:
                     # the outermost step span IS the trace's root —

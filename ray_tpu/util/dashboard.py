@@ -7,7 +7,9 @@ collapsed TPU-first: the cluster state already lives in the control
 service's tables, so the dashboard is a handful of HTML renderers over
 the same RPCs the state API uses — no build step, no JS framework, one
 process. Pages: / (overview), /nodes, /actors, /jobs, /pgs, /serve,
-/tasks (recent spans off the tracing archive).
+/tasks (recent spans off the tracing archive), /traces (sampled
+request traces), /devices (per-device HBM / duty cycle / XLA compile
+aggregates off util/devmon.py's device events).
 
 Served by util.metrics.MetricsServer on every node's metrics port; the
 node agent registers a `fetch` callable that proxies to the head.
@@ -38,6 +40,7 @@ _NAV = ("<nav><a href='/'>overview</a><a href='/nodes'>nodes</a>"
         "<a href='/actors'>actors</a><a href='/jobs'>jobs</a>"
         "<a href='/pgs'>placement groups</a><a href='/serve'>serve</a>"
         "<a href='/tasks'>tasks</a><a href='/traces'>traces</a>"
+        "<a href='/devices'>devices</a>"
         "<a href='/history'>history</a>"
         "<a href='/profile'>profile</a>"
         "<a href='/metrics'>metrics</a></nav>")
@@ -338,6 +341,64 @@ async def _traces(fetch: Fetch, query: str = "") -> bytes:
     return _page("traces", body)
 
 
+async def _devices(fetch: Fetch, query: str = "") -> bytes:
+    """Device-plane view (util/devmon.py events off the cluster
+    timeline): per-device HBM occupancy + duty cycle, XLA compile
+    aggregates per function, and recompile-storm flags — the
+    accelerator lane the host profiler and request traces can't see."""
+    from ray_tpu.util.state import devices_from_events, summarize_devices
+    r = await fetch("collect_timeline")
+    s = summarize_devices(devices_from_events(r.get("events", [])))
+    body = ""
+    if s["storms"]:
+        flags = "; ".join(
+            f"{_esc(st['fn'])}: {st['count']} compiles in "
+            f"{st['window_s']:g}s" for st in s["storms"][:5])
+        body += (f"<p class=bad>recompile storm(s) flagged &mdash; "
+                 f"{flags}</p>")
+    drows = []
+    for d in s["devices"]:
+        lim = f"{(d['limit'] or 0) / 1e9:.2f}" if d["limit"] else "?"
+        drows.append((
+            _esc(d["device"]),
+            _esc(f"{str(d['node_id'] or '')[:8]}/pid "
+                 f"{d['pid'] or '?'}"),
+            f"{(d['used'] or 0) / 1e6:.2f}",
+            lim,
+            f"{(d['peak'] or 0) / 1e6:.2f}",
+            f"{(d['duty'] or 0.0) * 100:.1f}%",
+            _esc(d["source"] or "-"),
+            _esc(time.strftime("%H:%M:%S",
+                               time.localtime(d["start_time"] or 0))),
+        ))
+    body += ("<h2>devices</h2>"
+             "<p class=dim>latest per-device snapshot; CLI: "
+             "<code>ray-tpu devices</code></p>"
+             + _table(("device", "where", "HBM used (MB)",
+                       "limit (GB)", "peak (MB)", "duty cycle",
+                       "source", "sampled"), drows))
+    crows = []
+    for c in s["compiles"]:
+        crows.append((
+            _esc(c["fn"])[:60],
+            str(c["compiles"]),
+            str(c["recompiles"]),
+            str(c["cache_hits"]),
+            f"{c['mean_s'] * 1e3:.2f}",
+            f"{c['max_s'] * 1e3:.2f}",
+            _esc(time.strftime("%H:%M:%S",
+                               time.localtime(c["last_time"] or 0))),
+        ))
+    body += ("<h2>XLA compiles</h2>"
+             "<p class=dim>per jitted function; a traced request's "
+             "compile shows as a <code>dev:compile</code> lane in "
+             "<code>ray-tpu trace &lt;id&gt;</code></p>"
+             + _table(("function", "compiles", "recompiles",
+                       "cache hits", "mean (ms)", "max (ms)", "last"),
+                      crows))
+    return _page("devices", body)
+
+
 # --- time-series history ----------------------------------------------
 # The reference provisions Prometheus + Grafana for dashboard history
 # (dashboard/modules/metrics/); here a bounded in-process ring sampled
@@ -523,6 +584,7 @@ async def _profile(fetch: Fetch, query: str = "") -> bytes:
 _PAGES = {"/": _overview, "/overview": _overview, "/nodes": _nodes,
           "/actors": _actors, "/jobs": _jobs, "/pgs": _pgs,
           "/serve": _serve, "/tasks": _tasks, "/traces": _traces,
+          "/devices": _devices,
           "/history": _history, "/profile": _profile}
 
 

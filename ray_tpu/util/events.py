@@ -36,8 +36,16 @@ from typing import Deque, Dict, List
 #   request     per-request trace spans: proxy/handle/replica/engine
 #               segments + engine batch spans (util/tracing.py request
 #               layer, serve/*, llm/engine.py)
+#   device      accelerator-plane spans: XLA compile spans, HBM
+#               snapshots, recompile-storm flags (util/devmon.py) —
+#               rare, minutes-relevant events
+#   device_window  per-block device-compute duty windows
+#               (util/devmon.py record_device_window) — HIGH RATE
+#               (one per engine decode block), so they get their own
+#               bucket: a steady serving load must not age the rare
+#               compile/storm/hbm events out of "device"
 CATEGORIES = ("trace", "collective", "train", "worker", "cgroup",
-              "memory", "request")
+              "memory", "request", "device", "device_window")
 
 _DEFAULT_CAP = 65536
 # Dedicated sub-budgets: the key also names the bucket. Everything
@@ -47,9 +55,15 @@ _DEFAULT_CAP = 65536
 # task exec spans the timeline is built on. "request" likewise: a
 # high-QPS serve path emits ~6 spans per request — a traffic burst
 # must age out against its own bucket, never the task exec or
-# collective spans.
+# collective spans. "device"/"device_window" (util/devmon.py) are
+# capped for the same reason — a recompile storm is by definition a
+# flood — and capped SEPARATELY from each other: duty windows arrive
+# per decode block (~continuous under load) while compile spans and
+# storm flags are rare and must stay visible for minutes, so windows
+# get their own bucket to drain.
 _CATEGORY_CAPS: Dict[str, int] = {"collective": 16384, "train": 4096,
-                                  "request": 8192}
+                                  "request": 8192, "device": 4096,
+                                  "device_window": 4096}
 
 _BUFS: Dict[str, Deque[dict]] = {}
 _LOCK = threading.Lock()

@@ -182,6 +182,107 @@ def list_traces(limit: int = 100) -> List[dict]:
     return traces_from_events(r.get("events", []), limit)
 
 
+def devices_from_events(events, limit: int = 500) -> List[dict]:
+    """Timeline "device" events (util/devmon.py) -> rows, newest
+    first. The ONE place the device-event shape is interpreted —
+    `ray-tpu devices` and the dashboard /devices page both render
+    these rows. Three row kinds share the list, discriminated by
+    ``kind``: "hbm" (per-device memory snapshot + duty cycle),
+    "compile" (one XLA compile span), "storm" (a recompile-storm
+    flag). Duty windows are a chrome-trace concern and are skipped.
+    ``limit`` applies PER KIND: steady hbm snapshots (one per device
+    per devmon_hbm_interval_s) must not age the rare compile/storm
+    rows out of the summary while those still sit in the buffer."""
+    rows = []
+    for e in events:
+        if e.get("cat") != "device":
+            continue
+        name = e.get("name")
+        base = {"node_id": str(e.get("node", ""))[:16] or None,
+                "pid": e.get("pid"), "start_time": e.get("ts")}
+        if name == "hbm":
+            rows.append({"kind": "hbm", "device": e.get("device"),
+                         "used": e.get("used", 0),
+                         "limit": e.get("limit", 0),
+                         "peak": e.get("peak", 0),
+                         "duty": e.get("duty", 0.0),
+                         "source": e.get("source"), **base})
+        elif name == "compile":
+            rows.append({"kind": "compile", "fn": e.get("fn", "?"),
+                         "duration_s": e.get("dur", 0.0),
+                         "cache_hit": bool(e.get("cache_hit")),
+                         "trace": e.get("trace"), **base})
+        elif name == "recompile_storm":
+            rows.append({"kind": "storm", "fn": e.get("fn", "?"),
+                         "count": e.get("count"),
+                         "window_s": e.get("window_s"), **base})
+    rows.sort(key=lambda x: -(x["start_time"] or 0))
+    out: List[dict] = []
+    counts: dict = {}
+    for r in rows:
+        n = counts.get(r["kind"], 0)
+        if n < limit:
+            counts[r["kind"]] = n + 1
+            out.append(r)
+    return out
+
+
+def summarize_devices(rows: List[dict]) -> dict:
+    """Roll-up over device rows: the LATEST hbm snapshot per
+    (node, pid, device), compile aggregates per function (count,
+    recompiles, cache hits, total/max seconds), and the storm flags —
+    the /devices page and the `ray-tpu devices` footer."""
+    devices: dict = {}
+    compiles: dict = {}
+    storms = []
+    for r in rows:                  # rows arrive newest first
+        if r["kind"] == "hbm":
+            key = (r["node_id"], r["pid"], r["device"])
+            if key not in devices:  # first seen == newest snapshot
+                devices[key] = r
+        elif r["kind"] == "compile":
+            a = compiles.setdefault(r["fn"], {
+                "fn": r["fn"], "compiles": 0, "cache_hits": 0,
+                "total_s": 0.0, "max_s": 0.0, "last_time": None,
+                "_procs": {}})
+            if r["cache_hit"]:
+                a["cache_hits"] += 1
+            else:
+                a["compiles"] += 1
+                a["total_s"] += r["duration_s"] or 0.0
+                a["max_s"] = max(a["max_s"], r["duration_s"] or 0.0)
+                # per-process counts: a RECOMPILE is a process
+                # compiling the same fn AGAIN — eight workers each
+                # cold-compiling once is a healthy cluster, not 7
+                # recompiles
+                pk = (r["node_id"], r["pid"])
+                a["_procs"][pk] = a["_procs"].get(pk, 0) + 1
+            if a["last_time"] is None:
+                a["last_time"] = r["start_time"]
+        elif r["kind"] == "storm":
+            storms.append(r)
+    dev_rows = sorted(devices.values(),
+                      key=lambda d: (str(d["node_id"] or ""),
+                                     str(d["device"] or "")))
+    comp_rows = sorted(compiles.values(),
+                       key=lambda c: (-c["compiles"], c["fn"]))
+    for c in comp_rows:
+        procs = c.pop("_procs")
+        c["recompiles"] = sum(max(0, n - 1) for n in procs.values())
+        c["mean_s"] = c["total_s"] / max(1, c["compiles"])
+    return {"devices": dev_rows, "compiles": comp_rows,
+            "storms": storms,
+            "hbm_used_bytes": sum(d["used"] or 0 for d in dev_rows),
+            "compile_total_s": sum(c["total_s"] for c in comp_rows)}
+
+
+def list_devices(limit: int = 500) -> List[dict]:
+    """Recent device-plane rows (HBM snapshots, compile spans, storm
+    flags) off the cluster timeline (`ray-tpu devices` from Python)."""
+    r = _call("collect_timeline")
+    return devices_from_events(r.get("events", []), limit)
+
+
 def summarize_collectives(rows: List[dict]) -> List[dict]:
     """Aggregate collective rows per (kind, op, codec): round count,
     mean/max round time, bytes per round, and the modal straggler rank

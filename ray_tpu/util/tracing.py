@@ -314,7 +314,11 @@ _COLLECTIVE_ROUND_ARGS = ("op", "codec", "cid", "step", "bytes",
 _REQUEST_SPAN_ARGS = ("trace", "span", "parent", "seg", "status",
                       "keep", "deployment", "method", "http_status",
                       "error", "links", "step", "block", "slots",
-                      "tokens", "attempt", "replica")
+                      "tokens", "attempt", "replica", "kv_bytes")
+
+
+_DEVICE_SPAN_ARGS = ("fn", "cache_hit", "trace", "seg", "device",
+                     "count", "window_s")
 
 
 def to_chrome(evs: List[dict], path: Optional[str] = None,
@@ -329,6 +333,11 @@ def to_chrome(evs: List[dict], path: Optional[str] = None,
     data actually took. Request spans (the "request" category) become
     per-component lanes (``tid=req:<component>``) with parent->child
     flow edges — the cross-process waterfall of one served request.
+    Device spans (the "device" category, util/devmon.py) become a
+    ``dev:compile`` lane (XLA compile spans + recompile-storm
+    instants) and per-device ``dev:<device>`` duty-window lanes; a
+    compile span stamped with a request's trace id rides that
+    request's filtered waterfall.
 
     ``trace_id`` filters the input to ONE request trace before
     rendering (filter_trace: the trace's own spans, batch spans linked
@@ -393,6 +402,38 @@ def to_chrome(evs: List[dict], path: Optional[str] = None,
                                         node_pid, tid)
                 if e.get("parent"):
                     req_parents.append((e["span"], e["parent"]))
+        elif cat in ("device", "device_window"):
+            # accelerator-plane lanes (util/devmon.py): XLA compile
+            # spans on a dev:compile lane (a traced request's compile
+            # rides its waterfall — "slow because it compiled"),
+            # device-compute duty windows (their own budget category)
+            # on a per-device lane, and recompile-storm flags as
+            # instants on the compile lane. hbm snapshots are gauges,
+            # not spans — skipped here.
+            ts_us = adj_us(e, e["ts"])
+            name = e.get("name")
+            if name == "compile":
+                out.append({"ph": "X", "cat": "device",
+                            "name": f"xla:{e.get('fn', '?')}",
+                            "ts": ts_us, "dur": e.get("dur", 0.0) * 1e6,
+                            "pid": node_pid, "tid": "dev:compile",
+                            "args": {k: e[k] for k in _DEVICE_SPAN_ARGS
+                                     if e.get(k) is not None}})
+            elif name == "window":
+                out.append({"ph": "X", "cat": "device",
+                            "name": e.get("seg", "device"),
+                            "ts": ts_us, "dur": e.get("dur", 0.0) * 1e6,
+                            "pid": node_pid,
+                            "tid": f"dev:{e.get('device', '0')}",
+                            "args": {k: e[k] for k in _DEVICE_SPAN_ARGS
+                                     if e.get(k) is not None}})
+            elif name == "recompile_storm":
+                out.append({"ph": "I", "cat": "device",
+                            "name": f"storm:{e.get('fn', '?')}",
+                            "ts": ts_us, "s": "p",
+                            "pid": node_pid, "tid": "dev:compile",
+                            "args": {k: e[k] for k in _DEVICE_SPAN_ARGS
+                                     if e.get(k) is not None}})
         elif cat == "collective":
             ts_us = adj_us(e, e["ts"])
             dur_us = e.get("dur", 0.0) * 1e6

@@ -93,6 +93,8 @@ def instantiate_all() -> dict:
     take(zero.zero_metrics())
     from ray_tpu.train import controller
     take(controller.train_metrics())
+    from ray_tpu.util import devmon
+    take(devmon.devmon_metrics())
     return out
 
 
@@ -154,6 +156,59 @@ def lint_category_caps() -> list:
         if cat not in events.CATEGORIES)
 
 
+# Device-plane metric families: every string literal in the source
+# tree that LOOKS like one of these metric names must actually be
+# registered by instantiate_all() — a devmon/engine call site emitting
+# an unregistered name would silently create a series the catalog,
+# docs, and dashboards don't know about. The scan is literal-based
+# (same spirit as the events.record category grep above); names
+# mentioned in docstrings/backticks don't match, only quoted strings.
+DEVICE_METRIC_PREFIXES = ("device_", "xla_", "llm_kv_")
+
+_DEVICE_METRIC_RE = re.compile(
+    r"""['"]((?:%s)[a-z0-9_]+)['"]"""
+    % "|".join(re.escape(p) for p in DEVICE_METRIC_PREFIXES))
+
+
+def scan_device_metric_names(root: str = None) -> list:
+    """Every quoted device-family metric-name literal under ray_tpu/
+    as ``(relpath:line, name)``."""
+    if root is None:
+        root = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "ray_tpu")
+    found = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+            for m in _DEVICE_METRIC_RE.finditer(text):
+                line = text.count("\n", 0, m.start()) + 1
+                rel = os.path.relpath(path, os.path.dirname(root))
+                found.append((f"{rel}:{line}", m.group(1)))
+    return found
+
+
+def lint_device_metric_registration(registry: dict,
+                                    found: list = None) -> list:
+    """Violations for device-family metric literals that no registered
+    metric matches (exact name only — a label value like "device"
+    doesn't match the prefixed-name regex in the first place).
+    Registered EVENT CATEGORIES are exempt: "device_window" is a
+    buffer-budget category, not a metric series."""
+    if found is None:
+        found = scan_device_metric_names()
+    from ray_tpu.util import events
+    allowed = set(registry) | set(events.CATEGORIES)
+    return sorted(
+        f"{site}: metric literal {name!r} matches a device family "
+        f"({'/'.join(DEVICE_METRIC_PREFIXES)}) but is not registered "
+        f"by instantiate_all()"
+        for site, name in found if name not in allowed)
+
+
 # THE registry of lint-enforced Config knob families: family label ->
 # (name prefix, name suffix). Every knob matching a family must be
 # exercised by at least one test module — register new families here
@@ -166,6 +221,9 @@ KNOB_FAMILIES = {
     "tuner": ("collective_tuner", ""),
     # request tracing (tail-sampling rate, slow-keep threshold)
     "trace": ("trace_", ""),
+    # device observability (recompile-storm gate, HBM cadence, duty
+    # horizon — util/devmon.py)
+    "devmon": ("devmon_", ""),
 }
 
 
@@ -237,13 +295,14 @@ def lint_chaos_knob_tests(tests_dir: str = None,
 
 
 def main() -> int:
-    instantiate_all()
+    registered = instantiate_all()
     from ray_tpu.util import metrics
     errors = lint(metrics._REGISTRY)
     found = scan_event_categories()
     errors += lint_event_categories(found)
     errors += lint_category_caps()
     errors += lint_knob_tests()
+    errors += lint_device_metric_registration(registered)
     if errors:
         print(f"{len(errors)} metric/event lint violation(s):")
         for e in errors:

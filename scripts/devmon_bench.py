@@ -1,0 +1,157 @@
+"""Device-observability overhead A/B: engine throughput devmon off/on.
+
+Method: the COLLECTIVE_TRACE_BENCH / TRACE_BENCH recipe — reps
+INTERLEAVED (off, on, off, on, ...) so machine drift hits both arms
+equally; the headline is best-of-reps tokens/s per arm. Each rep runs
+the continuous-batching LLM engine closed-loop in a fresh subprocess
+(the RAY_TPU_DEVMON master switch is read at process import, like the
+tracing flags): the engine is the most devmon-sensitive workload in
+the tree — every decode block records a duty window, every prefill a
+device window, and the jax.monitoring compile listeners sit on the jit
+path.
+
+Arms:
+  off  RAY_TPU_DEVMON=0 (listeners never registered, every devmon
+       record path no-ops)
+  on   defaults: compile tracing + duty windows + HBM gauges at the
+       default knobs
+
+Run from the repo root: python scripts/devmon_bench.py --overhead
+Commit the aggregate JSON to DEVICE_BENCH.json.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def one_run(requests: int, prompt_len: int, max_new: int,
+            slots: int) -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import numpy as np
+
+    from ray_tpu.llm import LLMEngine
+    from ray_tpu.models import llama
+
+    cfg = llama.tiny(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                     n_kv_heads=4, ffn_dim=128, dtype="float32",
+                     logits_dtype="float32", attn_impl="reference")
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+
+    async def go():
+        eng = LLMEngine(cfg, params, max_slots=slots, max_len=256,
+                        prefill_buckets=(32, 64), cache_dtype="float32",
+                        steps_per_sync=8)
+        # warm every jit variant so the measured window is decode
+        # throughput, not compile time (compile spans are recorded
+        # either way — that's the point of the 'on' arm)
+        await eng.generate(list(range(1, prompt_len + 1)),
+                           max_new_tokens=max_new)
+        rng = np.random.default_rng(0)
+        prompts = [list(rng.integers(1, 255, size=prompt_len))
+                   for _ in range(requests)]
+        t0 = time.monotonic()
+        outs = await asyncio.gather(*[
+            eng.generate(p, max_new_tokens=max_new) for p in prompts])
+        elapsed = time.monotonic() - t0
+        toks = sum(len(o["tokens"]) for o in outs)
+        await eng.stop()
+        return {"requests": len(outs), "tokens": toks,
+                "elapsed_s": round(elapsed, 4),
+                "tokens_per_s": round(toks / elapsed, 2)}
+
+    out = asyncio.run(go())
+    from ray_tpu.util import devmon, events
+    out["devmon_enabled"] = devmon.enabled()
+    out["device_events"] = sum(
+        1 for e in events.dump()
+        if e.get("cat") in ("device", "device_window"))
+    return out
+
+
+ARMS = {
+    "off": {"RAY_TPU_DEVMON": "0"},
+    "on": {"RAY_TPU_DEVMON": "1"},
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--overhead", action="store_true",
+                    help="run the off/on A/B (the only arm; kept as a "
+                         "flag for future workload arms)")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--prompt-len", type=int, default=24)
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--one-run", action="store_true",
+                    help="internal: run one arm in THIS process and "
+                         "print its JSON line")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the aggregate JSON here too")
+    args = ap.parse_args()
+    if args.one_run:
+        print("RESULT " + json.dumps(one_run(
+            args.requests, args.prompt_len, args.max_new, args.slots)))
+        return 0
+    results = []
+    for rep in range(args.reps):
+        for arm, env in ARMS.items():       # interleaved: off, on, ...
+            child_env = dict(os.environ)
+            child_env.update(env)
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--one-run", "--requests", str(args.requests),
+                 "--prompt-len", str(args.prompt_len),
+                 "--max-new", str(args.max_new),
+                 "--slots", str(args.slots)],
+                env=child_env, capture_output=True, text=True,
+                timeout=900)
+            line = next((ln for ln in p.stdout.splitlines()
+                         if ln.startswith("RESULT ")), None)
+            if p.returncode != 0 or line is None:
+                print(p.stdout[-2000:], p.stderr[-2000:],
+                      file=sys.stderr)
+                raise RuntimeError(f"run failed: rep={rep} arm={arm}")
+            r = {"arm": arm, "rep": rep, **json.loads(line[7:])}
+            print(json.dumps(r))
+            results.append(r)
+    best = {arm: max((r for r in results if r["arm"] == arm),
+                     key=lambda r: r["tokens_per_s"])
+            for arm in ARMS}
+    agg = {
+        "bench": "devmon_overhead",
+        "method": "interleaved closed-loop LLM engine decode "
+                  "(best-of-reps tokens/s per arm; devmon master "
+                  "switch read at subprocess import)",
+        "requests_per_rep": args.requests,
+        "prompt_len": args.prompt_len,
+        "max_new": args.max_new,
+        "slots": args.slots,
+        "reps": args.reps,
+        "results": results,
+        "best_tokens_per_s": {a: best[a]["tokens_per_s"] for a in best},
+        "devmon_on_vs_off_throughput": round(
+            best["on"]["tokens_per_s"] / best["off"]["tokens_per_s"],
+            4),
+        "device_events_on": best["on"]["device_events"],
+        "device_events_off": best["off"]["device_events"],
+    }
+    print(json.dumps(agg, indent=2))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(agg, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
