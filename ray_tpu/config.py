@@ -85,6 +85,36 @@ class Config:
     # one-level ring regardless of topology.
     collective_hierarchy: str = "auto"
 
+    # --- pipeline parallelism (train/pipeline.py) ---
+    # Default microbatch schedule for train.Pipeline: "1f1b" keeps
+    # in-flight activations at O(stages) with the same bubble as
+    # GPipe; "gpipe" is the simple fill/drain reference.
+    pipeline_schedule: str = "1f1b"
+    # Ship activations/gradients across stage edges as device-path
+    # TensorRef handles (runtime/device_store.py — the tensor moves at
+    # most once, on the consumer's resolve; 3.6x over host staging per
+    # PERF.md) instead of host-staged numpy frames. Requires the
+    # cluster RPC pool for cross-process resolution; the runtime loop
+    # frees every ref the moment the consumer materializes it.
+    pipeline_device_transport: bool = True
+    # TTL backstop on schedule-owned activation refs: a consumer that
+    # dies before resolving cannot pin the producer's memory past this
+    # bound (the normal path frees refs at materialization). Keep it
+    # ABOVE pipeline_step_timeout_s plus the worst-case stage compile:
+    # a ref must outlive any stall the pipeline itself tolerates, or a
+    # slow-but-healthy consumer resolves an already-expired tensor.
+    pipeline_activation_ttl_s: float = 600.0
+    # Bound on one schedule step's MID-step channel waits (recv of a
+    # microbatch / backpressured send): a stage dead mid-step surfaces
+    # as PeerLostError within this instead of hanging the pipeline.
+    # The wait for a NEW step's first microbatch is exempt (driver
+    # cadence — eval/checkpoint pauses between steps are healthy);
+    # a peer dead at a step boundary is detected by the driver's
+    # report read, and Pipeline.teardown() injects STOP directly on
+    # inter-stage edges when a dead stage can't relay it, so parked
+    # survivors still unwind.
+    pipeline_step_timeout_s: float = 300.0
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_retry_max_attempts: int = 5

@@ -123,6 +123,29 @@ class DeviceStore:
         with self._lock:
             self._arrays.pop(tid, None)
 
+    # -- accounting ------------------------------------------------------
+
+    def live_count(self) -> int:
+        """Parked (unexpired) tensors in this store — the invariant a
+        schedule-owned transport must hold: after a pipeline step
+        drains, this returns to its pre-step value (activations are
+        freed as their consumer materializes them, so steady-state
+        memory is O(in-flight microbatches), never O(steps))."""
+        with self._lock:
+            self._purge_expired_locked()
+            return len(self._arrays)
+
+    def live_bytes(self) -> int:
+        """Total bytes of parked (unexpired) tensors."""
+        with self._lock:
+            self._purge_expired_locked()
+            return int(sum(getattr(a, "nbytes", 0)
+                           for a, _dl in self._arrays.values()))
+
+    def stats(self) -> Dict[str, int]:
+        return {"live_count": self.live_count(),
+                "live_bytes": self.live_bytes()}
+
     # -- consumer side ---------------------------------------------------
 
     def get(self, ref: TensorRef, sharding=None):
@@ -192,9 +215,12 @@ def _store() -> DeviceStore:
     return _STORE
 
 
-def put_device(arr) -> TensorRef:
-    """Public entry: park a device array, get a shippable handle."""
-    return _store().put(arr)
+def put_device(arr, ttl_s: Optional[float] = None) -> TensorRef:
+    """Public entry: park a device array, get a shippable handle.
+    ``ttl_s`` bounds how long an unresolved handle pins the array
+    (schedule-owned refs — pipeline activations — pass a short TTL so
+    a dead consumer cannot leak HBM past the bound)."""
+    return _store().put(arr, ttl_s=ttl_s)
 
 
 def get_device(ref: TensorRef, sharding=None):

@@ -570,6 +570,15 @@ class WorkerExecutor:
 
                 from ray_tpu.dag.runtime import exec_loop
                 fn = partial(exec_loop, hosted.instance)
+            elif method == "__pipe_exec_loop__":
+                # Pipeline-stage pinned loop (train/pipeline.py
+                # schedules executed by dag/runtime.py pipe_exec_loop)
+                # — dispatched like the dag loop, duck-typed against
+                # the instance's pipe_forward/pipe_backward/pipe_step.
+                from functools import partial
+
+                from ray_tpu.dag.runtime import pipe_exec_loop
+                fn = partial(pipe_exec_loop, hosted.instance)
             else:
                 fn = getattr(hosted.instance, method)
             if hosted.groups:
@@ -602,9 +611,10 @@ class WorkerExecutor:
         finally:
             tracing.reset_request_context(rtok)
             tracing.current_span.reset(tok)
-            if method != "__dag_exec_loop__":
-                # the pinned dag loop lives for the dag's whole lifetime —
-                # a span covering it would occlude every real slice
+            if method not in ("__dag_exec_loop__", "__pipe_exec_loop__"):
+                # pinned dag/pipeline loops live for the whole graph
+                # lifetime — a span covering one would occlude every
+                # real slice
                 tracing.record_exec(span, "actor", method, t0, time.time(),
                                     error=err,
                                     trace=tctx.trace_id if tctx else "")

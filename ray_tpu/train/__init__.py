@@ -17,6 +17,8 @@ from ray_tpu.train.boosting import (BoostingConfig, BoostingModel,
 from ray_tpu.train.collective import (PeerLostError, allgather_params,
                                       allreduce_gradients,
                                       reduce_scatter_gradients)
+from ray_tpu.train.pipeline import (Pipeline, PipelineStageActor,
+                                    bubble_fraction, compile_schedule)
 from ray_tpu.train.reshard import ReshardError
 from ray_tpu.train.trainer import (JaxTrainer, SklearnTrainer,
                                    TorchTrainer,
@@ -26,9 +28,11 @@ from ray_tpu.train.zero import ShardedOptimizer
 __all__ = [
     "BoostingConfig", "BoostingModel", "BoostingTrainer",
     "Checkpoint", "CheckpointConfig", "FailureConfig", "PeerLostError",
+    "Pipeline", "PipelineStageActor",
     "Result", "ReshardError",
     "RunConfig", "ScalingConfig", "ShardedOptimizer", "SklearnTrainer",
     "allgather_params", "allreduce_gradients", "await_regroup",
+    "bubble_fraction", "compile_schedule",
     "ensure_jax_distributed",
     "get_context", "get_dataset_shard", "reduce_scatter_gradients",
     "report", "JaxTrainer", "TorchTrainer", "get_controller",

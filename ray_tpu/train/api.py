@@ -235,6 +235,20 @@ class TrainContext:
         # next reshard collective (assigned by the controller's rewire).
         self._recovered_mirrors: list = []
         self._lost_info: dict = {}
+        # Pipeline-parallel group id (train/pipeline.py Pipeline sets
+        # it when constructed inside a train_fn): the controller's
+        # reshape gate reads it off poll() — a pipeline topology can
+        # NOT re-form in place around a lost stage (the stage's
+        # parameters exist nowhere else), so worker loss falls through
+        # to the checkpoint-restart path — and trace_step() uses it to
+        # pull the step's pipeline spans into the waterfall.
+        # pipeline_step is the pipeline's OWN step counter (bumped by
+        # Pipeline.step), deliberately separate from collective_step:
+        # an auxiliary allreduce between pipeline steps must not
+        # desynchronize the stage spans' step tags from the ones
+        # trace_step stamps.
+        self.pipeline_group: Optional[str] = None
+        self.pipeline_step = 0
 
     # -- elastic reshape ---------------------------------------------------
 
@@ -406,6 +420,23 @@ class TrainContext:
         seg = (r + (own_self - self.rank)) % n
         return total * seg // n, total * (seg + 1) // n
 
+    def register_pipeline(self, group: str) -> None:
+        """Mark this worker as driving a pipeline-parallel group (see
+        train/pipeline.py): gates elastic in-place reshape OFF for the
+        worker group (controller reads the flag off poll()) and tags
+        trace_step() waterfalls with the pipeline group id."""
+        self.pipeline_group = str(group)[:12] or None
+        self.pipeline_step = 0
+
+    def unregister_pipeline(self, group: str) -> None:
+        """Clear the pipeline flag at Pipeline.teardown() — a train_fn
+        that moves on to pure data-parallel training gets its elastic
+        in-place reshape back (a stale flag would force checkpoint
+        restarts forever). Only the registering group may clear it, so
+        tearing down an old pipeline can't unflag a newer one."""
+        if self.pipeline_group == str(group)[:12]:
+            self.pipeline_group = None
+
     def get_dataset_shard(self, name: str = "train"):
         shard = self._dataset_shards.get(name)
         if shard is None:
@@ -456,8 +487,11 @@ class TrainContext:
             step = self.collective_step
             # the ring group id scopes the step tag: filter_trace then
             # pulls only THIS group's rounds (two jobs sharing a step
-            # index must not cross-wire)
+            # index must not cross-wire); the pipeline group id does
+            # the same for the step's pipe:stage<k> spans
             group = (self._grad_sync or {}).get("group")
+            pgroup = getattr(self, "pipeline_group", None)
+            pstep0 = int(getattr(self, "pipeline_step", 0))
             t0, ok = time.time(), False
             try:
                 yield tctx.trace_id
@@ -473,6 +507,14 @@ class TrainContext:
                 devmon.record_device_window(name, t0, time.time(),
                                             trace=tctx.trace_id)
                 extra = {"group": group} if group else {}
+                if pgroup:
+                    extra["pgroup"] = pgroup
+                    # the FIRST pipeline step that ran inside this
+                    # span (Pipeline.step bumps pipeline_step); -1
+                    # when none did, so filter_trace pulls nothing
+                    # rather than an arbitrary step's lanes
+                    extra["pstep"] = pstep0 \
+                        if self.pipeline_step > pstep0 else -1
                 if root:
                     # the outermost step span IS the trace's root —
                     # train-step traces are few and hand-opened, so

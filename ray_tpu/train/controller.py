@@ -115,6 +115,10 @@ class TrainController:
         # last seen peer-checkpoint inventory per CURRENT rank index
         # ({mirrored_rank: step}) — the reshape decision reads it
         self._last_mirrors: Dict[int, Dict[int, int]] = {}
+        # ranks that reported an active pipeline-parallel group
+        # (train/pipeline.py) on their last poll — the reshape gate
+        # reads it (a pipeline cannot shrink in place)
+        self._last_pipeline: Dict[int, bool] = {}
 
     # --- scaling policy (reference: scaling_policy/fixed.py, elastic.py) ---
 
@@ -387,6 +391,7 @@ class TrainController:
         group_id = uuid.uuid4().hex
         self._group_id = group_id
         self._last_mirrors = {}
+        self._last_pipeline = {}
         sync = self._grad_sync_specs(group_id)
         n = len(self._workers)
         refs = []
@@ -574,6 +579,7 @@ class TrainController:
                 for rep in p["reports"]:
                     self._handle_report(p["rank"], rep)
                 self._last_mirrors[i] = dict(p.get("mirrors") or {})
+                self._last_pipeline[i] = bool(p.get("pipeline"))
                 if p["error"]:
                     raise api.TaskError(
                         f"train_fn failed on rank {p['rank']}:\n"
@@ -629,6 +635,13 @@ class TrainController:
             # an in-place re-form would silently drop the dead rank's
             # shard for the rest of the run — the restart path
             # re-splits over the new size, so it is the correct one
+            return None
+        if any(self._last_pipeline.values()):
+            # pipeline-topology group (train/pipeline.py, mirrored
+            # from the streaming_split gate above): each rank hosts a
+            # DISTINCT stage's parameters, so an in-place N-1 re-form
+            # would silently train a model with a stage missing — the
+            # checkpoint restart is the only correct recovery
             return None
         dead_ranks = sorted({i for i, _ in dead})
         survivors = [i for i in range(len(self._workers))
@@ -686,6 +699,7 @@ class TrainController:
         self._workers = [self._workers[i] for i in survivors]
         self._infos = [self._infos[i] for i in survivors]
         self._last_mirrors = {}
+        self._last_pipeline = {}
         n = len(self._workers)
         import uuid
         gid = uuid.uuid4().hex

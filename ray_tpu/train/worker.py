@@ -140,7 +140,12 @@ class TrainWorker:
                    if gid == self._group_id}
         return {"done": self._done.is_set(), "error": self._error,
                 "reports": reports, "rank": self.rank,
-                "mirrors": mirrors}
+                "mirrors": mirrors,
+                # pipeline-topology flag: the controller's reshape gate
+                # must NOT re-form a ring around a lost pipeline stage
+                # (its parameters exist nowhere else — restart instead)
+                "pipeline": bool(getattr(self.ctx, "pipeline_group",
+                                         None)) if self.ctx else False}
 
     # --- elastic reshape -------------------------------------------------
 

@@ -44,8 +44,13 @@ from typing import Deque, Dict, List
 #               (one per engine decode block), so they get their own
 #               bucket: a steady serving load must not age the rare
 #               compile/storm/hbm events out of "device"
+#   pipeline    pipeline-parallel stage spans: per-microbatch F/B op
+#               spans + per-step bubble spans (dag/runtime.py
+#               pipe_exec_loop) — rendered as pipe:stage<k> timeline
+#               lanes with microbatch flow edges
 CATEGORIES = ("trace", "collective", "train", "worker", "cgroup",
-              "memory", "request", "device", "device_window")
+              "memory", "request", "device", "device_window",
+              "pipeline")
 
 _DEFAULT_CAP = 65536
 # Dedicated sub-budgets: the key also names the bucket. Everything
@@ -63,7 +68,12 @@ _DEFAULT_CAP = 65536
 # get their own bucket to drain.
 _CATEGORY_CAPS: Dict[str, int] = {"collective": 16384, "train": 4096,
                                   "request": 8192, "device": 4096,
-                                  "device_window": 4096}
+                                  "device_window": 4096,
+                                  # 2 op spans per microbatch per stage
+                                  # per step: a long pipeline run must
+                                  # age against itself, not evict task
+                                  # exec or collective spans
+                                  "pipeline": 8192}
 
 _BUFS: Dict[str, Deque[dict]] = {}
 _LOCK = threading.Lock()

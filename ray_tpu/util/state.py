@@ -113,6 +113,39 @@ def collectives_from_events(events, limit: int = 50) -> List[dict]:
     return rows[:limit]
 
 
+def pipeline_from_events(events, limit: int = 50) -> List[dict]:
+    """Timeline "pipeline" STEP spans -> per (group, stage, chain)
+    summary rows, newest first. The ONE place the pipeline step-span
+    shape is interpreted: steps seen, mean step wall time, mean
+    measured bubble, and the resulting bubble fraction — the number to
+    hold against the analytic (S-1)/(M+S-1) bound (per-microbatch op
+    spans are a chrome-trace concern and are skipped here)."""
+    acc: dict = {}
+    for e in events:
+        if e.get("cat") != "pipeline" or e.get("name") != "step":
+            continue
+        key = (e.get("group"), e.get("stage"), e.get("chain", 0))
+        row = acc.setdefault(key, {
+            "group": key[0], "stage": key[1], "chain": key[2],
+            "steps": 0, "step_s_sum": 0.0, "bubble_s_sum": 0.0,
+            "last_ts": 0.0})
+        row["steps"] += 1
+        row["step_s_sum"] += float(e.get("dur", 0.0))
+        row["bubble_s_sum"] += float(e.get("bubble_s", 0.0))
+        row["last_ts"] = max(row["last_ts"], e.get("ts", 0.0))
+    rows = []
+    for row in acc.values():
+        n = max(1, row["steps"])
+        step_s = row.pop("step_s_sum") / n
+        bubble_s = row.pop("bubble_s_sum") / n
+        rows.append({**row, "mean_step_s": step_s,
+                     "mean_bubble_s": bubble_s,
+                     "bubble_fraction": (bubble_s / step_s)
+                     if step_s > 0 else 0.0})
+    rows.sort(key=lambda x: (-(x["last_ts"] or 0), x["stage"] or 0))
+    return rows[:limit]
+
+
 def traces_from_events(events, limit: int = 100) -> List[dict]:
     """Timeline "request" spans -> one row per SAMPLED trace (a trace
     is sampled iff its proxy-side ROOT span was recorded — util/tracing

@@ -277,14 +277,19 @@ def filter_trace(evs: List[dict], trace_id: str) -> List[dict]:
     """Events belonging to ONE trace: request/exec spans stamped with
     the trace id, batch spans LINKED to it, and — when the trace
     contains train-step spans tagged with a collective step — the
-    collective rounds of those steps (TrainContext.collective_step tags
-    let a train-step trace reference its ring rounds). A step span that
-    also carries its ring ``group`` id matches only that group's rounds
-    (prefix match: hierarchical sub-rings derive ``<group>.n<i>`` /
-    ``<group>.x`` names) — two jobs that happen to share a step index
-    must not cross-wire their waterfalls; group-less step spans fall
-    back to step-only matching."""
-    step_keys = [(e.get("step"), e.get("group") or None)
+    collective rounds AND pipeline stage spans of those steps
+    (TrainContext.collective_step tags let a train-step trace reference
+    its ring rounds; a pipeline step bumps the same counter). A step
+    span that also carries its ring ``group`` id matches only that
+    group's rounds (prefix match: hierarchical sub-rings derive
+    ``<group>.n<i>`` / ``<group>.x`` names) — two jobs that happen to
+    share a step index must not cross-wire their waterfalls; pipeline
+    spans match the step span's ``pgroup`` tag the same way (per-stage
+    ZeRO rings derive ``<pgroup>.z<k>`` collective group names, so the
+    pgroup prefix also pulls those rounds in); group-less step spans
+    fall back to step-only matching."""
+    step_keys = [(e.get("step"), e.get("group") or None,
+                  e.get("pgroup") or None, e.get("pstep"))
                  for e in evs
                  if e.get("cat") == "request"
                  and e.get("trace") == trace_id
@@ -299,9 +304,26 @@ def filter_trace(evs: List[dict], trace_id: str) -> List[dict]:
         elif cat == "collective" and step_keys:
             grp = str(e.get("group") or "")
             if any(e.get("step") == s
-                   and (g is None or grp == g
-                        or grp.startswith(f"{g}."))
-                   for s, g in step_keys):
+                   and ((g is None and pg is None)
+                        or (g is not None
+                            and (grp == g or grp.startswith(f"{g}.")))
+                        or (pg is not None
+                            and (grp == pg or grp.startswith(f"{pg}."))))
+                   for s, g, pg, _ps in step_keys):
+                out.append(e)
+        elif cat == "pipeline" and step_keys:
+            grp = str(e.get("group") or "")
+            # pgroup scoping mirrors the collective group rule: a step
+            # span that names its pipeline matches only that group —
+            # and matches by the step span's PSTEP tag (the pipeline's
+            # own counter, immune to auxiliary-collective bumps of
+            # collective_step); a fully group-less step (no ring AND
+            # no pipeline) falls back to step-only matching
+            if any(((pg is not None and grp == pg
+                     and e.get("step") == (ps if ps is not None else s))
+                    or (pg is None and g is None
+                        and e.get("step") == s))
+                   for s, g, pg, ps in step_keys):
                 out.append(e)
     return out
 
@@ -319,6 +341,10 @@ _REQUEST_SPAN_ARGS = ("trace", "span", "parent", "seg", "status",
 
 _DEVICE_SPAN_ARGS = ("fn", "cache_hit", "trace", "seg", "device",
                      "count", "window_s")
+
+
+_PIPE_SPAN_ARGS = ("stage", "chain", "mb", "kind", "step", "group",
+                   "wait_s", "bubble_s", "update_s")
 
 
 def to_chrome(evs: List[dict], path: Optional[str] = None,
@@ -363,6 +389,8 @@ def to_chrome(evs: List[dict], path: Optional[str] = None,
     starts = {}        # task hex -> (ts_us, pid, tid)
     req_spans = {}     # request span id -> (start_us, end_us, pid, tid)
     req_parents = []   # (child span id, parent span id)
+    # (group, chain, step, mb, kind) -> {stage: (s_us, e_us, pid, tid)}
+    pipe_ops: dict = {}
     # (group, cid) -> {rank: (start_us, end_us, pid, tid, size)}
     rounds: dict = {}
     for e in evs:
@@ -402,6 +430,37 @@ def to_chrome(evs: List[dict], path: Optional[str] = None,
                                         node_pid, tid)
                 if e.get("parent"):
                     req_parents.append((e["span"], e["parent"]))
+        elif cat == "pipeline":
+            # pipeline-parallel stage lanes (dag/runtime.py
+            # pipe_exec_loop): one pipe:stage<k> lane per stage actor
+            # with per-microbatch F/B op spans and per-step bubble
+            # spans; forward flow edges stage p -> p+1 (and gradient
+            # edges p+1 -> p) show each microbatch's path through the
+            # pipeline
+            ts_us = adj_us(e, e["ts"])
+            dur_us = e.get("dur", 0.0) * 1e6
+            k = e.get("stage", "?")
+            ch = e.get("chain", 0)
+            tid = f"pipe:stage{k}" + (f".{ch}" if ch else "")
+            if e.get("name") == "op":
+                rec = {"ph": "X", "cat": "pipeline",
+                       "name": f"{e.get('kind', '?')}{e.get('mb', '?')}",
+                       "ts": ts_us, "dur": dur_us,
+                       "pid": node_pid, "tid": tid,
+                       "args": {a: e[a] for a in _PIPE_SPAN_ARGS
+                                if e.get(a) is not None}}
+                out.append(rec)
+                key = (e.get("group", ""), ch, e.get("step"),
+                       e.get("mb"), e.get("kind"))
+                pipe_ops.setdefault(key, {})[e.get("stage")] = (
+                    ts_us, ts_us + dur_us, node_pid, tid)
+            else:               # per-step span
+                out.append({"ph": "X", "cat": "pipeline",
+                            "name": f"step{e.get('step', '?')}",
+                            "ts": ts_us, "dur": dur_us,
+                            "pid": node_pid, "tid": tid,
+                            "args": {a: e[a] for a in _PIPE_SPAN_ARGS
+                                     if e.get(a) is not None}})
         elif cat in ("device", "device_window"):
             # accelerator-plane lanes (util/devmon.py): XLA compile
             # spans on a dev:compile lane (a traced request's compile
@@ -512,6 +571,25 @@ def to_chrome(evs: List[dict], path: Optional[str] = None,
         out.append({"ph": "f", "id": flow, "cat": "flow",
                     "name": "request", "ts": max(child[1], parent[0]),
                     "pid": child[2], "tid": child[3], "bp": "e"})
+    # pipeline flow edges: each microbatch's forward op at stage p
+    # feeds its op at stage p+1 (gradients: p+1 feeds p). Drawn
+    # producer-start -> consumer-end, clamped forward like the request
+    # edges — a consumer cannot finish before its producer started, so
+    # under clock correction the arrows never run backwards.
+    for (_g, _c, _s, _mb, kind), lanes in pipe_ops.items():
+        for stage, (s_us, _e_us, pid, tid) in lanes.items():
+            if not isinstance(stage, int):
+                continue
+            nxt = lanes.get(stage + 1 if kind == "F" else stage - 1)
+            if nxt is None:
+                continue
+            flow += 1
+            out.append({"ph": "s", "id": flow, "cat": "flow",
+                        "name": "pipe", "ts": s_us,
+                        "pid": pid, "tid": tid})
+            out.append({"ph": "f", "id": flow, "cat": "flow",
+                        "name": "pipe", "ts": max(nxt[1], s_us),
+                        "pid": nxt[2], "tid": nxt[3], "bp": "e"})
     if path is not None:
         with open(path, "w") as f:
             json.dump({"traceEvents": out,

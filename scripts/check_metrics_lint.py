@@ -93,6 +93,8 @@ def instantiate_all() -> dict:
     take(zero.zero_metrics())
     from ray_tpu.train import controller
     take(controller.train_metrics())
+    from ray_tpu.train import pipeline
+    take(pipeline.pipeline_metrics())
     from ray_tpu.util import devmon
     take(devmon.devmon_metrics())
     return out
@@ -224,6 +226,9 @@ KNOB_FAMILIES = {
     # device observability (recompile-storm gate, HBM cadence, duty
     # horizon — util/devmon.py)
     "devmon": ("devmon_", ""),
+    # pipeline parallelism (schedule kind, device-ref transport,
+    # activation TTL, step timeout — train/pipeline.py)
+    "pipeline": ("pipeline_", ""),
 }
 
 
