@@ -244,6 +244,44 @@ class Config:
     devmon_hbm_interval_s: float = 5.0
     devmon_duty_horizon_s: float = 30.0
 
+    # --- cluster health plane (util/timeseries.py + util/health.py) ---
+    # Master runtime off-switch for the head-side metrics time-series
+    # store + SLO engine (the RAY_TPU_HEALTH env var is the process-
+    # start master switch, same pattern as RAY_TPU_DEVMON). Off: no
+    # store, no evaluation loop, report_metrics keeps only the latest
+    # snapshot as before.
+    health_enabled: bool = True
+    # Raw-resolution window width and retention. Rollups derive from
+    # these (timeseries.RESOLUTION_SCALES): 10s raw for 15 min, 1-min
+    # for 2 h, 10-min for 24 h by default.
+    health_window_s: float = 10.0
+    health_retention_s: float = 900.0
+    # Memory bound: max labelled series tracked; past it the least-
+    # recently-updated series is evicted (health_series_dropped_total).
+    health_max_series: int = 4096
+    # Pinned regression baselines for the sentinels ("" = look for
+    # HEALTH_BASELINE.json in the working directory).
+    health_baseline_path: str = ""
+    # SLO engine (Google-SRE multi-window multi-burn-rate): the "page"
+    # tier fires when the error-budget burn rate exceeds slo_fast_burn
+    # over BOTH fast windows ("short,long" seconds — short detects
+    # fast, long stops one bad scrape from paging); the "warn" tier
+    # uses the slow windows at slo_slow_burn. Defaults scale the SRE
+    # workbook's 5m/1h page pair down to the store's 15-min raw
+    # retention.
+    slo_eval_interval_s: float = 10.0
+    slo_fast_burn: float = 14.4
+    slo_fast_windows_s: str = "60,300"
+    slo_slow_burn: float = 3.0
+    slo_slow_windows_s: str = "300,1800"
+    # Derived default objectives (per-deployment ingress latency +
+    # availability, collective straggler, HBM headroom) and their
+    # shared latency bound / target. False = only objectives user code
+    # registered via health.add_objective().
+    slo_default_objectives: bool = True
+    slo_latency_threshold_s: float = 1.0
+    slo_target: float = 0.99
+
     # --- control-plane fault tolerance ---
     # Directory for durable control tables (GCS-persistence analog,
     # runtime/persistence.py). "" = in-memory only.

@@ -188,6 +188,8 @@ class ControlService:
             "cluster_view": self.cluster_view,
             "report_metrics": self.report_metrics,
             "profile_target": self.profile_target,
+            "health_state": self.health_state,
+            "query_series": self.query_series,
             "ping": self.ping,
         }
 
@@ -282,6 +284,16 @@ class ControlService:
             self._recover()
         self.addr = await self.server.start(host, port)
         self._health_task = asyncio.ensure_future(self._health_loop())
+        # Cluster health plane (util/health.py): the head-side metrics
+        # time-series store + SLO burn-rate evaluation loop. Gated by
+        # RAY_TPU_HEALTH / Config.health_enabled; report_metrics feeds
+        # the store from the same pushes merge_remote keeps.
+        from ray_tpu.util import health as _health
+        self._healthplane_task = None
+        if _health.enabled() and self.config.health_enabled:
+            _health.activate(self.config)
+            self._healthplane_task = asyncio.ensure_future(
+                _health.head_loop(self.config))
         from ray_tpu.util import metrics as _m
         self._collector = self._render_metrics
         _m.register_collector(self._collector)
@@ -294,6 +306,12 @@ class ControlService:
     async def stop(self):
         if self._health_task:
             self._health_task.cancel()
+        if getattr(self, "_healthplane_task", None) is not None:
+            self._healthplane_task.cancel()
+            self._healthplane_task = None
+            from ray_tpu.util import health as _health
+            _health.deactivate()   # a later cluster in this process
+            # must not inherit this one's series or alert state
         from ray_tpu.util import metrics as _m
         if getattr(self, "_collector", None) is not None:
             _m.unregister_collector(self._collector)
@@ -1039,10 +1057,35 @@ class ControlService:
     async def report_metrics(self, source: str, text: str) -> dict:
         """Workers push labelled metric snapshots here (util/metrics.py
         push_loop); merged into this process's /metrics endpoint so the
-        head serves cluster-wide series."""
+        head serves cluster-wide series — and, when the health plane is
+        on, ingested into the head time-series store so the same push
+        builds queryable history (util/timeseries.py)."""
         from ray_tpu.util import metrics as _m
         _m.merge_remote(str(source), str(text))
+        from ray_tpu.util import health as _health
+        try:
+            _health.ingest_push(str(source), str(text))
+        except Exception:  # noqa: BLE001 — history must not fail pushes
+            pass
         return {"ok": True}
+
+    async def health_state(self) -> dict:
+        """The health plane's machine-readable snapshot (objectives,
+        burn rates, active alerts, sentinels) — the /health endpoint,
+        `ray-tpu health`, and the dashboard all serve this; its
+        ``burn_advice`` map is the input contract for SLO-driven
+        replica autoscaling (ROADMAP item 3)."""
+        from ray_tpu.util import health as _health
+        return _health.local_state()
+
+    async def query_series(self, name: str, since_s: float = 900.0,
+                           labels: Optional[dict] = None) -> dict:
+        """Windowed points for one stored metric series (`ray-tpu
+        metrics <name> --since 15m` and the dashboard sparklines)."""
+        from ray_tpu.util import health as _health
+        return _health.local_query(str(name), float(since_s),
+                                   labels if isinstance(labels, dict)
+                                   else None)
 
     # --- cluster-wide profiling -------------------------------------------
 

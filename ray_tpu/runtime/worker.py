@@ -703,6 +703,17 @@ class WorkerExecutor:
 
     async def shutdown_worker(self):
         await self.flush_events()     # spans must outlive the worker
+        # Final metrics snapshot: the push loop ticks every export
+        # interval, so a worker reaped seconds after its last task
+        # would otherwise take up to a full interval's counters to the
+        # grave — head aggregation silently undercounts short-lived
+        # workers. Bounded so a dead head can't stall the shutdown.
+        flush = getattr(self, "_final_metrics_push", None)
+        if flush is not None:
+            try:
+                await asyncio.wait_for(flush(), 2.0)
+            except Exception:  # noqa: BLE001 — best effort on exit
+                pass
         asyncio.get_running_loop().call_later(0.05, sys.exit, 0)
         return {"ok": True}
 
@@ -754,10 +765,42 @@ async def _amain():
     async def _head_call(method, **kw):
         return await ctx.pool.call(head, method, timeout=10.0, **kw)
 
+    _push_source = f"worker:{wid.hex()[:12]}"
+    _push_labels = {"node": node_id.hex()[:12],
+                    "worker": wid.hex()[:12]}
     asyncio.ensure_future(_metrics.push_loop(
-        _head_call, source=f"worker:{wid.hex()[:12]}",
-        labels={"node": node_id.hex()[:12], "worker": wid.hex()[:12]},
+        _head_call, source=_push_source, labels=_push_labels,
         interval_s=ctx.config.metrics_export_interval_s))
+    # graceful shutdown drains one FINAL snapshot through the same
+    # path (shutdown_worker) so the last interval's counters survive
+    executor._final_metrics_push = lambda: _metrics.push_once(
+        _head_call, _push_source, _push_labels)
+
+    # SIGTERM is how the agent actually reaps workers (_kill_worker
+    # -> proc.terminate()): without this handler the process dies
+    # instantly and neither the span flush nor the final metrics push
+    # ever runs — the graceful-shutdown drain would be dead code on
+    # the production reap path. The drain is bounded (flush timeouts
+    # + a hard daemon-timer backstop), so a dead head can't turn
+    # termination into a hang.
+    import signal as _signal
+    import threading as _threading
+    _terming = {"v": False}
+
+    def _graceful_term():
+        if _terming["v"]:
+            return
+        _terming["v"] = True
+        t = _threading.Timer(3.0, os._exit, args=(0,))
+        t.daemon = True
+        t.start()
+        asyncio.ensure_future(executor.shutdown_worker())
+
+    try:
+        asyncio.get_running_loop().add_signal_handler(
+            _signal.SIGTERM, _graceful_term)
+    except (NotImplementedError, RuntimeError, ValueError):
+        pass     # non-unix: keep default die-now semantics
 
     # Device-plane observability (util/devmon.py): the monitor loop
     # hooks the XLA compile listeners the tick after jax first appears

@@ -304,7 +304,61 @@ def cmd_logs(args) -> int:
 
 
 def cmd_metrics(args) -> int:
-    """Fetch /metrics from a node's Prometheus endpoint."""
+    """Without a name: dump /metrics from a node's Prometheus
+    endpoint (the latest snapshot). With a name: query the HEAD's
+    time-series store for that metric's history (`ray-tpu metrics
+    serve_proxy_handler_s --since 15m`) and render a sparkline +
+    per-window stats — degradation over minutes, not a moment."""
+    if getattr(args, "name", None):
+        from ray_tpu.util.health import parse_since, spark
+        addr = _resolve_address(args)
+        labels = None
+        if getattr(args, "labels", None):
+            labels = dict(kv.split("=", 1)
+                          for kv in args.labels.split(",") if "=" in kv)
+        since_s = parse_since(args.since, 900.0)
+        r = _call_head(addr, "query_series", name=args.name,
+                       since_s=since_s, labels=labels)
+        if r.get("error"):
+            print(r["error"], file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(r, default=str, indent=2))
+            return 0
+        pts = r.get("points", [])
+        kind = r.get("kind")
+        if not pts:
+            print(f"no stored points for {args.name!r} in the last "
+                  f"{since_s:g}s (is the health plane on — "
+                  f"RAY_TPU_HEALTH / Config.health_enabled — and has "
+                  f"the series been pushed yet?)")
+            return 0
+        from ray_tpu.util.timeseries import DISPLAY_FIELD
+        field = DISPLAY_FIELD.get(kind, "value")
+        vals = [p.get(field) for p in pts]
+        nums = [v for v in vals if v is not None]
+        unit = "/s" if field == "rate" else \
+            (" s" if args.name.endswith("_s") else "")
+        print(f"{args.name} [{kind}] — {len(pts)} windows of "
+              f"{r.get('window_s', 0):g}s over {since_s:g}s, "
+              f"{r.get('series', 0)} series merged ({field})")
+        print(f"  {spark(vals)}")
+        if nums:
+            print(f"  min {min(nums):g}{unit}  "
+                  f"mean {sum(nums) / len(nums):g}{unit}  "
+                  f"max {max(nums):g}{unit}  last {nums[-1]:g}{unit}")
+        if kind == "histogram":
+            last = pts[-1]
+            print(f"  last window: n={last.get('count', 0):g} "
+                  f"p50={last.get('p50', 0):g}s "
+                  f"p99={last.get('p99', 0):g}s "
+                  f"mean={last.get('mean', 0):g}s")
+        return 0
+    if getattr(args, "json", False):
+        print("--json applies to the named-metric history query "
+              "(ray-tpu metrics <name> --json); the bare form dumps "
+              "raw Prometheus text", file=sys.stderr)
+        return 2
     import urllib.request
     addr = args.endpoint
     if not addr:
@@ -323,6 +377,72 @@ def cmd_metrics(args) -> int:
     with urllib.request.urlopen(f"http://{addr}/metrics",
                                 timeout=10) as r:
         sys.stdout.write(r.read().decode())
+    return 0
+
+
+def cmd_health(args) -> int:
+    """Cluster health plane summary (util/health.py): SLO objectives
+    with their multi-window burn rates, active page/warn alerts (with
+    exemplar trace ids — `ray-tpu trace <id>` opens the offending
+    request), and regression sentinels vs the pinned
+    HEALTH_BASELINE.json."""
+    import time as _time
+    addr = _resolve_address(args)
+    s = _call_head(addr, "health_state")
+    if args.json:
+        print(json.dumps(s, default=str, indent=2))
+        return 0
+    if not s.get("enabled"):
+        print(s.get("reason", "health plane disabled"))
+        return 0
+    tiers = s.get("tiers", {})
+    tdesc = ", ".join(
+        f"{t}: burn>={v['burn_threshold']:g} over "
+        f"{v['windows_s'][0]:g}s+{v['windows_s'][1]:g}s"
+        for t, v in tiers.items())
+    print(f"health plane: {s.get('series', 0)} series, "
+          f"{s.get('points_total', 0)} points, eval #"
+          f"{s.get('eval_count', 0)}  ({tdesc})")
+    alerts = s.get("alerts", [])
+    for a in alerts:
+        since = _time.strftime("%H:%M:%S",
+                               _time.localtime(a.get("since") or 0))
+        ex = a.get("exemplar")
+        print(f"  ALERT [{a['tier'].upper()}] {a['objective']} "
+              f"firing since {since}"
+              + (f"  exemplar trace {ex}  (ray-tpu trace {ex})"
+                 if ex else ""))
+    if not alerts:
+        print("  no active alerts")
+    print()
+    for o in s.get("objectives", []):
+        page = (o.get("tiers") or {}).get("page", {})
+        warn = (o.get("tiers") or {}).get("warn", {})
+
+        def fb(v):
+            return "-" if v is None else \
+                ("inf" if v == -1.0 else f"{v:g}")
+        mark = {"page": "PAGE ", "warn": "warn "}.get(
+            o.get("alert"), "ok   ")
+        print(f"  {mark} {o['name']:28s} [{o['kind']:12s}] "
+              f"page burn {fb(page.get('burn_short'))}/"
+              f"{fb(page.get('burn_long'))} "
+              f"warn {fb(warn.get('burn_short'))}/"
+              f"{fb(warn.get('burn_long'))}  {o.get('metric')}")
+    sents = s.get("sentinels", [])
+    if sents:
+        print()
+        for t in sents:
+            live = "-" if t.get("live") is None else f"{t['live']:g}"
+            ratio = "-" if t.get("ratio") is None \
+                else f"{t['ratio']:.2f}x"
+            flag = "REGRESSION" if t.get("breached") else "ok"
+            print(f"  {flag:10s} {t['name']:28s} live {live} vs "
+                  f"baseline {t['baseline']:g} ({ratio}, "
+                  f"tolerance {t['tolerance']:g}x, "
+                  f"{t['stat']} over {t['window_s']:g}s)")
+    print("\nhistory: ray-tpu metrics <name> --since 15m; "
+          "machine-readable: GET /health?json=1 on the metrics port")
     return 0
 
 
@@ -662,9 +782,31 @@ def main(argv=None) -> int:
                     help="print only the last N lines")
     pg.set_defaults(fn=cmd_logs)
 
-    pm = sub.add_parser("metrics", help="dump a node's /metrics")
+    pm = sub.add_parser(
+        "metrics",
+        help="dump a node's /metrics, or (with a name) query the "
+             "head's time-series history for one metric")
+    pm.add_argument("name", nargs="?",
+                    help="metric name to query from the head store "
+                         "(e.g. serve_proxy_handler_s); omit to dump "
+                         "the raw /metrics snapshot")
+    pm.add_argument("--since", default="15m",
+                    help="history window, e.g. 90s / 15m / 2h "
+                         "(default 15m)")
+    pm.add_argument("--labels",
+                    help="label selector, e.g. deployment=app1")
+    pm.add_argument("--json", action="store_true")
+    pm.add_argument("--address")
     pm.add_argument("--endpoint", help="host:port (default: latest local)")
     pm.set_defaults(fn=cmd_metrics)
+
+    ph = sub.add_parser(
+        "health",
+        help="SLO objectives, burn-rate alerts (page/warn tiers), and "
+             "regression sentinels off the head health plane")
+    ph.add_argument("--address")
+    ph.add_argument("--json", action="store_true")
+    ph.set_defaults(fn=cmd_health)
 
     pk = sub.add_parser("stack",
                         help="dump a live worker/actor's thread stacks "

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import math
 import time
 from collections import deque
@@ -37,6 +38,8 @@ from typing import Dict, Optional, Tuple
 from ray_tpu import api
 from ray_tpu.serve import fault
 from ray_tpu.util import tracing
+
+_log = logging.getLogger("ray_tpu.serve.proxy")
 
 
 class _BadRequest(Exception):
@@ -158,6 +161,13 @@ def proxy_metrics() -> dict:
             "serve_proxy_handler_s",
             "Time awaiting the deployment handler's result",
             tag_keys=("deployment",)),
+        # the availability SLI: the health plane's per-deployment
+        # availability objective reads code="5xx" increments off this
+        # (util/health.py derived objectives)
+        "requests": m.Counter(
+            "serve_requests_total",
+            "Ingress requests by final HTTP status code",
+            tag_keys=("deployment", "code")),
     }
 
 
@@ -174,6 +184,9 @@ class HTTPProxy:
         self._m = proxy_metrics()
         self._fm = fault.fault_metrics()
         self._adm: Dict[str, _Admission] = {}
+        # cached head health snapshot for the shed advisory (log-only
+        # for now; the actuation hook for ROADMAP item 3's autoscaler)
+        self._health_advice = {"ts": 0.0, "state": None}
 
     def _admission(self, dep: str) -> _Admission:
         a = self._adm.get(dep)
@@ -407,6 +420,20 @@ class HTTPProxy:
         if isinstance(e, _Shed):
             self._shed += 1
             finish("shed", 503)
+            if dep:
+                self._m["requests"].inc(
+                    tags={"deployment": dep, "code": "503"})
+                # Health-plane advisory (LOG-ONLY for now): a shed
+                # while the deployment's availability/latency budget is
+                # already burning is exactly the moment SLO-driven
+                # replica autoscaling (ROADMAP item 3) would scale out.
+                # The actuation hook is the head's `health_state`
+                # burn_advice map this consults — an autoscaler swaps
+                # the log line below for a scale-up RPC.
+                try:
+                    asyncio.ensure_future(self._consult_health(dep))
+                except RuntimeError:
+                    pass       # no running loop (unit-test contexts)
             hdrs["Retry-After"] = str(int(math.ceil(e.retry_after_s)))
             return self._respond(
                 writer, 503, {"error": f"overloaded: {e}"},
@@ -417,13 +444,55 @@ class HTTPProxy:
                 (kind == "timeout" and rem is not None and rem <= 0.05):
             self._fm["deadline"].inc(tags={"where": where})
             finish("deadline", 504)
+            if dep:
+                self._m["requests"].inc(
+                    tags={"deployment": dep, "code": "504"})
             return self._respond(writer, 504,
                                  {"error": f"deadline exceeded: {e}"},
                                  headers=hdrs or None)
         finish("error", 500)
+        if dep:
+            self._m["requests"].inc(
+                tags={"deployment": dep, "code": "500"})
         return self._respond(writer, 500,
                              {"error": f"{type(e).__name__}: {e}"},
                              headers=hdrs or None)
+
+    async def _consult_health(self, dep: str) -> None:
+        """Log-only advisory off the cluster health plane: fetch (and
+        briefly cache) the head's SLO snapshot; when the deployment's
+        availability or latency budget is burning, say so next to the
+        shed decision. Never raises — an unreachable head or a
+        disabled plane silently skips the advisory."""
+        try:
+            cache = self._health_advice
+            now = time.monotonic()
+            if now - cache["ts"] > 5.0:
+                # stamp BEFORE awaiting: a shed storm must not
+                # stampede the (already overloaded) head with one
+                # health_state RPC per shed — concurrent callers and
+                # post-timeout retries all see a fresh stamp
+                cache["ts"] = now
+                ctx = api._g.ctx
+                cache["state"] = await ctx.pool.call(
+                    ctx.head_addr, "health_state", timeout=2.0)
+            st = cache["state"] or {}
+            adv = (st.get("burn_advice") or {}).get(dep)
+            if adv and (adv.get("availability_burning")
+                        or adv.get("latency_burning")) \
+                    and now - cache.get("logged_ts", 0.0) > 5.0:
+                # one advisory line per cache window, not one per
+                # shed — a shed storm must not also be a log storm
+                cache["logged_ts"] = now
+                _log.warning(
+                    "serve[%s]: shedding while the %s-tier SLO budget "
+                    "is burning (availability=%s latency=%s) — replica "
+                    "scale-out would relieve this (autoscaler hook, "
+                    "ROADMAP item 3)", dep, adv.get("tier") or "?",
+                    adv.get("availability_burning"),
+                    adv.get("latency_burning"))
+        except Exception:  # noqa: BLE001 — advisory only
+            pass
 
     async def _dispatch(self, writer, method, path, headers, body):
         self._requests += 1
@@ -589,6 +658,8 @@ class HTTPProxy:
                 tracing.finish_request(
                     tctx, t_arrive_wall, time.time(), status="ok",
                     http_status=200, deployment=dep)
+            self._m["requests"].inc(
+                tags={"deployment": dep, "code": "200"})
             return self._respond(writer, 200, result,
                                  headers=self._trace_headers(tctx))
 
@@ -609,19 +680,29 @@ class HTTPProxy:
         response ends with the connection."""
         from ray_tpu.serve.handle import DeploymentHandle
         loop = asyncio.get_running_loop()
-        if arg is not None and not isinstance(arg, dict):
+
+        def _bad_stream(msg: str) -> str:
+            # validation 500s are still failed requests: the
+            # availability SLI counts them and the trace (errors are
+            # always kept) finishes, same as the unary error paths
             self._errors += 1
-            self._respond(writer, 500,
-                          {"error": "stream requests take a JSON object "
-                                    "body with a 'tokens' field"})
+            self._m["requests"].inc(
+                tags={"deployment": dep, "code": "500"})
+            if tctx is not None and t_arrive_wall is not None:
+                tracing.finish_request(
+                    tctx, t_arrive_wall, time.time(), status="error",
+                    error=True, http_status=500, deployment=dep)
+            self._respond(writer, 500, {"error": msg},
+                          headers=self._trace_headers(tctx))
             return "close"
+
+        if arg is not None and not isinstance(arg, dict):
+            return _bad_stream("stream requests take a JSON object "
+                               "body with a 'tokens' field")
         kw = dict(arg or {})
         tokens = kw.pop("tokens", None)
         if tokens is None:
-            self._errors += 1
-            self._respond(writer, 500,
-                          {"error": "stream request needs 'tokens'"})
-            return "close"
+            return _bad_stream("stream request needs 'tokens'")
         try:
             h = DeploymentHandle(
                 dep, _deadline_ts=deadline_ts,
@@ -688,6 +769,13 @@ class HTTPProxy:
             self._m["handler"].observe(
                 time.monotonic() - t_sent, tags,
                 exemplar=tctx.trace_id if tctx else None)
+            # stream availability: headers already went out 200, but a
+            # cut/errored stream is a failed request to the client —
+            # the SLI counts it like the unary 5xx it would have been
+            self._m["requests"].inc(tags={
+                "deployment": dep,
+                "code": {"ok": "200",
+                         "deadline": "504"}.get(status, "500")})
             if tctx is not None:
                 tracing.record_request_span(
                     "proxy", "handler", tctx, tctx.span_id,

@@ -41,6 +41,7 @@ _NAV = ("<nav><a href='/'>overview</a><a href='/nodes'>nodes</a>"
         "<a href='/pgs'>placement groups</a><a href='/serve'>serve</a>"
         "<a href='/tasks'>tasks</a><a href='/traces'>traces</a>"
         "<a href='/devices'>devices</a>"
+        "<a href='/health'>health</a>"
         "<a href='/history'>history</a>"
         "<a href='/profile'>profile</a>"
         "<a href='/metrics'>metrics</a></nav>")
@@ -399,6 +400,123 @@ async def _devices(fetch: Fetch, query: str = "") -> bytes:
     return _page("devices", body)
 
 
+async def _health(fetch: Fetch, query: str = "") -> bytes:
+    """Cluster health plane (util/health.py off the head's time-series
+    store): SLO objectives with multi-window burn rates, active
+    page/warn alerts (exemplar trace ids link straight into /traces),
+    regression sentinels vs HEALTH_BASELINE.json, and sparklines for
+    the breaching series. Machine-readable twin: /health?json=1."""
+    s = await fetch("health_state")
+    if not s.get("enabled"):
+        return _page("health",
+                     f"<p class=dim>{_esc(s.get('reason', 'health plane disabled'))}</p>")
+    tiers = s.get("tiers", {})
+    head = (f"<p><span class=pill>{s.get('series', 0)} series</span> "
+            f"<span class=pill>{s.get('points_total', 0)} points"
+            f"</span> <span class=pill>eval #"
+            f"{s.get('eval_count', 0)}</span> "
+            + " ".join(
+                f"<span class=pill>{_esc(t)}: burn&ge;"
+                f"{v['burn_threshold']:g} over {v['windows_s'][0]:g}s"
+                f"+{v['windows_s'][1]:g}s</span>"
+                for t, v in tiers.items())
+            + " <a href='/health?json=1'>json</a></p>")
+    body = head
+    alerts = s.get("alerts", [])
+    if alerts:
+        arows = []
+        for a in alerts:
+            ex = a.get("exemplar")
+            arows.append((
+                f"<span class=bad>{_esc(a['tier'].upper())}</span>",
+                _esc(a["objective"]),
+                _esc(time.strftime("%H:%M:%S",
+                                   time.localtime(a.get("since") or 0))),
+                (f"<a href='/traces?trace={_esc(ex)}'>{_esc(ex[:16])}"
+                 f"</a>" if ex else "<span class=dim>-</span>"),
+            ))
+        body += ("<h2>active alerts</h2>"
+                 + _table(("tier", "objective", "since",
+                           "exemplar trace"), arows))
+    else:
+        body += "<p class=ok>no active alerts</p>"
+
+    def _fb(v):
+        return ("<span class=dim>-</span>" if v is None
+                else ("inf" if v == -1.0 else f"{v:g}"))
+    orows = []
+    for o in s.get("objectives", []):
+        page = (o.get("tiers") or {}).get("page", {})
+        warn = (o.get("tiers") or {}).get("warn", {})
+        alert = o.get("alert")
+        st = ("<span class=bad>PAGE</span>" if alert == "page" else
+              "<span class=bad>warn</span>" if alert == "warn" else
+              "<span class=ok>ok</span>")
+        orows.append((
+            st, _esc(o["name"]), _esc(o["kind"]),
+            f"<code>{_esc(o.get('metric'))}</code>",
+            f"{_fb(page.get('burn_short'))} / "
+            f"{_fb(page.get('burn_long'))}",
+            f"{_fb(warn.get('burn_short'))} / "
+            f"{_fb(warn.get('burn_long'))}",
+            _esc(o.get("description") or "-"),
+        ))
+    body += ("<h2>objectives</h2>"
+             "<p class=dim>burn = error-budget consumption rate "
+             "(1.0 sustains the SLO exactly); an alert needs BOTH of "
+             "its tier's windows over threshold. CLI: "
+             "<code>ray-tpu health</code></p>"
+             + _table(("state", "objective", "kind", "metric",
+                       "page burn (short/long)",
+                       "warn burn (short/long)", "description"), orows))
+    srows = []
+    for t in s.get("sentinels", []):
+        srows.append((
+            "<span class=bad>REGRESSION</span>" if t.get("breached")
+            else "<span class=ok>ok</span>",
+            _esc(t["name"]), _esc(t.get("metric")),
+            _esc(t.get("stat")),
+            "-" if t.get("live") is None else f"{t['live']:g}",
+            f"{t.get('baseline', 0):g}",
+            "-" if t.get("ratio") is None else f"{t['ratio']:.2f}x",
+            f"{t.get('tolerance', 0):g}x",
+        ))
+    if srows:
+        body += ("<h2>regression sentinels</h2>"
+                 "<p class=dim>live windows vs the pinned "
+                 "HEALTH_BASELINE.json (seeded from the committed "
+                 "BENCH_* trajectory)</p>"
+                 + _table(("state", "sentinel", "metric", "stat",
+                           "live", "baseline", "ratio", "tolerance"),
+                          srows))
+    # sparklines for the objectives' metrics (history off the head
+    # store; reuses the /history SVG renderer)
+    seen = []
+    for o in s.get("objectives", []):
+        m = o.get("metric")
+        if m and m not in seen:
+            seen.append(m)
+    import asyncio as _aio
+
+    from ray_tpu.util.timeseries import DISPLAY_FIELD
+    queries = await _aio.gather(
+        *[fetch("query_series", name=m, since_s=900.0)
+          for m in seen[:6]], return_exceptions=True)
+    charts = ""
+    for m, q in zip(seen[:6], queries):
+        if isinstance(q, BaseException):
+            continue    # one transient fetch failure skips ONE chart
+        pts = q.get("points") or []
+        field = DISPLAY_FIELD.get(q.get("kind"), "value")
+        vals = [p.get(field) for p in pts]
+        if any(v is not None for v in vals):
+            charts += (f"<h2>{_esc(m)} ({_esc(field)}, 15m)</h2>"
+                       + _spark(vals))
+    if charts:
+        body += charts
+    return _page("health", body)
+
+
 # --- time-series history ----------------------------------------------
 # The reference provisions Prometheus + Grafana for dashboard history
 # (dashboard/modules/metrics/); here a bounded in-process ring sampled
@@ -584,7 +702,7 @@ async def _profile(fetch: Fetch, query: str = "") -> bytes:
 _PAGES = {"/": _overview, "/overview": _overview, "/nodes": _nodes,
           "/actors": _actors, "/jobs": _jobs, "/pgs": _pgs,
           "/serve": _serve, "/tasks": _tasks, "/traces": _traces,
-          "/devices": _devices,
+          "/devices": _devices, "/health": _health,
           "/history": _history, "/profile": _profile}
 
 

@@ -48,9 +48,13 @@ from typing import Deque, Dict, List
 #               spans + per-step bubble spans (dag/runtime.py
 #               pipe_exec_loop) — rendered as pipe:stage<k> timeline
 #               lanes with microbatch flow edges
+#   health      SLO alert / regression-sentinel state transitions
+#               (util/health.py) — firing/resolved instants rendered
+#               on a "health" timeline lane next to the traces that
+#               explain them (exemplar trace ids attached)
 CATEGORIES = ("trace", "collective", "train", "worker", "cgroup",
               "memory", "request", "device", "device_window",
-              "pipeline")
+              "pipeline", "health")
 
 _DEFAULT_CAP = 65536
 # Dedicated sub-budgets: the key also names the bucket. Everything
@@ -73,7 +77,11 @@ _CATEGORY_CAPS: Dict[str, int] = {"collective": 16384, "train": 4096,
                                   # per step: a long pipeline run must
                                   # age against itself, not evict task
                                   # exec or collective spans
-                                  "pipeline": 8192}
+                                  "pipeline": 8192,
+                                  # alert transitions are rare, but a
+                                  # flapping objective must flap
+                                  # against its own budget
+                                  "health": 2048}
 
 _BUFS: Dict[str, Deque[dict]] = {}
 _LOCK = threading.Lock()

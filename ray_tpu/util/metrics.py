@@ -38,6 +38,19 @@ def _fmt_labels(key: Tuple[Tuple[str, str], ...]) -> str:
     return "{" + inner + "}"
 
 
+def _fmt_val(v: float) -> str:
+    """Full-precision sample rendering. %g's 6 significant digits
+    silently drop counter increments past ~1e6 — a worker-pushed
+    serve_requests_total at 1e7 renders '1e+07' before AND after 40
+    more requests, so the head's time-series deltas (and the
+    availability burn rates on them) would read 0. Integral floats
+    render as integers, everything else via repr (shortest exact)."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
 class Metric:
     kind = "untyped"
 
@@ -75,7 +88,8 @@ class Metric:
         with _LOCK:
             items = list(self._values.items())
         for key, v in items:
-            lines.append(f"{self.name}{_fmt_labels(extra + key)} {v:g}")
+            lines.append(
+                f"{self.name}{_fmt_labels(extra + key)} {_fmt_val(v)}")
         return "\n".join(lines)
 
 
@@ -172,7 +186,8 @@ class Histogram(Metric):
                     f"{ex[2]:.3f}") if ex else ""
             lines.append(
                 f"{self.name}_bucket{_fmt_labels(lk)} {cum}{tail}")
-            lines.append(f"{self.name}_sum{_fmt_labels(key)} {total:g}")
+            lines.append(
+                f"{self.name}_sum{_fmt_labels(key)} {_fmt_val(total)}")
             lines.append(f"{self.name}_count{_fmt_labels(key)} {cum}")
         return "\n".join(lines)
 
@@ -294,6 +309,20 @@ def merge_remote(source: str, text: str) -> None:
             del _REMOTE[s]
 
 
+async def push_once(call, source: str,
+                    labels: Optional[dict]) -> bool:
+    """Render-and-push one snapshot (the push_loop body, and the FINAL
+    flush a worker's graceful shutdown performs so a short-lived
+    worker's last counters aren't silently lost from head aggregation
+    — see runtime/worker.py shutdown_worker). Returns True when a
+    snapshot was actually sent."""
+    text = render_labeled(labels)
+    if not text:
+        return False
+    await call("report_metrics", source=source, text=text)
+    return True
+
+
 async def push_loop(call, source: str, labels: Optional[dict],
                     interval_s: float = 5.0) -> None:
     """Periodically push this process's metric samples to the head.
@@ -303,9 +332,7 @@ async def push_loop(call, source: str, labels: Optional[dict],
     while True:
         await asyncio.sleep(interval_s)
         try:
-            text = render_labeled(labels)
-            if text:
-                await call("report_metrics", source=source, text=text)
+            await push_once(call, source, labels)
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -363,6 +390,19 @@ async function tick(){
 }
 tick(); setInterval(tick, 2000);
 </script></body></html>"""
+
+
+def _wants_param(query: Optional[str], name: str) -> bool:
+    """True for an actually-truthy query parameter (?name=1) —
+    substring matching would misroute ?name=0 or params that merely
+    contain the name (?dropexemplars=1)."""
+    from urllib.parse import parse_qs
+    v = parse_qs(query or "").get(name, [""])[0]
+    return v.lower() not in ("", "0", "false", "no")
+
+
+def _wants_json(query: Optional[str]) -> bool:
+    return _wants_param(query, "json")
 
 
 class MetricsServer:
@@ -441,13 +481,34 @@ class MetricsServer:
                 # OpenMetrics, so claiming that content type would
                 # break the scrape we just protected).
                 text = render_all()
-                if "exemplars=1" not in (query or ""):
+                if not _wants_param(query, "exemplars"):
                     text = strip_exemplars(text)
                 body = text.encode()
                 ctype = "text/plain; version=0.0.4"
                 code = "200 OK"
             elif path.startswith("/healthz"):
                 body, ctype, code = b"ok\n", "text/plain", "200 OK"
+            elif path.rstrip("/") == "/health" \
+                    and _wants_json(query):
+                # machine-readable health snapshot (?json=1): the SLO
+                # engine's full state — objectives, burn rates, active
+                # alerts, sentinels, and the per-deployment
+                # ``burn_advice`` map that is the input contract for
+                # SLO-driven replica autoscaling (ROADMAP item 3).
+                # Bare /health (below) renders the human dashboard.
+                import json as _json
+                from ray_tpu.util import health as _health
+                state = None
+                for fetch in _state_fetchers():
+                    try:
+                        state = await fetch("health_state")
+                        break
+                    except Exception:
+                        continue
+                if state is None:    # no agent in this process: local
+                    state = _health.local_state()
+                body = (_json.dumps(state, default=str) + "\n").encode()
+                ctype, code = "application/json", "200 OK"
             elif path.startswith("/raw"):
                 # the original metric-table page, kept at /raw
                 body, ctype, code = _DASH_HTML, "text/html", "200 OK"

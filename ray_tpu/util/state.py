@@ -316,6 +316,25 @@ def list_devices(limit: int = 500) -> List[dict]:
     return devices_from_events(r.get("events", []), limit)
 
 
+def health_state() -> dict:
+    """The head health plane's machine-readable snapshot (objectives,
+    burn rates, active alerts, regression sentinels — util/health.py).
+    Same shape `ray-tpu health --json`, the dashboard /health page,
+    and the /health?json=1 endpoint serve; its ``burn_advice`` map is
+    the autoscaler input contract (ROADMAP item 3)."""
+    return _call("health_state")
+
+
+def query_metric(name: str, since_s: float = 900.0,
+                 labels: Optional[dict] = None) -> dict:
+    """Windowed history for one metric off the head time-series store
+    (`ray-tpu metrics <name> --since 15m` from Python): counters as
+    per-window rates, gauges as mean/min/max, histograms as
+    count-rate + p50/p99 per window."""
+    return _call("query_series", name=name, since_s=float(since_s),
+                 labels=labels)
+
+
 def summarize_collectives(rows: List[dict]) -> List[dict]:
     """Aggregate collective rows per (kind, op, codec): round count,
     mean/max round time, bytes per round, and the modal straggler rank

@@ -347,6 +347,11 @@ _PIPE_SPAN_ARGS = ("stage", "chain", "mb", "kind", "step", "group",
                    "wait_s", "bubble_s", "update_s")
 
 
+_HEALTH_ARGS = ("objective", "tier", "state", "kind", "metric",
+                "burn_short", "burn_long", "deployment", "trace",
+                "sentinel", "stat", "live", "baseline", "tolerance")
+
+
 def to_chrome(evs: List[dict], path: Optional[str] = None,
               clock_offsets: Optional[dict] = None,
               trace_id: Optional[str] = None) -> List[dict]:
@@ -493,6 +498,20 @@ def to_chrome(evs: List[dict], path: Optional[str] = None,
                             "pid": node_pid, "tid": "dev:compile",
                             "args": {k: e[k] for k in _DEVICE_SPAN_ARGS
                                      if e.get(k) is not None}})
+        elif cat == "health":
+            # SLO alert / sentinel transitions (util/health.py) as
+            # instants on a "health" lane — a page-tier firing sits in
+            # the same timeline as the traces that explain it (its
+            # exemplar trace id is in args; `ray-tpu trace <id>` opens
+            # the offending request's waterfall)
+            which = (e.get("objective") or e.get("sentinel") or "?")
+            out.append({"ph": "I", "cat": "health",
+                        "name": f"{e.get('tier', e.get('name', '?'))}:"
+                                f"{which}:{e.get('state', '?')}",
+                        "ts": adj_us(e, e["ts"]), "s": "g",
+                        "pid": node_pid, "tid": "health",
+                        "args": {k: e[k] for k in _HEALTH_ARGS
+                                 if e.get(k) is not None}})
         elif cat == "collective":
             ts_us = adj_us(e, e["ts"])
             dur_us = e.get("dur", 0.0) * 1e6

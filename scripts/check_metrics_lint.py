@@ -10,7 +10,11 @@ after importing every instrumented module):
      ending in ``_size`` (e.g. ``llm_batch_size``);
   3. no duplicate names, including case-insensitive collisions (the
      registry keys by exact name, so ``Foo``/``foo`` could otherwise
-     coexist and split a series).
+     coexist and split a series);
+  4. every metric carries a NON-EMPTY help/description string — the
+     catalog, the /metrics HELP lines, and the health plane's series
+     listing all surface it; an undescribed series is unusable by
+     anyone but its author.
 
 It also lints the EVENT-CATEGORY catalog: every ``events.record(``
 call site in the source tree must use a category enumerated in
@@ -64,6 +68,11 @@ def lint(registry: dict) -> list:
                 f"{name}: case-insensitive duplicate of "
                 f"{seen_lower[low]}")
         seen_lower.setdefault(low, name)
+        desc = getattr(metric, "description", None)
+        if desc is not None and not str(desc).strip():
+            errors.append(
+                f"{name}: empty help/description string (every "
+                f"registered metric must say what it measures)")
     return sorted(errors)
 
 
@@ -97,6 +106,8 @@ def instantiate_all() -> dict:
     take(pipeline.pipeline_metrics())
     from ray_tpu.util import devmon
     take(devmon.devmon_metrics())
+    from ray_tpu.util import health
+    take(health.health_metrics())
     return out
 
 
@@ -158,18 +169,27 @@ def lint_category_caps() -> list:
         if cat not in events.CATEGORIES)
 
 
-# Device-plane metric families: every string literal in the source
+# Lint-scanned metric families: every string literal in the source
 # tree that LOOKS like one of these metric names must actually be
-# registered by instantiate_all() — a devmon/engine call site emitting
-# an unregistered name would silently create a series the catalog,
-# docs, and dashboards don't know about. The scan is literal-based
-# (same spirit as the events.record category grep above); names
-# mentioned in docstrings/backticks don't match, only quoted strings.
+# registered by instantiate_all() — a call site emitting an
+# unregistered name would silently create a series the catalog, docs,
+# and dashboards don't know about. The scan is literal-based (same
+# spirit as the events.record category grep above); names mentioned in
+# docstrings/backticks don't match, only quoted strings. The device
+# families came with the PR 11 devmon plane; ``health_``/``slo_`` are
+# the cluster health plane's (util/health.py).
 DEVICE_METRIC_PREFIXES = ("device_", "xla_", "llm_kv_")
+HEALTH_METRIC_PREFIXES = ("health_", "slo_")
+METRIC_FAMILY_PREFIXES = DEVICE_METRIC_PREFIXES + HEALTH_METRIC_PREFIXES
+
+# prefixed literals that are NOT metric names: control RPC method
+# names etc. (Config knob names are exempted wholesale below — the
+# health plane reads its knobs via quoted getattr calls).
+EXEMPT_METRIC_LITERALS = {"health_state"}
 
 _DEVICE_METRIC_RE = re.compile(
     r"""['"]((?:%s)[a-z0-9_]+)['"]"""
-    % "|".join(re.escape(p) for p in DEVICE_METRIC_PREFIXES))
+    % "|".join(re.escape(p) for p in METRIC_FAMILY_PREFIXES))
 
 
 def scan_device_metric_names(root: str = None) -> list:
@@ -195,19 +215,27 @@ def scan_device_metric_names(root: str = None) -> list:
 
 def lint_device_metric_registration(registry: dict,
                                     found: list = None) -> list:
-    """Violations for device-family metric literals that no registered
-    metric matches (exact name only — a label value like "device"
-    doesn't match the prefixed-name regex in the first place).
-    Registered EVENT CATEGORIES are exempt: "device_window" is a
-    buffer-budget category, not a metric series."""
+    """Violations for family-prefixed metric literals that no
+    registered metric matches (exact name only — a label value like
+    "device" doesn't match the prefixed-name regex in the first
+    place). Registered EVENT CATEGORIES are exempt ("device_window" /
+    "health" are buffer-budget categories, not metric series), as are
+    Config knob names (the health plane reads its knobs via quoted
+    getattr) and the explicit EXEMPT_METRIC_LITERALS (RPC method
+    names)."""
     if found is None:
         found = scan_device_metric_names()
+    from dataclasses import fields as _fields
+
+    from ray_tpu.config import Config
     from ray_tpu.util import events
-    allowed = set(registry) | set(events.CATEGORIES)
+    allowed = (set(registry) | set(events.CATEGORIES)
+               | {f.name for f in _fields(Config)}
+               | EXEMPT_METRIC_LITERALS)
     return sorted(
-        f"{site}: metric literal {name!r} matches a device family "
-        f"({'/'.join(DEVICE_METRIC_PREFIXES)}) but is not registered "
-        f"by instantiate_all()"
+        f"{site}: metric literal {name!r} matches a lint-scanned "
+        f"family ({'/'.join(METRIC_FAMILY_PREFIXES)}) but is not "
+        f"registered by instantiate_all()"
         for site, name in found if name not in allowed)
 
 
@@ -229,6 +257,14 @@ KNOB_FAMILIES = {
     # pipeline parallelism (schedule kind, device-ref transport,
     # activation TTL, step timeout — train/pipeline.py)
     "pipeline": ("pipeline_", ""),
+    # cluster health plane: time-series store retention/memory bounds
+    # + baseline path (util/timeseries.py, util/health.py). The
+    # prefix also covers the head liveness knobs (health_check_*) —
+    # they are Config health surface too and deserve the same
+    # coverage guarantee.
+    "health": ("health_", ""),
+    # SLO engine: burn thresholds, windows, derived-objective knobs
+    "slo": ("slo_", ""),
 }
 
 

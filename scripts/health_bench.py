@@ -1,0 +1,198 @@
+"""Health-plane overhead A/B: serve throughput with the head-side
+time-series store + SLO evaluator on vs RAY_TPU_HEALTH=0.
+
+Method: the TRACE_BENCH recipe — reps INTERLEAVED (off, on, off, on,
+...) so machine drift hits both arms equally; the headline is
+best-of-reps throughput per arm. Each rep is a fresh one-node cluster
++ echo deployment driven closed-loop over the REAL HTTP proxy path: an
+echo handler is the worst case for any per-request accounting (there
+is no model time to hide it behind), and the sustained push/ingest/
+evaluate load is exactly what the store adds at the head.
+
+Arms:
+  off  RAY_TPU_HEALTH=0 — no store, no evaluation loop; pushes keep
+       only the latest snapshot (the pre-PR behavior)
+  on   health plane at a 1s eval interval (tighter than the 10s
+       default, so the bench is an over-estimate of production cost)
+
+Both arms push metrics at a 1s export interval so the push traffic
+itself is identical — the measured delta is store ingest + SLO
+evaluation only. The master switch is read at process import, so each
+(rep, arm) runs in a fresh subprocess.
+
+Run from the repo root: python scripts/health_bench.py
+Commit the aggregate JSON to HEALTH_BENCH.json.
+"""
+
+import argparse
+import http.client
+import json
+import os
+import statistics
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+
+def one_run(requests: int, concurrency: int) -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=max(4, concurrency))
+
+    @serve.deployment(max_ongoing_requests=concurrency)
+    class Echo:
+        async def __call__(self, v=None):
+            return {"ok": True, "n": len(v or {})}
+
+    serve.run(Echo.bind(), name="bench", route_prefix="/bench")
+    addr = serve.proxy_address()
+    body = json.dumps({"k": 1}).encode()
+
+    def post(conn):
+        conn.request("POST", "/bench", body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        r.read()
+        assert r.status == 200, r.status
+
+    warm = http.client.HTTPConnection(addr["host"], addr["port"],
+                                      timeout=30)
+    for _ in range(10):
+        post(warm)
+    warm.close()
+
+    lat = [None] * requests
+    idx = {"v": 0}
+    lock = threading.Lock()
+
+    def worker():
+        conn = http.client.HTTPConnection(addr["host"], addr["port"],
+                                          timeout=30)
+        while True:
+            with lock:
+                i = idx["v"]
+                if i >= requests:
+                    break
+                idx["v"] += 1
+            t0 = time.monotonic()
+            post(conn)
+            lat[i] = time.monotonic() - t0
+        conn.close()
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    lats = sorted(x for x in lat if x is not None)
+    out = {
+        "requests": len(lats),
+        "elapsed_s": round(elapsed, 4),
+        "req_per_s": round(len(lats) / elapsed, 2),
+        "p50_ms": round(statistics.median(lats) * 1e3, 3),
+        "p99_ms": round(lats[int(len(lats) * 0.99) - 1] * 1e3, 3),
+    }
+    # prove the arm did what it claims: the on arm must have a live
+    # store that saw this load; the off arm must report inactive.
+    # (Settle OUTSIDE the timed window: the last export-interval push
+    # and an eval tick must land before we read the tallies.)
+    time.sleep(2.5)
+    from ray_tpu import api
+    ctx = api._require_init()
+    st = api._run(ctx.pool.call(ctx.head_addr, "health_state",
+                                timeout=10.0))
+    out["health_enabled"] = bool(st.get("enabled"))
+    out["health_series"] = int(st.get("series", 0))
+    out["health_points"] = int(st.get("points_total", 0))
+    out["health_evals"] = int(st.get("eval_count", 0))
+    serve.shutdown()
+    ray_tpu.shutdown()
+    return out
+
+
+ARMS = {
+    "off": {"RAY_TPU_HEALTH": "0",
+            "RAY_TPU_METRICS_EXPORT_INTERVAL_S": "1"},
+    "on": {"RAY_TPU_METRICS_EXPORT_INTERVAL_S": "1",
+           "RAY_TPU_SLO_EVAL_INTERVAL_S": "1"},
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=1500)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--one-run", action="store_true",
+                    help="internal: run one arm in THIS process and "
+                         "print its JSON line")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the aggregate JSON here too")
+    args = ap.parse_args()
+    if args.one_run:
+        print("RESULT " + json.dumps(
+            one_run(args.requests, args.concurrency)))
+        return 0
+    results = []
+    for rep in range(args.reps):
+        for arm, env in ARMS.items():       # interleaved: off, on, ...
+            child_env = dict(os.environ)
+            child_env.pop("PYTHONPATH", None)
+            child_env.update(env)
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--one-run", "--requests", str(args.requests),
+                 "--concurrency", str(args.concurrency)],
+                env=child_env, capture_output=True, text=True,
+                timeout=900)
+            line = next((ln for ln in p.stdout.splitlines()
+                         if ln.startswith("RESULT ")), None)
+            if p.returncode != 0 or line is None:
+                print(p.stdout[-2000:], p.stderr[-2000:],
+                      file=sys.stderr)
+                raise RuntimeError(f"run failed: rep={rep} arm={arm}")
+            r = {"arm": arm, "rep": rep, **json.loads(line[7:])}
+            assert r["health_enabled"] == (arm == "on"), r
+            if arm == "on":
+                assert r["health_points"] > 0, \
+                    "on arm's store ingested nothing — bench invalid"
+            print(json.dumps(r))
+            results.append(r)
+    best = {arm: max((r for r in results if r["arm"] == arm),
+                     key=lambda r: r["req_per_s"])
+            for arm in ARMS}
+    agg = {
+        "bench": "health_plane_overhead",
+        "method": "interleaved closed-loop over the HTTP proxy (echo "
+                  "deployment; best rep per arm; on arm at a 1s eval "
+                  "interval — tighter than the 10s default)",
+        "requests_per_rep": args.requests,
+        "concurrency": args.concurrency,
+        "reps": args.reps,
+        "results": results,
+        "best_req_per_s": {a: best[a]["req_per_s"] for a in best},
+        "on_vs_off_throughput": round(
+            best["on"]["req_per_s"] / best["off"]["req_per_s"], 4),
+        "on_vs_off_p50": round(
+            best["on"]["p50_ms"] / best["off"]["p50_ms"], 4),
+        "on_vs_off_p99": round(
+            best["on"]["p99_ms"] / best["off"]["p99_ms"], 4),
+    }
+    print(json.dumps(agg, indent=2))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(agg, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

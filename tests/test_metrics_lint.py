@@ -33,10 +33,17 @@ def test_framework_metrics_pass_lint():
                  "allreduce_hier_inter_bytes_total",
                  "collective_bcast_round_s", "collective_tuner_regime",
                  "allreduce_bucket_overlap_s",
-                 "optim_shard_bytes"):
+                 "optim_shard_bytes",
+                 "serve_requests_total",
+                 "health_series", "health_points_total",
+                 "health_eval_s", "slo_burn_rate",
+                 "slo_alerts_total", "slo_alert_active"):
         assert name in registry, name
     errors = mod.lint(registry)
     assert errors == []
+    # rule 4: every framework metric carries a non-empty description
+    assert all(str(getattr(m, "description", "x")).strip()
+               for m in registry.values())
 
 
 def test_knob_families_fold_into_one_shared_scan():
@@ -44,7 +51,8 @@ def test_knob_families_fold_into_one_shared_scan():
     (lint_knob_tests over KNOB_FAMILIES), not per-family copies; the
     legacy per-family entry points stay as thin wrappers."""
     mod = _load_linter()
-    assert set(mod.KNOB_FAMILIES) >= {"chaos", "tuner", "trace"}
+    assert set(mod.KNOB_FAMILIES) >= {"chaos", "tuner", "trace",
+                                      "health", "slo"}
     assert mod.lint_knob_tests() == []
     # the fold is real: family wrappers and the shared scan agree
     assert mod.lint_knob_tests(families=["tuner"]) \
@@ -102,8 +110,9 @@ def test_lint_flags_violations():
     mod = _load_linter()
 
     class _Fake:
-        def __init__(self, kind):
+        def __init__(self, kind, description="described"):
             self.kind = kind
+            self.description = description
 
     errs = mod.lint({
         "BadName_s": _Fake("counter"),          # not snake_case
@@ -113,10 +122,13 @@ def test_lint_flags_violations():
         "ok_latency_s": _Fake("histogram"),     # ok
         "dup_total": _Fake("counter"),
         "DUP_total": _Fake("counter"),          # case-insensitive dup
+        "undescribed_total": _Fake("counter", ""),  # empty help string
     })
     assert any("BadName_s" in e for e in errs)
     assert any("no_unit" in e for e in errs)
     assert any("duplicate" in e for e in errs)
+    assert any("undescribed_total" in e and "description" in e
+               for e in errs)
     assert not any("queue_depth" in e for e in errs)
     assert not any("batch_size" in e for e in errs)
     assert not any("ok_latency_s" in e for e in errs)

@@ -1,0 +1,65 @@
+"""SIGTERM final-flush e2e: the agent reaps workers with SIGTERM
+(agent._kill_worker -> proc.terminate()), so the graceful-shutdown
+drain (span flush + ONE final metrics push, runtime/worker.py) must
+run on that signal — not only on the shutdown_worker RPC nothing in
+production invokes. The export interval is set far beyond the test's
+lifetime, so the victim's counters can ONLY reach the head through
+the final flush. (Own module: it needs a cluster whose push cadence
+differs from test_zz_health's; late-alphabet name keeps the tier-1
+cutoff stable.)"""
+
+import os
+import signal
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def term_cluster():
+    env = {"RAY_TPU_METRICS_EXPORT_INTERVAL_S": "30"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    import ray_tpu
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+@pytest.mark.slow
+def test_sigterm_drains_final_metrics_snapshot_e2e(term_cluster):
+    import ray_tpu
+    from ray_tpu.util import metrics as M
+
+    @ray_tpu.remote
+    class Bumper:
+        def bump(self):
+            from ray_tpu.util import metrics as m
+            m.Counter("zz_term_flush_total",
+                      "sigterm final-flush e2e").inc(5.0)
+            return os.getpid()
+
+    b = Bumper.remote()
+    pid = ray_tpu.get(b.bump.remote())
+    # nothing has pushed (30s export interval): the head's aggregated
+    # view must not know the counter yet — otherwise the assertion
+    # below would pass without the final flush
+    assert "zz_term_flush_total" not in M.render_all()
+    os.kill(pid, signal.SIGTERM)
+    deadline = time.monotonic() + 10.0
+    text = ""
+    while time.monotonic() < deadline:
+        text = M.render_all()      # driver IS the head (in-process)
+        if "zz_term_flush_total" in text:
+            break
+        time.sleep(0.25)
+    line = next((ln for ln in text.splitlines()
+                 if ln.startswith("zz_term_flush_total")), None)
+    assert line is not None, \
+        "SIGTERM'd worker's final snapshot never reached the head"
+    assert line.endswith(" 5"), line
