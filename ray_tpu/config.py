@@ -244,6 +244,46 @@ class Config:
     devmon_hbm_interval_s: float = 5.0
     devmon_duty_horizon_s: float = 30.0
 
+    # --- durable checkpoint plane (train/ckptio.py) ---
+    # How long the rank-0 commit coordinator waits for every rank's
+    # shard (payload + per-shard meta) of one step to become visible
+    # in storage before abandoning the commit. An abandoned save is
+    # INVISIBLE to restore by construction (no manifest = no
+    # checkpoint) — the previous committed step keeps resolving.
+    ckpt_commit_timeout_s: float = 60.0
+    # Verify each shard's recorded content hash at restore. A corrupt
+    # shard then fails loudly (and the controller's auto-resume falls
+    # back to the previous complete checkpoint) instead of loading
+    # silently-wrong optimizer state. Off trades the sha256 pass for
+    # restore speed on storage you trust end-to-end.
+    ckpt_verify_hash: bool = True
+    # Host staging slots for the async writer's double buffering: the
+    # step path only pays the snapshot copy while a free slot exists;
+    # when the background writer falls this many saves behind, save()
+    # blocks until a slot frees (backpressure, never silent drops).
+    ckpt_stage_buffers: int = 2
+    # Deterministic fault injection for the CHECKPOINT plane, sibling
+    # of testing_channel_failure / testing_serve_failure. Rules
+    # "<site>:<action>:<nth>[:<param>]" (comma-separated): site in
+    # {shard (the per-rank payload write), commit (the manifest
+    # marker write)}; action in {kill (SIGKILL this process — a
+    # deterministic crash mid-save / mid-commit), error, delay
+    # (sleep <param> s), torn (corrupt the write: truncated payload /
+    # truncated manifest reaches the FINAL name, exercising hash and
+    # parse validation)}; nth = 1-based per-site op index counted
+    # process-wide. See train/ckptio.py.
+    testing_ckpt_failure: str = ""
+
+    # --- preemption-aware shutdown (runtime/worker.py + ckptio) ---
+    # Grace window a worker gets on SIGTERM before the exit backstop
+    # fires: preemption hooks run inside it — finish flushing the
+    # in-flight async checkpoint save (+ rank-0 manifest commit),
+    # mirror the ZeRO shard to the ring successor, drain metrics.
+    # TPU preemption delivers SIGTERM with advance notice; this is
+    # how much of that notice the worker spends saving work instead
+    # of dying with it. 0 restores die-now semantics.
+    preempt_grace_s: float = 5.0
+
     # --- cluster health plane (util/timeseries.py + util/health.py) ---
     # Master runtime off-switch for the head-side metrics time-series
     # store + SLO engine (the RAY_TPU_HEALTH env var is the process-

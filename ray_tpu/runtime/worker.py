@@ -777,13 +777,21 @@ async def _amain():
         _head_call, _push_source, _push_labels)
 
     # SIGTERM is how the agent actually reaps workers (_kill_worker
-    # -> proc.terminate()): without this handler the process dies
-    # instantly and neither the span flush nor the final metrics push
-    # ever runs — the graceful-shutdown drain would be dead code on
-    # the production reap path. The drain is bounded (flush timeouts
-    # + a hard daemon-timer backstop), so a dead head can't turn
-    # termination into a hang.
+    # -> proc.terminate()) AND how TPU preemption announces itself:
+    # without this handler the process dies instantly and neither the
+    # span flush nor the final metrics push ever runs — the
+    # graceful-shutdown drain would be dead code on the production
+    # reap path. When the durable checkpoint plane is live in this
+    # process (train/ckptio.py imported — never imported just for
+    # this), the signal FIRST runs the preemption hooks inside a
+    # Config.preempt_grace_s window on a side thread (finish the
+    # in-flight async checkpoint save + rank-0 manifest commit,
+    # mirror the ZeRO shard to the ring successor) and only then the
+    # normal drain; hooks are deadline-bounded and the hard
+    # daemon-timer backstop moves out by exactly the grace, so a
+    # dead head or a wedged hook can't turn termination into a hang.
     import signal as _signal
+    import sys as _sys
     import threading as _threading
     _terming = {"v": False}
 
@@ -791,10 +799,27 @@ async def _amain():
         if _terming["v"]:
             return
         _terming["v"] = True
-        t = _threading.Timer(3.0, os._exit, args=(0,))
+        _ckptio = _sys.modules.get("ray_tpu.train.ckptio")
+        grace = float(getattr(ctx.config, "preempt_grace_s", 0.0)
+                      or 0.0) if _ckptio is not None else 0.0
+        t = _threading.Timer(grace + 3.0, os._exit, args=(0,))
         t.daemon = True
         t.start()
-        asyncio.ensure_future(executor.shutdown_worker())
+        if grace > 0:
+            loop = asyncio.get_running_loop()
+
+            def _drain():
+                try:
+                    _ckptio.fire_preemption(grace)
+                except Exception:   # noqa: BLE001 — exit path
+                    pass
+                loop.call_soon_threadsafe(
+                    lambda: asyncio.ensure_future(
+                        executor.shutdown_worker()))
+            th = _threading.Thread(target=_drain, daemon=True)
+            th.start()
+        else:
+            asyncio.ensure_future(executor.shutdown_worker())
 
     try:
         asyncio.get_running_loop().add_signal_handler(

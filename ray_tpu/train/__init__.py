@@ -14,6 +14,8 @@ from ray_tpu.train.api import (Checkpoint, CheckpointConfig, FailureConfig,
                                get_context, get_dataset_shard, report)
 from ray_tpu.train.boosting import (BoostingConfig, BoostingModel,
                                     BoostingTrainer)
+from ray_tpu.train.ckptio import (AsyncCheckpointer, CkptError,
+                                  preempted, restore as restore_checkpoint)
 from ray_tpu.train.collective import (PeerLostError, allgather_params,
                                       allreduce_gradients,
                                       reduce_scatter_gradients)
@@ -26,14 +28,18 @@ from ray_tpu.train.trainer import (JaxTrainer, SklearnTrainer,
 from ray_tpu.train.zero import ShardedOptimizer
 
 __all__ = [
+    "AsyncCheckpointer",
     "BoostingConfig", "BoostingModel", "BoostingTrainer",
-    "Checkpoint", "CheckpointConfig", "FailureConfig", "PeerLostError",
+    "Checkpoint", "CheckpointConfig", "CkptError",
+    "FailureConfig", "PeerLostError",
     "Pipeline", "PipelineStageActor",
     "Result", "ReshardError",
     "RunConfig", "ScalingConfig", "ShardedOptimizer", "SklearnTrainer",
     "allgather_params", "allreduce_gradients", "await_regroup",
     "bubble_fraction", "compile_schedule",
     "ensure_jax_distributed",
-    "get_context", "get_dataset_shard", "reduce_scatter_gradients",
-    "report", "JaxTrainer", "TorchTrainer", "get_controller",
+    "get_context", "get_dataset_shard", "preempted",
+    "reduce_scatter_gradients",
+    "report", "restore_checkpoint",
+    "JaxTrainer", "TorchTrainer", "get_controller",
 ]

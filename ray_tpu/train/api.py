@@ -130,9 +130,17 @@ class Checkpoint:
     ``path`` is either a local directory or a storage URI
     (memory://..., gs://... — util/storage.py). ``as_directory()``
     always returns a local directory, downloading once per process for
-    remote checkpoints."""
+    remote checkpoints.
+
+    ``managed`` marks a checkpoint the durable checkpoint plane
+    (train/ckptio.py) already persisted and pointer-committed:
+    ``report()`` must register it with the controller WITHOUT
+    re-uploading or re-writing the resume pointer — the plane's
+    two-phase commit already made it durable, and a second pointer
+    write could move the pointer BACKWARD past a newer commit."""
     path: str
     metrics: Dict[str, Any] = field(default_factory=dict)
+    managed: bool = False
 
     # per-PROCESS download memo: a machine-global cache would serve
     # stale content when a reused URI's data changes across runs
@@ -531,7 +539,14 @@ class TrainContext:
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
         self._seq += 1
-        if checkpoint is not None and self._storage_path:
+        if checkpoint is not None and getattr(checkpoint, "managed",
+                                              False):
+            # ckptio-managed checkpoints are ALREADY durable (shards +
+            # manifest + pointer, committed by the plane's two-phase
+            # protocol) — re-persisting here would be wasted bytes at
+            # best and a pointer regression at worst
+            pass
+        elif checkpoint is not None and self._storage_path:
             # Durable BEFORE report() returns: a crash right after report
             # must not lose the checkpoint (reference: report() persists to
             # storage synchronously — train/_internal/storage.py).
@@ -565,18 +580,17 @@ class TrainContext:
                 checkpoint = Checkpoint(path=uri,
                                         metrics=dict(checkpoint.metrics))
             else:
-                os.makedirs(self._storage_path, exist_ok=True)
-                # Per-rank/pid tmp name: ranks share the storage path,
-                # and a shared tmp file would let one rank truncate
-                # another's in-flight write before the atomic rename.
-                tmp = os.path.join(
-                    self._storage_path,
-                    f".latest.tmp.{self.rank}.{os.getpid()}")
-                with open(tmp, "w") as f:
-                    json.dump({"path": checkpoint.path,
-                               "metrics": dict(metrics)}, f)
-                os.replace(tmp, os.path.join(self._storage_path,
-                                             "_latest_checkpoint.json"))
+                # Atomic AND durable (tmp + fsync + rename + dir
+                # fsync, util/storage.py): a crash mid-write must
+                # leave the previous pointer intact, and a crash
+                # right after the rename must not evaporate the new
+                # one — the resume pointer is the restart path's
+                # single source of truth.
+                _st.atomic_write_json(
+                    os.path.join(self._storage_path,
+                                 "_latest_checkpoint.json"),
+                    {"path": checkpoint.path,
+                     "metrics": dict(metrics)})
         self._reports.put({"seq": self._seq, "metrics": dict(metrics),
                            "checkpoint": checkpoint})
 

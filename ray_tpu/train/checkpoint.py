@@ -21,23 +21,30 @@ class CheckpointManager:
         self.config = config
         self._tracked: List[Checkpoint] = []
         self.latest: Optional[Checkpoint] = None
+        # The directory the durable resume pointer
+        # (_latest_checkpoint.json) currently targets: retention must
+        # NEVER delete it — a crash after deletion would leave the
+        # restart path resolving a pointer to rubble. Updated by the
+        # controller whenever a reported checkpoint advanced the
+        # pointer, and by _recover_latest_checkpoint on resume.
+        self.pointer_target: Optional[str] = None
+
+    @staticmethod
+    def _norm(p):
+        from ray_tpu.util import storage as _st
+        if not p:
+            return None
+        return p if _st.is_remote(p) else os.path.abspath(p)
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Dict[str, Any]) -> None:
-        from ray_tpu.util import storage as _st
-
         # Dedup by path: in SPMD training every rank may report the same
         # checkpoint; tracking duplicates would let retention rmtree a
         # still-live directory. Remote URIs compare verbatim, local
         # paths normalized.
-        def norm(p):
-            if not p:
-                return None
-            return p if _st.is_remote(p) else os.path.abspath(p)
-
-        path = norm(checkpoint.path)
+        path = self._norm(checkpoint.path)
         for existing in self._tracked:
-            if path and norm(existing.path) == path:
+            if path and self._norm(existing.path) == path:
                 existing.metrics = dict(metrics)
                 self.latest = existing
                 return
@@ -61,20 +68,43 @@ class CheckpointManager:
         reverse = self.config.checkpoint_score_order == "max"
         return sorted(self._tracked, key=self._score, reverse=reverse)[0]
 
+    def _protected(self, ckpt: Checkpoint) -> bool:
+        """Never a retention victim: the latest checkpoint (the resume
+        candidate) and whatever directory the durable resume pointer
+        currently targets (deleting it would turn the pointer into a
+        dangling reference a crashed controller restarts into)."""
+        if ckpt is self.latest:
+            return True
+        pt = self._norm(self.pointer_target)
+        return pt is not None and self._norm(ckpt.path) == pt
+
     def _enforce_retention(self) -> None:
         keep = self.config.num_to_keep
         if keep is None or len(self._tracked) <= keep:
             return
         reverse = self.config.checkpoint_score_order == "max"
         if self.config.checkpoint_score_attribute is None:
-            victims = self._tracked[:-keep]  # oldest first
+            worst_first = list(self._tracked)       # oldest first
         else:
-            ordered = sorted(self._tracked, key=self._score, reverse=reverse)
-            victims = ordered[keep:]
+            # score order puts the BEST first; victims come off the
+            # tail, so walk it reversed (worst first)
+            worst_first = sorted(self._tracked, key=self._score,
+                                 reverse=reverse)[::-1]
+        # Take exactly len - keep victims from the worst end, SKIPPING
+        # protected entries and replacing each skip with the next-worst
+        # candidate — a protected checkpoint among the victims must not
+        # inflate the tracked set past num_to_keep forever (the old
+        # skip-without-replace overshot by one per protected hit).
+        excess = len(self._tracked) - keep
+        victims = []
+        for v in worst_first:
+            if len(victims) >= excess:
+                break
+            if self._protected(v):
+                continue
+            victims.append(v)
         from ray_tpu.util import storage as _st
         for v in victims:
-            if v is self.latest:
-                continue
             self._tracked.remove(v)
             if not v.path or not self.storage_path:
                 continue

@@ -32,7 +32,9 @@ def train_metrics() -> dict:
 
       train_restarts_total  group recoveries, tagged kind=reshard
                             (in-place N-1 re-form) | restart (teardown
-                            + restore from the latest checkpoint)
+                            + restore from the latest checkpoint) |
+                            preempt (advance-notice preemption —
+                            either flavor, budget-free)
       train_lost_steps      reports lost by the LAST recovery: 0 for a
                             reshard (survivors keep live state),
                             reports-since-last-checkpoint for a restore
@@ -43,8 +45,10 @@ def train_metrics() -> dict:
             "train_restarts_total",
             "Worker-group recoveries performed by the train "
             "controller, tagged kind=reshard (elastic in-place "
-            "re-form at N-1) or kind=restart (full teardown + "
-            "checkpoint restore)",
+            "re-form at N-1), kind=restart (full teardown + "
+            "checkpoint restore), or kind=preempt (advance-notice "
+            "preemption recovery — reshape or restore, without "
+            "consuming the failure budget)",
             tag_keys=("kind",)),
         "lost_steps": m.Gauge(
             "train_lost_steps",
@@ -84,6 +88,20 @@ class _ResizeRequested(Exception):
         self.target = target
 
 
+class _PreemptRestart(Exception):
+    """Internal: every lost rank had ADVANCE preemption notice (its
+    SIGTERM grace window flushed a final checkpoint / mirrored its
+    shard) and no in-place reshape is possible — restart from the
+    latest checkpoint WITHOUT consuming the failure budget.
+    Preemption with notice is scheduled capacity loss, not a fault
+    of the job (run() still guards against a notice loop that never
+    makes progress)."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(f"preemption restart: {cause}")
+        self.cause = cause
+
+
 class TrainController:
     def __init__(self, train_fn: Callable,
                  scaling: ScalingConfig,
@@ -111,6 +129,17 @@ class TrainController:
         # or a train_fn with no await_regroup loop), so the follow-up
         # restart must not consume a second failure-budget unit
         self._reshape_unvalidated = False
+        # ranks that reported preemption notice (SIGTERM grace window
+        # running — train/ckptio.py preempted() off poll()) -> the
+        # monotonic deadline after which the controller recovers
+        # PROACTIVELY instead of waiting out a 60 s poll timeout on a
+        # dying worker
+        self._preempt_notice: Dict[int, float] = {}
+        # True between a budget-free preemption restart and the first
+        # report after it: a SECOND preemption restart with no
+        # progress in between stops being free (a notice loop on a
+        # flapping machine must not restart forever)
+        self._preempt_unvalidated = False
         self._reports_since_ckpt = 0  # the restore path's replay cost
         # last seen peer-checkpoint inventory per CURRENT rank index
         # ({mirrored_rank: step}) — the reshape decision reads it
@@ -227,18 +256,28 @@ class TrainController:
                     f"jax.distributed bootstrap incomplete: {oks}")
 
     def _recover_latest_checkpoint(self):
-        """Restart path: recover the durably-persisted latest checkpoint
-        pointer (written by report() rank 0 before a crash)."""
+        """Restart path: recover the durably-persisted latest
+        checkpoint pointer (written by report() / the ckptio commit
+        coordinator before a crash), speaking BOTH formats: a legacy
+        directory pointer, and a ckptio manifest checkpoint
+        (train/ckptio.py). Tolerant by construction — a corrupt,
+        empty, or missing pointer, or a pointer naming a torn/partial
+        checkpoint, falls back to scanning storage for the newest
+        COMPLETE manifest checkpoint (else a clean start). It never
+        raises for bad checkpoint CONTENT; only an unreachable remote
+        storage backend still surfaces loudly (a transient transport
+        error must not silently restart training from step 0)."""
         import json
         import os
         sp = self.run_config.storage_path
         if not sp:
             return
+        from ray_tpu.train import ckptio
         from ray_tpu.util import storage as _st
+        data = None
         if _st.is_remote(sp):
-            # A transient storage error here must NOT silently restart
-            # training from step 0 — retry, then surface loudly.
             last = None
+            raw = None
             for attempt in range(3):
                 try:
                     st, root = _st.get_storage(sp)
@@ -253,25 +292,56 @@ class TrainController:
                 raise RuntimeError(
                     f"cannot read checkpoint pointer from {sp}: "
                     f"{last}") from last
-            if raw is None:
-                return
-            data = json.loads(raw)
+            if raw is not None:
+                try:
+                    data = json.loads(raw)
+                except Exception:   # noqa: BLE001 — torn pointer
+                    data = None     # fall back to the manifest scan
         else:
             try:
-                p = os.path.join(sp, "_latest_checkpoint.json")
-                if not os.path.exists(p):
-                    return
-                with open(p) as f:
+                with open(os.path.join(
+                        sp, "_latest_checkpoint.json")) as f:
                     data = json.load(f)
-            except Exception:
-                return  # corrupt local pointer: best-effort
+            except Exception:       # noqa: BLE001 — missing/corrupt
+                data = None
         path = data.get("path") if isinstance(data, dict) else None
-        if not isinstance(path, str) or not path:
-            return  # well-formed JSON, wrong shape: skip best-effort
+        resolved = None
+        metrics: dict = {}
+        # deep (re-hash) validation when ckpt_verify_hash: a shard
+        # bit-rotted AFTER commit would otherwise pass the existence
+        # check here, then fail every rank's restore() hash check —
+        # and the restart loop would re-resolve the same corrupt
+        # checkpoint until the failure budget dies, never reaching
+        # the older complete one the scan below would have found
+        from ray_tpu.config import get_config
+        deep = bool(getattr(get_config(), "ckpt_verify_hash", True))
+        if isinstance(path, str) and path:
+            if ckptio.is_manifest_dir(path):
+                if ckptio.validate_checkpoint(path, deep=deep):
+                    resolved = path
+                    metrics = data.get("metrics") or {}
+                # else: pointer names a torn/corrupt manifest
+                # checkpoint — scan below for an older complete one
+                # instead of resuming into a crash loop
+            else:
+                # legacy directory pointer: trusted as before
+                resolved = path
+                metrics = data.get("metrics") or {}
+        if resolved is None:
+            found = ckptio.find_latest_complete(sp, deep=deep)
+            if found is not None:
+                resolved, man = found
+                metrics = dict(
+                    (man.get("user_meta") or {}).get("metrics") or {})
+        if resolved is None:
+            return
         known = {c.path for c in self.ckpt_manager._tracked}
-        if path not in known:
+        if resolved not in known:
             self.ckpt_manager.register(
-                Checkpoint(path=path), data.get("metrics", {}))
+                Checkpoint(path=resolved,
+                           managed=ckptio.is_manifest_dir(resolved)),
+                metrics)
+        self.ckpt_manager.pointer_target = resolved
 
     def _grad_sync_specs(self, group_id: str):
         """Ring channel specs for host-plane gradient sync
@@ -392,6 +462,7 @@ class TrainController:
         self._group_id = group_id
         self._last_mirrors = {}
         self._last_pipeline = {}
+        self._preempt_notice = {}
         sync = self._grad_sync_specs(group_id)
         n = len(self._workers)
         refs = []
@@ -495,6 +566,31 @@ class TrainController:
                 self._teardown_group()
                 resize_to = rr.target
                 continue
+            except _PreemptRestart as pr:
+                # advance-notice preemption with no reshape available:
+                # restart from the latest checkpoint (which includes
+                # any grace-window flush that committed) WITHOUT
+                # spending the failure budget — unless the LAST
+                # recovery was also a preemption restart and nothing
+                # reported since (a flapping machine's notice loop
+                # must not restart for free forever)
+                self._teardown_group()
+                if self._preempt_unvalidated:
+                    self._failures += 1
+                    self._clean_reports = 0
+                    if self._failures > max_failures:
+                        return Result(
+                            metrics=(self.metrics_history[-1]
+                                     if self.metrics_history else {}),
+                            checkpoint=self.ckpt_manager.best(),
+                            metrics_history=list(self.metrics_history),
+                            error=pr.cause)
+                self._preempt_unvalidated = True
+                self._record_recovery(
+                    "preempt", pr.cause,
+                    lost=self._reports_since_ckpt)
+                self._reports_since_ckpt = 0
+                continue
             except (api.RayTpuError, TrainGroupError) as e:
                 # RayTpuError covers actor death, worker crash, task errors
                 # AND placement failures (create_pg raising) — all of them
@@ -551,6 +647,23 @@ class TrainController:
               + (f" flight_recorder={flight}" if flight else "")
               + f": {str(cause)[:200]}")
 
+    def _note_preempted(self, rank: int) -> None:
+        """Record one rank's advance preemption notice (the worker's
+        SIGTERM grace window is running): after grace + margin the
+        controller recovers PROACTIVELY — killing the doomed worker
+        and reshaping/restoring — instead of waiting out a 60 s poll
+        timeout against a process the machine is about to take."""
+        if rank in self._preempt_notice:
+            return
+        from ray_tpu.config import get_config
+        grace = float(getattr(get_config(), "preempt_grace_s", 5.0))
+        self._preempt_notice[rank] = time.monotonic() + grace + 1.0
+        events.record(
+            "train", "preempt_notice", ph="i", ts=time.time(),
+            rank=rank, grace_s=grace, group=self._group_id[:12])
+        print(f"[train] rank {rank} reported preemption notice "
+              f"(grace {grace}s) — will recover proactively")
+
     def _poll_until_done(self, poll_s: float = 0.2):
         pending = set(range(len(self._workers)))
         grow_iv = self.scaling.elastic_grow_interval_s
@@ -580,22 +693,60 @@ class TrainController:
                     self._handle_report(p["rank"], rep)
                 self._last_mirrors[i] = dict(p.get("mirrors") or {})
                 self._last_pipeline[i] = bool(p.get("pipeline"))
+                if p.get("preempted"):
+                    self._note_preempted(i)
                 if p["error"]:
-                    raise api.TaskError(
+                    err = api.TaskError(
                         f"train_fn failed on rank {p['rank']}:\n"
                         f"{p['error']}")
+                    if i in self._preempt_notice:
+                        # a noticed rank's train_fn error (typically
+                        # PeerLostError from a co-preempted peer) is
+                        # part of the same scheduled capacity loss —
+                        # route it through the dead/preempt_only
+                        # accounting below, not the budgeted raise
+                        try:
+                            ray_tpu.kill(self._workers[i])
+                        except Exception:  # noqa: BLE001 — dying
+                            pass
+                        dead.append((i, err))
+                        pending.discard(i)
+                        continue
+                    raise err
                 if p["done"]:
                     pending.discard(i)
+            # proactive preemption recovery: a noticed rank whose
+            # grace window expired is as good as dead — take it down
+            # NOW (its final flush already landed or never will) so
+            # the reshape/restore starts before the OS reaps it
+            dead_ranks = {i for i, _ in dead}
+            for i, dl in sorted(self._preempt_notice.items()):
+                if i in pending and i not in dead_ranks \
+                        and time.monotonic() >= dl:
+                    try:
+                        ray_tpu.kill(self._workers[i])
+                    except Exception:   # noqa: BLE001 — already gone
+                        pass
+                    dead.append((i, api.TaskError(
+                        f"rank {i} preempted (grace window expired)")))
             if dead:
+                # every lost rank had advance notice -> this is
+                # scheduled capacity loss, not a job fault: recover
+                # without consuming the failure budget
+                preempt_only = all(i in self._preempt_notice
+                                   for i, _ in dead)
                 # worker loss: reshape the surviving ranks in place
                 # when the elastic policy allows it, else fall through
                 # to the restart-from-checkpoint path in run()
                 plan = self._plan_reshape(dead, pending)
                 if plan is not None:
-                    pending = self._reshape(plan, dead[0][1])
+                    pending = self._reshape(plan, dead[0][1],
+                                            free=preempt_only)
                     grow_seen = None
                     next_grow_check = time.monotonic() + grow_iv
                     continue
+                if preempt_only:
+                    raise _PreemptRestart(dead[0][1])
                 raise dead[0][1]
             # elastic GROW: capacity that appeared mid-run (autoscaler
             # added a node, another job released one) widens the group.
@@ -664,17 +815,20 @@ class TrainController:
         return {"dead": dead_ranks, "survivors": survivors,
                 "assign": assign}
 
-    def _reshape(self, plan: dict, cause: BaseException):
+    def _reshape(self, plan: dict, cause: BaseException,
+                 free: bool = False):
         """Re-form the ring around the lost worker(s): survivors keep
         their processes and live state, adopt new ranks and a fresh
         incarnation id, and the train_fns reshard ZeRO optimizer
         shards over the new ring (train/reshard.py) — no placement
         group, no actor spawn, no checkpoint read. Consumes one unit
-        of the failure budget like a restart would; raises the cause
+        of the failure budget like a restart would — EXCEPT when
+        ``free`` (every lost rank had advance preemption notice:
+        scheduled capacity loss spends no budget). Raises the cause
         when the budget is exhausted or a rewire fails (the run() loop
         then takes the restart path)."""
         max_failures = self.run_config.failure_config.max_failures
-        if self._failures + 1 > max_failures:
+        if not free and self._failures + 1 > max_failures:
             raise cause             # run() counts + returns the error
         t0 = time.monotonic()
         dead = plan["dead"]
@@ -722,23 +876,35 @@ class TrainController:
                                 if n > 1 else None)}))
         # a rewire RPC failing (another death mid-reshape) propagates
         # as RayTpuError: run() counts it and restarts from checkpoint
-        oks = ray_tpu.get(refs, timeout=120)
-        if not all(oks):
-            # an assigned mirror went missing (or a survivor never
-            # started a train_fn): the restart path is the safe one
-            raise cause
-        self._failures += 1
+        # — EXCEPT a free (preemption) reshape, whose fallback restart
+        # must stay budget-free too (the capacity loss is still
+        # scheduled, whether or not the in-place re-form worked out)
+        try:
+            oks = ray_tpu.get(refs, timeout=120)
+            if not all(oks):
+                # an assigned mirror went missing (or a survivor never
+                # started a train_fn): the restart path is the safe one
+                raise cause
+        except BaseException:
+            if free:
+                raise _PreemptRestart(cause) from None
+            raise
+        if not free:
+            self._failures += 1
         self._clean_reports = 0
         self._reshape_unvalidated = True
+        self._preempt_notice = {}   # old rank indices are now invalid
         self._record_recovery(
-            "reshard", cause, lost=0, dur=time.monotonic() - t0,
-            dead=dead, world=n, old_world=old_n)
+            "preempt" if free else "reshard", cause, lost=0,
+            dur=time.monotonic() - t0,
+            dead=dead, world=n, old_world=old_n, reshard=True)
         return set(range(n))
 
     def _handle_report(self, rank: int, rep: dict):
         # any report proves the (possibly reshaped) incarnation is
         # making progress — later failures are new incidents
         self._reshape_unvalidated = False
+        self._preempt_unvalidated = False
         # Rank 0's metrics are canonical (SPMD: all ranks see the same
         # reduced values). Checkpoints ARE registered from any rank — a
         # distributed save may be reported by whichever rank coordinated it.
@@ -749,6 +915,11 @@ class TrainController:
         if ckpt is not None:
             self.ckpt_manager.register(ckpt, rep["metrics"])
             self._reports_since_ckpt = 0
+            if self.run_config.storage_path:
+                # the report path (or the ckptio commit, for managed
+                # checkpoints) advanced the durable resume pointer to
+                # this directory — retention must not delete it
+                self.ckpt_manager.pointer_target = ckpt.path
         # failure-budget recovery: a sustained clean streak hands the
         # budget back (FailureConfig.reset_after_clean_reports), so a
         # long job with RARE preemptions spends max_failures per

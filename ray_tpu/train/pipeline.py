@@ -356,6 +356,10 @@ class PipelineStageActor:
         self._acc = None
         self._acc_n = 0
         self.step_count = 0
+        # pending optimizer-state restore (pipe_restore before the
+        # first step resolved the per-stage ring): applied lazily the
+        # moment _opt_state materializes
+        self._restore_opt: Optional[dict] = None
 
     # -- wiring ----------------------------------------------------------
 
@@ -457,6 +461,7 @@ class PipelineStageActor:
             if isinstance(opt, ShardedOptimizer):
                 if self._opt_state is None:
                     self._opt_state = opt.init(self.params)
+                    self._apply_opt_restore()
                 self.params, self._opt_state = opt.update(
                     grads, self._opt_state, self.params)
             else:
@@ -477,6 +482,121 @@ class PipelineStageActor:
         self._acc_n = 0
         self.step_count += 1
         return out
+
+    # -- checkpointing (train/ckptio.py pipeline spaces) -----------------
+
+    def pipe_snapshot(self, rank: Optional[int] = None,
+                      world: Optional[int] = None,
+                      full_params: bool = True) -> dict:
+        """One replica's checkpoint shard of THIS stage: the stage
+        params flattened (stage params exist nowhere else — a lost
+        stage is unrecoverable without this), this replica's
+        ZeRO-shard elementwise optimizer leaves + bounds under the
+        per-stage ring's split, and the step counter. Host numpy
+        throughout (the blob crosses the object plane).
+
+        With ``full_params=False`` (replicas j>0 of a driver-side
+        save — replicas are bitwise identical, so one full copy
+        suffices) the blob carries only this replica's owned
+        ``param_seg`` + ``bounds`` under the per-stage split
+        (optimizer bounds when the ring resolved them, else
+        ``shard_bounds(total, world, rank)``) — an R-replica stage
+        then ships ~1 full copy instead of R."""
+        import numpy as np
+
+        from ray_tpu.dag.ring import _flatten
+        from ray_tpu.train.zero import ShardedOptimizer
+        leaves, _, _ = _flatten(self.params)
+        total = int(sum(l.size for l in leaves))
+        wire = ShardedOptimizer._wire_of(leaves)
+        flat = np.empty(total, wire)
+        off = 0
+        for l in leaves:
+            flat[off:off + l.size] = np.asarray(
+                l, dtype=wire).reshape(-1)
+            off += l.size
+        out = {"total": total, "step_count": int(self.step_count),
+               "layout": [(tuple(l.shape), int(l.size), str(l.dtype))
+                          for l in leaves]}
+        opt = self._opt
+        bounds = None
+        if self._opt_state is not None and \
+                isinstance(opt, ShardedOptimizer) and \
+                opt._bounds is not None:
+            lo, hi = opt._bounds
+            bounds = (int(lo), int(hi))
+            sleaves, _, _ = _flatten(self._opt_state)
+            elem, other = [], []
+            for l in sleaves:
+                a = np.asarray(l)
+                if a.ndim >= 1 and a.size == hi - lo:
+                    elem.append(np.array(a.reshape(-1), copy=True))
+                else:
+                    other.append(np.array(a, copy=True))
+            out["opt"] = {"bounds": bounds,
+                          "elem": elem, "other": other}
+        if full_params:
+            out["params_flat"] = flat
+        else:
+            if bounds is None:
+                from ray_tpu.train.reshard import shard_bounds
+                bounds = shard_bounds(total, int(world), int(rank))
+            out["bounds"] = bounds
+            out["param_seg"] = np.ascontiguousarray(
+                flat[bounds[0]:bounds[1]])
+        return out
+
+    def pipe_restore(self, blob: dict) -> bool:
+        """Load a ``pipe_snapshot``-shaped blob back into this stage:
+        params always; optimizer state when the blob carries a shard
+        and this replica's CURRENT bounds can be re-sliced from it
+        (the caller pre-reslices across replica counts via
+        train/ckptio.py — see Pipeline.restore_checkpoint)."""
+        import numpy as np
+
+        from ray_tpu.dag.ring import _flatten, rebuild_from_layout
+        flat = np.asarray(blob["params_flat"]).reshape(-1)
+        leaves, rebuild, _ = _flatten(self.params)
+        if int(sum(l.size for l in leaves)) != flat.size:
+            raise ValueError(
+                f"stage checkpoint has {flat.size} params, stage "
+                f"has {sum(l.size for l in leaves)}")
+        self.params = rebuild_from_layout(flat, {
+            "rebuild": rebuild,
+            "leaves": [(l.shape, l.size, l.dtype) for l in leaves]})
+        self.step_count = int(blob.get("step_count", 0))
+        opt_blob = blob.get("opt")
+        if opt_blob is not None:
+            # stash for lazy application: the optimizer (and its
+            # state template) may not be resolved until the first
+            # pipe_step touches the per-stage ring
+            self._restore_opt = dict(opt_blob)
+            self._apply_opt_restore()
+        return True
+
+    def _apply_opt_restore(self) -> None:
+        if getattr(self, "_restore_opt", None) is None or \
+                self._opt_state is None:
+            return
+        from ray_tpu.train.ckptio import _rebuild_state
+        from ray_tpu.train.zero import ShardedOptimizer
+        opt = self._opt
+        if not isinstance(opt, ShardedOptimizer) or \
+                opt._bounds is None:
+            return
+        blob, self._restore_opt = self._restore_opt, None
+        lo, hi = opt._bounds
+        blo, bhi = blob["bounds"]
+        if (int(blo), int(bhi)) != (int(lo), int(hi)):
+            # the caller should have re-sliced (ckptio.reslice_
+            # segments) before shipping; mismatched bounds here mean
+            # it didn't — params are restored, moments start fresh
+            print(f"[pipeline] stage opt restore skipped: blob "
+                  f"bounds {(blo, bhi)} != ring bounds {(lo, hi)}")
+            return
+        self._opt_state = _rebuild_state(
+            self._opt_state, hi - lo, list(blob["elem"]),
+            list(blob["other"]))
 
     # -- test/debug surface ----------------------------------------------
 
@@ -925,6 +1045,129 @@ class Pipeline:
             self._ctx.pipeline_step = getattr(
                 self._ctx, "pipeline_step", 0) + 1
         return PipelineStepResult(loss, reports)
+
+    # -- durable checkpointing (train/ckptio.py) --------------------------
+
+    def save_checkpoint(self, storage_path: str,
+                        step: Optional[int] = None, *,
+                        metrics: Optional[dict] = None) -> str:
+        """Synchronous driver-side sharded save of the whole pipeline
+        between steps: ONE ckptio manifest with a space per stage
+        (``stage<k>``) — each replica chain contributes its ZeRO
+        optimizer shard, replica 0's snapshot supplies the stage's
+        full parameters (replicas are bitwise identical). The same
+        two-phase commit as the data-parallel plane: shard files +
+        hashes first, the manifest marker last, so a driver crash
+        mid-save leaves the previous checkpoint resolving. Restore
+        re-slices per stage, so a different replica count on resume
+        follows the same path as the ZeRO N'≠N restore."""
+        import numpy as np
+
+        import ray_tpu
+        from ray_tpu.train import ckptio
+        from ray_tpu.train.reshard import shard_bounds
+        if step is None:
+            step = self._steps
+        ckpt = ckptio.ckpt_dirname(step)
+        spaces: Dict[str, dict] = {}
+        for k, row in enumerate(self._actors):
+            # replica 0 ships the full stage params (replicas are
+            # bitwise identical — one copy suffices); j>0 ship only
+            # their owned segment + their optimizer shard, so an
+            # R-replica stage moves ~1 full copy, not R
+            blobs = ray_tpu.get(
+                [h.pipe_snapshot.remote(rank=j, world=len(row),
+                                        full_params=(j == 0))
+                 for j, h in enumerate(row)], timeout=120)
+            metas = []
+            for j, blob in enumerate(blobs):
+                total = int(blob["total"])
+                opt = blob.get("opt")
+                if opt is not None:
+                    lo, hi = (int(b) for b in opt["bounds"])
+                    elem, other = opt["elem"], opt["other"]
+                else:
+                    lo, hi = (int(b) for b in blob["bounds"]) \
+                        if "bounds" in blob \
+                        else shard_bounds(total, len(row), j)
+                    elem, other = [], []
+                if "param_seg" in blob:
+                    seg = np.asarray(blob["param_seg"]).reshape(-1)
+                else:
+                    seg = np.ascontiguousarray(np.asarray(
+                        blob["params_flat"]).reshape(-1)[lo:hi])
+                arrays = {"param_seg": seg}
+                for e, a in enumerate(elem):
+                    arrays[f"elem_{e}"] = a
+                for o, a in enumerate(other):
+                    arrays[f"other_{o}"] = a
+                arrays["_counts"] = np.array(
+                    [len(elem), len(other)], np.int64)
+                metas.append(ckptio.write_shard(
+                    storage_path, ckpt, space=f"stage{k}", rank=j,
+                    world=len(row), bounds=(lo, hi), total=total,
+                    arrays=arrays, step=step))
+            spaces[f"stage{k}"] = {"shards": metas}
+        ckptio.commit_manifest(
+            storage_path, ckpt, step=step, spaces=spaces,
+            group={"kind": "pipeline", "stages": self.num_stages,
+                   "replicas": self.replicas, "group_id": self.group},
+            user_meta={"metrics": dict(metrics or {})})
+        return f"{storage_path.rstrip('/')}/{ckpt}"
+
+    def restore_checkpoint(self, path: str) -> int:
+        """Load a ``save_checkpoint`` manifest back into the wired
+        stage actors, re-slicing each stage's optimizer shards to the
+        CURRENT replica count (``ckptio.reslice_segments`` — the same
+        re-slice the data-parallel restore uses). Returns the
+        restored step."""
+        import numpy as np
+
+        import ray_tpu
+        from ray_tpu.train import ckptio
+        from ray_tpu.train.reshard import shard_bounds
+        man = ckptio.manifest_of(path)
+        if man is None:
+            raise ckptio.CkptError(
+                f"{path} has no committed manifest")
+        from ray_tpu.util import storage as _st
+        st, root = _st.get_storage(path)
+        refs = []
+        for k, row in enumerate(self._actors):
+            sp = man["spaces"].get(f"stage{k}")
+            if sp is None:
+                raise ckptio.CkptError(
+                    f"checkpoint {path} has no space stage{k} "
+                    f"(pipeline shape changed?)")
+            total = int(sp["total"])
+            from ray_tpu.config import get_config
+            verify = bool(getattr(get_config(), "ckpt_verify_hash",
+                                  True))
+            try:
+                # shared assembly protocol (load + hash verify +
+                # consistency + coverage) — one implementation for
+                # the ZeRO restore and the per-stage restore, so the
+                # validation can't drift between them
+                full, elem_pieces, others = ckptio._assemble_space(
+                    st, root, sp, verify)
+            except ckptio.CkptError as e:
+                raise ckptio.CkptError(f"stage{k}: {e}") from e
+            for j, h in enumerate(row):
+                nlo, nhi = shard_bounds(total, len(row), j)
+                blob = {"total": total, "params_flat": full,
+                        "step_count": int(man["step"])}
+                if elem_pieces:
+                    blob["opt"] = {
+                        "bounds": (nlo, nhi),
+                        "elem": [ckptio.reslice_segments(
+                            total, pieces, nlo, nhi,
+                            pieces[0][2].dtype if pieces
+                            else full.dtype)
+                            for pieces in elem_pieces],
+                        "other": list(others or [])}
+                refs.append(h.pipe_restore.remote(blob))
+        ray_tpu.get(refs, timeout=120)
+        return int(man["step"])
 
     def _collect_reports(self, deadline: float) -> List[dict]:
         from ray_tpu.dag.channel import (DATA, ERROR, STOP,

@@ -134,6 +134,7 @@ class TrainWorker:
         peer-checkpoint inventory for the CURRENT incarnation
         ({mirrored_rank: step}) — the controller's reshape decision
         reads it to know which lost segments have a surviving copy."""
+        from ray_tpu.train import ckptio
         reports = self.ctx.drain_reports() if self.ctx else []
         mirrors = {r: int(blob.get("step", 0))
                    for (gid, r), blob in self._mirrors.items()
@@ -141,6 +142,13 @@ class TrainWorker:
         return {"done": self._done.is_set(), "error": self._error,
                 "reports": reports, "rank": self.rank,
                 "mirrors": mirrors,
+                # advance preemption notice: this process received
+                # SIGTERM and is inside its grace window
+                # (runtime/worker.py routes the signal through
+                # ckptio.fire_preemption) — the controller recovers
+                # proactively instead of treating the coming death
+                # as a crash
+                "preempted": ckptio.preempted(),
                 # pipeline-topology flag: the controller's reshape gate
                 # must NOT re-form a ring around a lost pipeline stage
                 # (its parameters exist nowhere else — restart instead)

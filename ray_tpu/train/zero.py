@@ -189,6 +189,13 @@ class ShardedOptimizer:
         self._m = zero_metrics()
         self._step = 0      # collective-span train-step tag (tracing)
         self._bounds: Optional[Tuple[int, int]] = None
+        # last completed step's state (a cheap reference — functional
+        # updates never mutate it): the preemption hook mirrors it to
+        # the ring successor inside the SIGTERM grace window, so a
+        # preempted rank's shard survives in a peer's memory even
+        # when no durable checkpoint flush makes it out in time
+        self._last_state = None
+        self._preempt_hooked = False
 
     # -- group resolution --------------------------------------------------
 
@@ -370,6 +377,8 @@ class ShardedOptimizer:
             "leaves": [(l.shape, l.size, l.dtype) for l in leaves]})
         self._step += 1
         self._bounds = (lo, hi)
+        self._last_state = new_state
+        self._hook_preempt()
         if self.mirror_interval_steps and \
                 self._step % self.mirror_interval_steps == 0:
             self._mirror(new_state)
@@ -500,6 +509,40 @@ class ShardedOptimizer:
         except Exception:   # noqa: BLE001 — mirroring is best-effort
             pass
 
+    # the ONE optimizer instance holding the process's preempt hook:
+    # a worker that hosts several ShardedOptimizers over its lifetime
+    # (re-fit, tuner trials) must not accumulate one hook — and one
+    # pinned full state shard via _last_state — per dead instance
+    _preempt_registered: Optional["ShardedOptimizer"] = None
+
+    def _hook_preempt(self) -> None:
+        """Register the SIGTERM grace-window hook (latest instance
+        wins): a preempted rank mirrors its LAST COMPLETED state shard
+        to the ring successor regardless of the mirror interval
+        cadence — the "at minimum mirror-out its shard" floor of the
+        preemption plane (the durable flush is the ckptio
+        checkpointer's job)."""
+        if self._preempt_hooked or not self.mirror_interval_steps:
+            return
+        from ray_tpu.train import ckptio
+        prev = ShardedOptimizer._preempt_registered
+        if prev is not None and prev is not self:
+            ckptio.remove_preempt_hook(prev._preempt_mirror)
+            prev._preempt_hooked = False
+            prev._last_state = None     # unpin the stale shard
+        ckptio.on_preempt(self._preempt_mirror)
+        ShardedOptimizer._preempt_registered = self
+        self._preempt_hooked = True
+
+    def _preempt_mirror(self, deadline: float) -> None:
+        st = self._last_state
+        if st is None or self._bounds is None:
+            return
+        ctx = self._ctx()
+        if ctx is None or ctx.get_world_size() == 1:
+            return
+        ctx.mirror_shard(self._snapshot(st))
+
     def reshard(self, state):
         """Redistribute this optimizer's state to the CURRENT worker
         group's shard split after an elastic reshape — the in-place
@@ -607,6 +650,7 @@ class ShardedOptimizer:
             mirrors=len(mirrors), staleness_steps=int(staleness),
             pid=os.getpid())
         # re-mirror promptly so the NEW incarnation starts covered
+        self._last_state = new_state
         self._mirror(new_state)
         return new_state
 

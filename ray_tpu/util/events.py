@@ -52,9 +52,12 @@ from typing import Deque, Dict, List
 #               (util/health.py) — firing/resolved instants rendered
 #               on a "health" timeline lane next to the traces that
 #               explain them (exemplar trace ids attached)
+#   ckpt        durable checkpoint plane (train/ckptio.py): manifest
+#               commits, restores, preemption-notice flushes — rare,
+#               but a crash-looping saver must age against itself
 CATEGORIES = ("trace", "collective", "train", "worker", "cgroup",
               "memory", "request", "device", "device_window",
-              "pipeline", "health")
+              "pipeline", "health", "ckpt")
 
 _DEFAULT_CAP = 65536
 # Dedicated sub-budgets: the key also names the bucket. Everything
@@ -81,7 +84,11 @@ _CATEGORY_CAPS: Dict[str, int] = {"collective": 16384, "train": 4096,
                                   # alert transitions are rare, but a
                                   # flapping objective must flap
                                   # against its own budget
-                                  "health": 2048}
+                                  "health": 2048,
+                                  # one commit span per save interval
+                                  # — but a tight-loop saver (bench,
+                                  # chaos) must age against itself
+                                  "ckpt": 2048}
 
 _BUFS: Dict[str, Deque[dict]] = {}
 _LOCK = threading.Lock()

@@ -35,6 +35,47 @@ _REQUEST = 0  # mirrors runtime/rpc.py framing
 _KV_PREFIX = "__storage:"
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a rename into it is durable — on a crash
+    right after os.replace the new directory entry may otherwise never
+    reach disk (POSIX renames are atomic but not durable without it).
+    Best-effort on filesystems that refuse O_DIRECTORY fsync."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Crash-consistent local file write: tmp + flush + fsync +
+    rename + directory fsync. After this returns the file is durably
+    either the OLD content or the NEW content — never a torn mix and
+    never an empty rename that a crash mid-write could leave behind.
+    The write-side half of every commit-marker contract (checkpoint
+    manifests, the ``_latest_checkpoint.json`` resume pointer)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(d)
+
+
+def atomic_write_json(path: str, obj) -> None:
+    import json
+    atomic_write_bytes(path, json.dumps(obj).encode())
+
+
 def parse_uri(uri: str) -> Tuple[Optional[str], str]:
     """("gs", "bucket/x") for "gs://bucket/x"; (None, path) otherwise."""
     if "://" in uri:
@@ -102,11 +143,11 @@ class Storage:
 
 class LocalStorage(Storage):
     def put_bytes(self, path: str, data: bytes) -> None:
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        # atomic AND durable (fsync file + dir): checkpoint shards and
+        # commit markers ride this primitive, and a marker that can
+        # evaporate in a crash right after the rename defeats the
+        # two-phase commit it exists to anchor
+        atomic_write_bytes(path, data)
 
     def get_bytes(self, path: str) -> Optional[bytes]:
         try:

@@ -100,6 +100,8 @@ def instantiate_all() -> dict:
     take(ring.allreduce_metrics())
     from ray_tpu.train import zero
     take(zero.zero_metrics())
+    from ray_tpu.train import ckptio
+    take(ckptio.ckpt_metrics())
     from ray_tpu.train import controller
     take(controller.train_metrics())
     from ray_tpu.train import pipeline
@@ -180,7 +182,11 @@ def lint_category_caps() -> list:
 # the cluster health plane's (util/health.py).
 DEVICE_METRIC_PREFIXES = ("device_", "xla_", "llm_kv_")
 HEALTH_METRIC_PREFIXES = ("health_", "slo_")
-METRIC_FAMILY_PREFIXES = DEVICE_METRIC_PREFIXES + HEALTH_METRIC_PREFIXES
+# ``ckpt_`` came with the durable checkpoint plane (train/ckptio.py).
+CKPT_METRIC_PREFIXES = ("ckpt_",)
+METRIC_FAMILY_PREFIXES = (DEVICE_METRIC_PREFIXES
+                          + HEALTH_METRIC_PREFIXES
+                          + CKPT_METRIC_PREFIXES)
 
 # prefixed literals that are NOT metric names: control RPC method
 # names etc. (Config knob names are exempted wholesale below — the
@@ -265,6 +271,12 @@ KNOB_FAMILIES = {
     "health": ("health_", ""),
     # SLO engine: burn thresholds, windows, derived-objective knobs
     "slo": ("slo_", ""),
+    # durable checkpoint plane: commit coordinator timeout, restore
+    # hash verification, staging double-buffer depth (train/ckptio.py)
+    "ckpt": ("ckpt_", ""),
+    # preemption-aware shutdown: the SIGTERM grace window
+    # (runtime/worker.py + ckptio preemption hooks)
+    "preempt": ("preempt_", ""),
 }
 
 

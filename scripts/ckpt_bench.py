@@ -1,0 +1,243 @@
+"""Durable checkpoint plane benchmark: async sharded save vs the
+sync full-gather step-path save, plus a world-resize restore.
+
+Same 3-worker workload (ring-synced ZeRO-1 adam on a linear-regression
+problem), three checkpointing policies:
+
+  none         no checkpointing — the baseline step time;
+  async        train/ckptio.py AsyncCheckpointer saving EVERY step:
+               the step path pays only the device->host snapshot copy
+               (double-buffered staging), the shard write + rank-0
+               manifest commit ride the background writer;
+  sync_full    the pre-ckptio idiom this plane replaces: every step,
+               the group ring-allgathers the FULL optimizer moments
+               and rank 0 writes params + full state synchronously on
+               the step path (the train/api.py:531-style rank-0 full
+               checkpoint).
+
+Step time is measured from the report stream itself (median
+inter-report gap of rank 0's worker-side timestamps), the
+elastic_bench method. The resize phase then proves the restore
+contract: a 3-rank run checkpoints steps 0..K, a FRESH 2-rank run
+auto-resumes from the committed manifest (controller pointer ->
+manifest -> per-rank re-slice) and finishes the trajectory; max
+relative loss deviation vs an exact local adam reference is reported
+— the ELASTIC_BENCH tolerance bar (~1e-6).
+
+Usage: JAX_PLATFORMS=cpu python scripts/ckpt_bench.py
+Writes CKPT_BENCH.json next to the repo root.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+STEPS, DIM, LR = 14, 300_000, 0.05
+SPLIT_AT = 7            # resize phase: 3 ranks run [0, SPLIT_AT],
+RESIZE_STEPS = 14       # 2 ranks resume (SPLIT_AT, RESIZE_STEPS)
+STEP_SLEEP_S = 0.05     # stands in for device compute per step
+
+
+def _problem(dim=DIM):
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(32, dim)).astype(np.float32)
+    w_true = np.linspace(-1.0, 1.0, dim).astype(np.float32)
+    return X, (X @ w_true).astype(np.float32)
+
+
+def _loss_grad(w, X, y):
+    r = X @ w - y
+    return float(np.mean(r * r)), \
+        ((2.0 / len(y)) * (X.T @ r)).astype(np.float32)
+
+
+def _reference_losses(n):
+    import optax
+    X, y = _problem()
+    opt = optax.adam(LR)
+    w = np.zeros(DIM, np.float32)
+    state = opt.init(w)
+    out = []
+    for _ in range(n):
+        loss, g = _loss_grad(w, X, y)
+        out.append(loss)
+        upd, state = opt.update(g, state, w)
+        w = (w + np.asarray(upd, np.float32)).astype(np.float32)
+    return out
+
+
+def _make_train_fn(mode: str, tmp: str, steps_n: int):
+    problem, loss_grad = _problem, _loss_grad
+    dim, lr, pause = DIM, LR, STEP_SLEEP_S
+
+    def train_fn():
+        import json as _json
+        import os as _os
+        import time as _time
+
+        import numpy as _np
+        import optax
+
+        from ray_tpu import train as _train
+        from ray_tpu.dag.ring import _flatten
+        from ray_tpu.train import ckptio as _ck
+        ctx = _train.get_context()
+        rank = ctx.get_world_rank()
+        X, y = problem()
+        params = {"w": _np.zeros(dim, _np.float32)}
+        opt = _train.ShardedOptimizer(optax.adam(lr))
+        state = opt.init(params)
+        ck = _ck.AsyncCheckpointer() if mode in ("async",
+                                                 "resize") else None
+        start = 0
+        resume = ctx.get_checkpoint()
+        if resume is not None:
+            params, state, last = _ck.restore(
+                params, state, checkpoint=resume)
+            start = last + 1
+        for step in range(start, steps_n):
+            loss, g = loss_grad(params["w"], X, y)
+            params, state = opt.update({"w": g}, state, params)
+            if ck is not None:
+                ck.save(step, params, state, opt)
+            elif mode == "sync_full":
+                # the step-path full-gather save this plane replaces:
+                # every rank blocks on the moment allgathers, rank 0
+                # writes the FULL params + FULL state synchronously
+                ring = ctx.gradient_sync_ring()
+                leaves, _, _ = _flatten(state)
+                fulls = []
+                for leaf in leaves:
+                    a = _np.asarray(leaf)
+                    if a.ndim >= 1 and a.size > 1:
+                        fulls.append(_np.asarray(ring.allgather(
+                            a.reshape(-1), rebuild=False)))
+                    else:
+                        fulls.append(a)
+                if rank == 0:
+                    d = _os.path.join(tmp, f"full_{step}")
+                    _os.makedirs(d, exist_ok=True)
+                    _np.savez(_os.path.join(d, "full.npz"),
+                              w=params["w"],
+                              **{f"s{i}": a
+                                 for i, a in enumerate(fulls)})
+                    with open(_os.path.join(d, "meta.json"),
+                              "w") as f:
+                        _json.dump({"step": step}, f)
+            _train.report({"step": step, "loss": loss,
+                           "ts": _time.time(),
+                           "world": ctx.get_world_size()})
+            _time.sleep(pause)
+        if ck is not None:
+            ck.flush(timeout_s=60)
+            ck.close()
+
+    return train_fn
+
+
+def _run(mode: str, tmp: str, num_workers: int, steps_n: int) -> dict:
+    import ray_tpu
+    from ray_tpu import train
+    from ray_tpu.config import Config
+    from ray_tpu.train.api import RunConfig, ScalingConfig
+    os.makedirs(tmp, exist_ok=True)
+    cfg = Config.from_env(num_workers_prestart=0, max_workers_per_node=8,
+                          default_max_task_retries=0)
+    ray_tpu.init(num_cpus=6, config=cfg)
+    try:
+        storage = tmp if mode in ("async", "resize") else None
+        t0 = time.monotonic()
+        res = train.JaxTrainer(
+            _make_train_fn(mode, tmp, steps_n),
+            scaling_config=ScalingConfig(num_workers=num_workers,
+                                         sync_timeout_s=30.0),
+            run_config=RunConfig(storage_path=storage)).fit()
+        wall = time.monotonic() - t0
+        assert res.error is None, res.error
+        hist = [m for m in res.metrics_history if "step" in m]
+        ts = [m["ts"] for m in hist]
+        gaps = [b - a for a, b in zip(ts, ts[1:])]
+        return {
+            "steps": [m["step"] for m in hist],
+            "losses": [m["loss"] for m in hist],
+            "step_s": round(statistics.median(gaps), 4) if gaps
+            else None,
+            "p90_step_s": round(sorted(gaps)[int(0.9 * len(gaps))], 4)
+            if gaps else None,
+            "total_wall_s": round(wall, 2),
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
+def main() -> int:
+    import tempfile
+    out = {"ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+           "workload": {
+               "params": DIM, "steps": STEPS, "world": 3,
+               "optimizer": "adam via train.ShardedOptimizer (ZeRO-1)",
+               "step_sleep_s": STEP_SLEEP_S,
+               "save_cadence": "every step"}}
+    for mode in ("none", "async", "sync_full"):
+        with tempfile.TemporaryDirectory(
+                prefix=f"ckpt_bench_{mode}_") as tmp:
+            print(f"[ckpt_bench] running {mode} ...", flush=True)
+            r = _run(mode, tmp, num_workers=3, steps_n=STEPS)
+            assert r["steps"] == list(range(STEPS)), r["steps"]
+            out[mode] = {k: v for k, v in r.items()
+                         if k not in ("steps", "losses")}
+            print(f"[ckpt_bench] {mode}: {out[mode]}", flush=True)
+    base = out["none"]["step_s"]
+    out["async"]["overhead_vs_none"] = round(
+        out["async"]["step_s"] / base, 4)
+    out["sync_full"]["overhead_vs_none"] = round(
+        out["sync_full"]["step_s"] / base, 4)
+
+    # resize restore: 3 ranks checkpoint [0, SPLIT_AT], a FRESH 2-rank
+    # job auto-resumes from the committed manifest and finishes
+    with tempfile.TemporaryDirectory(prefix="ckpt_bench_rs_") as tmp:
+        print("[ckpt_bench] running resize restore 3 -> 2 ...",
+              flush=True)
+        a = _run("resize", tmp, num_workers=3, steps_n=SPLIT_AT + 1)
+        b = _run("resize", tmp, num_workers=2, steps_n=RESIZE_STEPS)
+        losses = a["losses"] + b["losses"]
+        steps = a["steps"] + b["steps"]
+        assert steps == list(range(RESIZE_STEPS)), steps
+        ref = _reference_losses(RESIZE_STEPS)
+        dev = max(abs(l - r) / max(abs(r), 1e-12)
+                  for l, r in zip(losses, ref))
+        out["restore_resize"] = {
+            "world": "3 -> 2",
+            "resume_step": SPLIT_AT + 1,
+            "steps": RESIZE_STEPS,
+            "max_rel_loss_dev": float(f"{dev:.3e}"),
+        }
+        print(f"[ckpt_bench] resize: {out['restore_resize']}",
+              flush=True)
+
+    ratio = out["async"]["overhead_vs_none"]
+    out["bar"] = {"async_overhead_max": 1.10,
+                  "async_overhead_ok": bool(ratio <= 1.10)}
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "CKPT_BENCH.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"[ckpt_bench] async {ratio}x vs none (bar 1.10x), "
+          f"sync_full {out['sync_full']['overhead_vs_none']}x, "
+          f"resize dev {out['restore_resize']['max_rel_loss_dev']} "
+          f"-> {path}")
+    return 0 if ratio <= 1.10 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

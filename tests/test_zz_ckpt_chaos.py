@@ -1,0 +1,292 @@
+"""Checkpoint-plane crash consistency under chaos.
+
+Deterministic SIGKILLs at the two dangerous windows — mid-shard-write
+and mid-manifest-commit (Config.testing_ckpt_failure, the checkpoint
+sibling of the channel/serve chaos planes) — must never yield a
+restorable-but-torn checkpoint: the killed save is INVISIBLE and the
+prior complete checkpoint keeps resolving. Kill points run in
+subprocesses (the kill takes the whole process, by design). The
+SIGTERM path extends the PR 13 test_zz_health_term pattern: the
+preemption grace window (Config.preempt_grace_s) must land the final
+watched checkpoint before exit — standalone via
+ckptio.install_sigterm_hook, and end-to-end through a live cluster
+where a whole-group self-preemption commits a grace-window manifest,
+the controller classifies the loss as advance-notice preemption
+(budget-free: max_failures=0 still completes), and the restarted
+group resumes from the flushed step with loss continuity.
+
+Own module (needs subprocesses + its own cluster env); late-alphabet
+name keeps the tier-1 870 s cutoff stable."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_victim(tmp: str, chaos_spec: str) -> subprocess.CompletedProcess:
+    """Subprocess: save step 1 completely, arm chaos, save step 2 —
+    the armed rule SIGKILLs it at the chosen window."""
+    code = textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        from ray_tpu.config import get_config
+        from ray_tpu.train import ckptio
+        tmp = sys.argv[1]
+        params = {{"w": np.arange(50, dtype=np.float32)}}
+        ck = ckptio.AsyncCheckpointer(tmp, rank=0, world=1)
+        ck.save(1, params, block=True)
+        assert ckptio.validate_checkpoint(
+            tmp + "/" + ckptio.ckpt_dirname(1))
+        get_config().testing_ckpt_failure = {chaos_spec!r}
+        ckptio.reset_ckpt_chaos()
+        ck.save(2, params, block=True)
+        print("SURVIVED")
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-c", code, tmp], env=env,
+        capture_output=True, text=True, timeout=120)
+
+
+def _assert_only_step1_restorable(tmp: str):
+    from ray_tpu.train import ckptio
+    ck1 = os.path.join(tmp, ckptio.ckpt_dirname(1))
+    ck2 = os.path.join(tmp, ckptio.ckpt_dirname(2))
+    assert ckptio.validate_checkpoint(ck1, deep=True)
+    # the killed save is INVISIBLE — never a restorable-but-torn mix
+    assert not ckptio.validate_checkpoint(ck2)
+    found = ckptio.find_latest_complete(tmp)
+    assert found is not None and found[0] == ck1
+    params, _, step = ckptio.restore(
+        {"w": np.zeros(50, np.float32)}, None, checkpoint=ck1,
+        bounds=(0, 50))
+    assert step == 1
+    np.testing.assert_array_equal(params["w"],
+                                  np.arange(50, dtype=np.float32))
+    # the controller's auto-resume resolves step 1 too (the pointer
+    # still targets it — it only ever advances AFTER a commit)
+    from ray_tpu.train.api import RunConfig, ScalingConfig
+    from ray_tpu.train.controller import TrainController
+    c = TrainController(lambda: None, ScalingConfig(num_workers=1),
+                        RunConfig(storage_path=tmp))
+    c._recover_latest_checkpoint()
+    assert c.ckpt_manager.latest is not None
+    assert c.ckpt_manager.latest.path == ck1
+
+
+def test_sigkill_mid_shard_write_leaves_no_torn_checkpoint(tmp_path):
+    res = _run_victim(str(tmp_path), "shard:kill:1")
+    assert res.returncode == -signal.SIGKILL, res.stderr
+    assert "SURVIVED" not in res.stdout
+    _assert_only_step1_restorable(str(tmp_path))
+    # the kill fired BEFORE the step-2 payload landed: no manifest,
+    # and whatever shard bytes exist are unreferenced
+    from ray_tpu.train import ckptio
+    ck2 = os.path.join(str(tmp_path), ckptio.ckpt_dirname(2))
+    assert ckptio.manifest_of(ck2) is None
+
+
+def test_sigkill_mid_manifest_commit_leaves_no_torn_checkpoint(tmp_path):
+    # the chaos plane is armed AFTER step 1 committed, so the step-2
+    # commit is the first (nth=1) commit op — killed AFTER the shard
+    # landed but BEFORE the marker rename
+    res = _run_victim(str(tmp_path), "commit:kill:1")
+    assert res.returncode == -signal.SIGKILL, res.stderr
+    tmp = str(tmp_path)
+    from ray_tpu.train import ckptio
+    ck2 = os.path.join(tmp, ckptio.ckpt_dirname(2))
+    # the shard IS there — but without the manifest marker the
+    # checkpoint still does not exist
+    assert os.path.exists(os.path.join(
+        ck2, "zero.shard-00000-of-00001.npz"))
+    assert ckptio.manifest_of(ck2) is None
+    _assert_only_step1_restorable(tmp)
+    # the resume pointer never moved past the complete step
+    with open(os.path.join(tmp, "_latest_checkpoint.json")) as f:
+        assert json.load(f)["step"] == 1
+
+
+def test_sigterm_grace_window_flushes_watched_save(tmp_path):
+    """Standalone SIGTERM path (ckptio.install_sigterm_hook): steps
+    saved with every=K are only WATCHED; the grace window must flush
+    the final watched step durably before the process exits."""
+    code = textwrap.dedent(f"""
+        import sys, time
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        from ray_tpu.train import ckptio
+        tmp = sys.argv[1]
+        ckptio.install_sigterm_hook(grace_s=8.0)
+        ck = ckptio.AsyncCheckpointer(tmp, rank=0, world=1)
+        params = {{"w": np.arange(32, dtype=np.float32) * 3.0}}
+        for step in (1, 2, 3):
+            ck.save(step, params, every=100)     # watch only
+        print("READY", flush=True)
+        time.sleep(60)
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", code, str(tmp_path)],
+                            env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        from ray_tpu.train import ckptio
+        assert ckptio.find_latest_complete(str(tmp_path)) is None
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        proc.kill()
+    from ray_tpu.train import ckptio
+    found = ckptio.find_latest_complete(str(tmp_path))
+    assert found is not None, "grace-window save never landed"
+    path, man = found
+    assert man["step"] == 3
+    assert ckptio.validate_checkpoint(path, deep=True)
+    params, _, step = ckptio.restore(
+        {"w": np.zeros(32, np.float32)}, None, checkpoint=path,
+        bounds=(0, 32))
+    np.testing.assert_array_equal(
+        params["w"], np.arange(32, dtype=np.float32) * 3.0)
+
+
+# -- cluster e2e: whole-group preemption -> grace flush -> free resume ----
+
+STEPS, DIE_AT, DIM, LR = 12, 5, 12, 0.05
+TOL = dict(rtol=2e-3, atol=1e-4)
+
+
+def _problem():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(32, DIM)).astype(np.float32)
+    w_true = np.linspace(-1.0, 1.0, DIM).astype(np.float32)
+    return X, (X @ w_true).astype(np.float32)
+
+
+def _loss_grad(w, X, y):
+    r = X @ w - y
+    return float(np.mean(r * r)), \
+        ((2.0 / len(y)) * (X.T @ r)).astype(np.float32)
+
+
+def _reference_losses():
+    import optax
+    X, y = _problem()
+    opt = optax.adam(LR)
+    w = np.zeros(DIM, np.float32)
+    state = opt.init(w)
+    losses = []
+    for _ in range(STEPS):
+        loss, g = _loss_grad(w, X, y)
+        losses.append(loss)
+        upd, state = opt.update(g, state, w)
+        w = (w + np.asarray(upd, np.float32)).astype(np.float32)
+    return losses
+
+
+@pytest.fixture
+def preempt_cluster():
+    import ray_tpu
+    from ray_tpu.config import Config
+    env = {"RAY_TPU_PREEMPT_GRACE_S": "8"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    cfg = Config.from_env(num_workers_prestart=0, max_workers_per_node=8,
+                          default_max_task_retries=0)
+    ray_tpu.init(num_cpus=6, config=cfg)
+    yield
+    ray_tpu.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+@pytest.mark.slow
+def test_whole_group_preemption_resumes_from_grace_checkpoint(
+        preempt_cluster, tmp_path):
+    """Whole-pod preemption, the routine TPU failure: every rank
+    SIGTERMs at the same step. The grace window must (a) flush the
+    watched final checkpoint — both shards + rank-0 manifest — and
+    (b) surface preemption notice to the controller, whose restart is
+    then BUDGET-FREE: with max_failures=0 the job still completes,
+    resuming from the grace-window step with loss continuity."""
+    from ray_tpu import train
+    from ray_tpu.train.api import (FailureConfig, RunConfig,
+                                   ScalingConfig)
+    tmp = str(tmp_path)
+    problem, loss_grad = _problem, _loss_grad
+    steps_n, die_at, dim, lr = STEPS, DIE_AT, DIM, LR
+
+    def train_fn():
+        import os as _os
+        import signal as _signal
+        import time as _time
+
+        import numpy as _np
+        import optax
+
+        from ray_tpu import train as _train
+        from ray_tpu.train import ckptio as _ck
+        ctx = _train.get_context()
+        X, y = problem()
+        params = {"w": _np.zeros(dim, _np.float32)}
+        opt = _train.ShardedOptimizer(optax.adam(lr))
+        state = opt.init(params)
+        ck = _ck.AsyncCheckpointer()
+        start = 0
+        resume = ctx.get_checkpoint()
+        if resume is not None:
+            params, state, last = _ck.restore(
+                params, state, checkpoint=resume)
+            start = last + 1
+        else:
+            # dwell so the controller's 0.2 s poll observes the
+            # preemption notice before this process exits (stands in
+            # for a realistically slow multi-GB flush)
+            _ck.on_preempt(lambda dl: _time.sleep(1.5))
+        for step in range(start, steps_n):
+            loss, g = loss_grad(params["w"], X, y)
+            params, state = opt.update({"w": g}, state, params)
+            # every=1000: every step is WATCHED, none saved — only
+            # the grace-window flush can make one durable
+            ck.save(step, params, state, opt, every=1000)
+            _train.report({"step": step, "loss": loss,
+                           "world": ctx.get_world_size()})
+            if step == die_at and resume is None:
+                _time.sleep(0.6)          # let the report land
+                _os.kill(_os.getpid(), _signal.SIGTERM)
+                _time.sleep(60)           # die inside the drain
+            _time.sleep(0.15)
+
+    res = train.JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2, sync_timeout_s=8.0),
+        run_config=RunConfig(
+            storage_path=tmp,
+            failure_config=FailureConfig(max_failures=0))).fit()
+    assert res.error is None, res.error
+    hist = [m for m in res.metrics_history if "step" in m]
+    steps = [m["step"] for m in hist]
+    # continuity: the grace flush captured step DIE_AT, so the resumed
+    # incarnation starts at DIE_AT+1 — nothing replayed, nothing lost
+    assert steps == list(range(STEPS)), steps
+    np.testing.assert_allclose(
+        [m["loss"] for m in hist], _reference_losses(), **TOL)
+    # the grace-window manifest is the one the resume used
+    from ray_tpu.train import ckptio
+    path = os.path.join(tmp, ckptio.ckpt_dirname(DIE_AT))
+    assert ckptio.validate_checkpoint(path, deep=True)
+    man = ckptio.manifest_of(path)
+    assert man["spaces"]["zero"]["world"] == 2
