@@ -135,6 +135,25 @@ class Config:
     # See dag/channel.py ChannelChaos.
     testing_channel_failure: str = ""
 
+    # --- paged KV cache (llm/kvcache.py) ---
+    # Token-block size of the engine's paged KV cache. The serving
+    # default: fixed-size blocks from a preallocated pool, per-request
+    # block tables, ref-counted prefix reuse for shared system
+    # prompts. 0 selects the legacy monolithic slot cache (bucketed
+    # doubling growth); tensor-parallel engines always use the
+    # monolithic cache. The effective size is gcd-adjusted to divide
+    # every prefill bucket and max_len.
+    kvcache_block_size: int = 16
+    # Pool size in blocks (0 = auto: worst case — every slot at
+    # max_len — plus one chain of prefix-cache headroom, capped at
+    # half the free HBM when the devmon gauges know it).
+    kvcache_pool_blocks: int = 0
+    # Prefix reuse: hash-chained full prompt blocks enter a cached
+    # index at request finish; a later request sharing the prefix
+    # adopts those blocks ref-counted and prefills only its suffix.
+    # Off: blocks free immediately at request finish.
+    kvcache_prefix_cache: bool = True
+
     # --- serve fault tolerance ---
     # Default per-request deadline budget (seconds) when the client
     # sends no X-Request-Deadline header. The budget is spent across
@@ -166,6 +185,28 @@ class Config:
     # finishes its in-flight requests (incl. streams) and accepts no
     # new ones; after this many seconds the controller stops waiting.
     serve_drain_timeout_s: float = 30.0
+    # --- SLO-driven replica autoscaling (serve/autoscale.py) ---
+    # A deployment opts in with autoscaling_config={"policy": "slo",
+    # ...}; the controller then scales it from the health plane's
+    # burn_advice (page-tier burn -> scale up; the proxy's
+    # shed-while-burning hint is the fast path) instead of the legacy
+    # target_ongoing_requests loop. Seconds between burn-advice
+    # fetches / per-deployment decision ticks:
+    serve_autoscale_interval_s: float = 2.0
+    # Minimum seconds between two scale changes of one deployment
+    # (hysteresis: a flapping alert cannot thrash replica counts).
+    serve_autoscale_cooldown_s: float = 15.0
+    # Replicas added per scale-up decision.
+    serve_autoscale_step: int = 1
+    # Utilization deadband: below low (sustained for the window, and
+    # only while no budget is burning) scale down one replica — the
+    # victim DRAINS, in-flight streams finish; above high with a
+    # warn-tier burn, scale up before the page tier fires. Between
+    # the thresholds the target holds.
+    serve_autoscale_low_util: float = 0.25
+    serve_autoscale_low_util_window_s: float = 30.0
+    serve_autoscale_high_util: float = 0.85
+
     # Deterministic fault injection for the SERVE data path, the
     # serving sibling of testing_rpc_failure / testing_channel_failure
     # (reference: src/ray/rpc/rpc_chaos.h + serve.proto health checks).

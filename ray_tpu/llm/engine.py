@@ -20,13 +20,15 @@ device steps run on an executor thread to keep the event loop live.
 from __future__ import annotations
 
 import asyncio
+import math
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from ray_tpu.llm import model as lm
+from ray_tpu.llm import kvcache, model as lm
 from ray_tpu.models.llama import LlamaConfig
 from ray_tpu.util import devmon, tracing
 
@@ -126,6 +128,15 @@ class _Request:
     # KV computed by a remote prefill engine (disaggregated serving):
     # {"k","v": (layers, bucket, kvh, hd) numpy, "logits": (vocab,)}
     prefilled: Optional[dict] = None
+    # paged-KV state (engine paged mode): engine-unique sequence id,
+    # the block allocation handed out at admission, and the prompt
+    # tokens served from cached prefix blocks (stamped on the
+    # terminal trace span and surfaced in the result)
+    seq: int = 0
+    kv_alloc: Optional[dict] = None
+    prefix_hit: int = 0
+    kv_written: bool = False    # prefill scatter reached the pool
+    handoff_bytes: int = 0      # disaggregated KV shipped for this req
 
 
 class LLMEngine:
@@ -135,6 +146,9 @@ class LLMEngine:
                  cache_dtype="bfloat16", seed: int = 0,
                  steps_per_sync: int = 8,
                  mesh=None, tensor_axis: str = "tensor",
+                 kv_block_size: Optional[int] = None,
+                 kv_pool_blocks: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
                  detokenize: Optional[Callable[[List[int]], str]] = None):
         """With ``mesh``, the engine runs TENSOR-PARALLEL: params shard
         per lm.serve_param_specs (Megatron layout), the KV cache shards
@@ -169,16 +183,59 @@ class LLMEngine:
         self.buckets = tuple(sorted(b for b in prefill_buckets
                                     if b <= max_len)) or (max_len,)
         self.detokenize = detokenize
-        # Bucketed KV growth (the dense-cache answer to paged KV —
-        # reference capability: vLLM's paged cache bounds HBM by live
-        # tokens): the cache starts at a small length and DOUBLES, up
-        # to max_len, only when an admitted request actually needs the
-        # room — max_len=8k costs 8k-sized HBM only once an 8k request
-        # arrives, and each growth step is one bounded recompile.
-        self._cache_len = min(max_len, max(1024, self.buckets[-1]))
-        self._cache = lm.init_cache(cfg, max_slots, self._cache_len,
-                                    dtype=jnp.dtype(cache_dtype),
-                                    mesh=mesh, axis=tensor_axis)
+        # Paged KV (llm/kvcache.py) is the default serving cache:
+        # fixed-size token blocks from a preallocated pool, per-request
+        # block tables, and prefix reuse for shared system prompts.
+        # kv_block_size=0 selects the legacy MONOLITHIC cache (bucketed
+        # doubling growth); tensor-parallel engines always use it (the
+        # paged gather/scatter is not yet shard_map'd over the mesh).
+        # None reads the Config knobs (kvcache_block_size etc.).
+        from ray_tpu.config import get_config
+        _cfg = get_config()
+        if kv_block_size is None:
+            kv_block_size = int(getattr(_cfg, "kvcache_block_size", 16))
+        if kv_pool_blocks is None:
+            kv_pool_blocks = int(getattr(_cfg, "kvcache_pool_blocks", 0))
+        if prefix_cache is None:
+            prefix_cache = bool(getattr(_cfg, "kvcache_prefix_cache",
+                                        True))
+        self._paged = kv_block_size > 0 and mesh is None
+        self._kvm = kvcache.kvcache_metrics()
+        if self._paged:
+            # effective block size must divide every prefill bucket
+            # and max_len (prefill writes land block-aligned): shrink
+            # to the gcd instead of erroring on small test buckets
+            b = kv_block_size
+            for v in (*self.buckets, max_len):
+                b = math.gcd(b, v)
+            self._block = max(1, b)
+            self._table_w = max_len // self._block
+            per_tok = (cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+                       * 2 * jnp.dtype(cache_dtype).itemsize)
+            nb = kvcache.auto_pool_blocks(
+                max_slots, self._table_w, per_tok * self._block,
+                kv_pool_blocks)
+            self._cache_len = max_len     # no growth: tables span it
+            self._pool = kvcache.init_pool(cfg, nb, self._block,
+                                           jnp.dtype(cache_dtype))
+            self._kv = kvcache.KVBlockManager(
+                nb, self._block, table_width=self._table_w,
+                prefix_cache=prefix_cache, metrics=self._kvm)
+            self._tables = np.full((max_slots, self._table_w),
+                                   kvcache.TRASH, np.int32)
+            self._blocked: deque = deque()   # admits parked on pool
+            self._seq_counter = 0
+            self._cache = None
+        else:
+            # Bucketed KV growth (the dense-cache fallback): the cache
+            # starts at a small length and DOUBLES, up to max_len, only
+            # when an admitted request actually needs the room —
+            # max_len=8k costs 8k-sized HBM only once an 8k request
+            # arrives, and each growth step is one bounded recompile.
+            self._cache_len = min(max_len, max(1024, self.buckets[-1]))
+            self._cache = lm.init_cache(cfg, max_slots, self._cache_len,
+                                        dtype=jnp.dtype(cache_dtype),
+                                        mesh=mesh, axis=tensor_axis)
         self._slots: List[Optional[_Request]] = [None] * max_slots
         self._waiting: "asyncio.Queue[_Request]" = asyncio.Queue()
         self._rng = np.random.default_rng(seed)
@@ -204,25 +261,43 @@ class LLMEngine:
     def stats(self) -> dict:
         """Scalar engine counters (the per-phase distributions live in
         the metrics registry — see engine_metrics())."""
-        return {"requests": self._requests,
-                "tokens_generated": self._tokens_generated,
-                "ttft_sum": self._ttft_sum,
-                "ttft_count": self._ttft_count,
-                "cache_len": self._cache_len}
+        out = {"requests": self._requests,
+               "tokens_generated": self._tokens_generated,
+               "ttft_sum": self._ttft_sum,
+               "ttft_count": self._ttft_count,
+               "cache_len": self._cache_len,
+               "paged": self._paged}
+        if self._paged:
+            out.update(block_size=self._block,
+                       blocks_used=self._kv.used_blocks(),
+                       blocks_cached=self._kv.cached_blocks(),
+                       blocks_free=self._kv.free_blocks(),
+                       prefix_hit_tokens=self._kv.hit_tokens_total)
+        return out
 
     def _kv_per_token_bytes(self) -> float:
         """Device bytes one KV position of one slot costs (both k and
         v, all layers) — the unit request-level HBM attribution is
         priced in."""
+        if self._paged:
+            return kvcache.pool_block_bytes(self._pool) / self._block
         n = self._cache["k"].nbytes + self._cache["v"].nbytes
         return n / float(self.max_slots * self._cache_len)
 
     def _kv_account(self) -> None:
-        """Publish the engine's explicit KV HBM attribution: live cache
-        bytes + the growth headroom still unspent before max_len
-        capacity. Called at init and after every bucketed growth; the
+        """Publish the engine's explicit KV HBM attribution. Paged:
+        live bytes = blocks referenced by live requests plus resident
+        prefix-cache blocks (the pool bounds HBM by LIVE tokens, the
+        vLLM property); headroom = free blocks. Monolithic: cache
+        bytes + the bucketed growth left before max_len capacity. The
         gauges ride the worker's metrics push to the head next to
         util/devmon.py's device_hbm_* series."""
+        if self._paged:
+            bb = kvcache.pool_block_bytes(self._pool)
+            live = self._kv.used_blocks() + self._kv.cached_blocks()
+            self._m["kv_bytes"].set(bb * live)
+            self._m["kv_headroom"].set(bb * self._kv.free_blocks())
+            return
         cur = self._cache["k"].nbytes + self._cache["v"].nbytes
         per_tok = self._kv_per_token_bytes()
         headroom = per_tok * self.max_slots \
@@ -361,6 +436,9 @@ class LLMEngine:
                      top_p=float(top_p), top_k=int(top_k), stop=stop,
                      prefilled=prefilled, deadline_ts=deadline_ts,
                      trace=tracing.current_context())
+        if self._paged:
+            self._seq_counter += 1
+            r.seq = self._seq_counter
         self._waiting.put_nowait(r)
         self._requests += 1
         self._ensure_loop()
@@ -369,6 +447,8 @@ class LLMEngine:
     def _result(self, r: _Request) -> dict:
         out = {"tokens": r.out,
                "ttft_s": (r.first_token_at or 0) - r.submitted}
+        if self._paged:
+            out["prefix_hit_tokens"] = r.prefix_hit
         if self.detokenize is not None:
             out["text"] = self.detokenize(r.out)
         return out
@@ -392,6 +472,26 @@ class LLMEngine:
     def _bucket_for(self, n: int) -> int:
         return lm.bucket_for(self.buckets, n)
 
+    def _pop_candidate(self) -> Optional[_Request]:
+        """Next admissible request: pool-parked admits first (FIFO —
+        paged mode re-tries them once blocks free up), then the
+        waiting queue. Deadline-expired candidates fail fast here."""
+        while self._paged and self._blocked:
+            cand = self._blocked.popleft()
+            if cand.deadline_ts is not None and \
+                    time.time() > cand.deadline_ts:
+                self._expire(cand, None)
+                continue
+            return cand
+        while not self._waiting.empty():
+            cand = self._waiting.get_nowait()
+            if cand.deadline_ts is not None and \
+                    time.time() > cand.deadline_ts:
+                self._expire(cand, None)
+                continue
+            return cand
+        return None
+
     async def _run(self):
         loop = asyncio.get_running_loop()
         try:
@@ -404,17 +504,32 @@ class LLMEngine:
                 for slot in range(self.max_slots):
                     if self._slots[slot] is not None:
                         continue
-                    r = None
-                    while not self._waiting.empty():
-                        cand = self._waiting.get_nowait()
-                        if cand.deadline_ts is not None and \
-                                time.time() > cand.deadline_ts:
-                            self._expire(cand, None)
-                            continue
-                        r = cand
-                        break
+                    r = self._pop_candidate()
                     if r is None:
                         continue
+                    if self._paged and r.kv_alloc is None:
+                        # full-horizon block reservation at admission:
+                        # decode can then never fail mid-flight on pool
+                        # pressure — overload parks the ADMIT instead
+                        # (FIFO; a parked head-of-line also blocks the
+                        # queue behind it, preserving arrival order)
+                        try:
+                            alloc = self._kv.alloc_seq(
+                                r.seq, r.tokens, r.max_new_tokens)
+                        except kvcache.BlockPoolExhausted as e:
+                            self._fail(r, None, e)
+                            continue
+                        if alloc is None:
+                            self._blocked.appendleft(r)
+                            break
+                        r.kv_alloc = alloc
+                        r.prefix_hit = alloc["hit_tokens"]
+                        # publish live-bytes/headroom NOW: a wave of
+                        # long decodes would otherwise report
+                        # init-time gauges until the first finish —
+                        # exactly the overload window the gauges
+                        # exist for
+                        self._kv_account()
                     try:
                         tok = await loop.run_in_executor(
                             None, self._admit_sync, slot, r)
@@ -422,9 +537,20 @@ class LLMEngine:
                         # a dead/freed remote KV handle fails ITS request
                         # only — the shared loop and other slots live on
                         # (resolution happens before any cache write, so
-                        # no partial state was left behind)
-                        self._fail(r, None, e)
+                        # no partial state was left behind; the slot is
+                        # passed so a paged table row set before the
+                        # failure reverts to trash with the blocks)
+                        self._fail(r, slot, e)
                         continue
+                    except BaseException as e:  # noqa: BLE001
+                        # any other admit failure kills the loop below —
+                        # but the candidate is in no queue and no slot
+                        # yet, so the outer sweep can't see it: fail it
+                        # HERE or its caller hangs forever on a future
+                        # nobody owns (the old behavior: a broken
+                        # prefill path turned into a silent stall)
+                        self._fail(r, slot, e)
+                        raise
                     self._emit_token(r, tok, slot)
                 # deadline-cancel active slots at the block boundary:
                 # the slot is reclaimed NOW (the next admit pass refills
@@ -438,6 +564,13 @@ class LLMEngine:
                 active = [i for i, r in enumerate(self._slots)
                           if r is not None]
                 if not active:
+                    if self._paged and self._blocked:
+                        # pool-parked admits with nothing running can
+                        # only be waiting on eviction — re-try shortly
+                        # instead of parking on the (possibly empty)
+                        # waiting queue forever
+                        await asyncio.sleep(0.01)
+                        continue
                     if self._waiting.empty():
                         # idle: park until work arrives
                         r = await self._waiting.get()
@@ -519,6 +652,8 @@ class LLMEngine:
             for i, r in enumerate(self._slots):
                 if r is not None:
                     self._fail(r, i, e)
+            while self._paged and self._blocked:
+                self._fail(self._blocked.popleft(), None, e)
             while not self._waiting.empty():
                 self._fail(self._waiting.get_nowait(), None, e)
             raise
@@ -542,11 +677,32 @@ class LLMEngine:
         finally:
             tracing.reset_request_context(tok)
 
+    @staticmethod
+    def _take_handoff(x):
+        """Unwrap the device-path KV handoff (reference: RDT
+        tensor_transport_manager.py:37): same-process resolution
+        never leaves HBM; cross-process is one fetch + device_put;
+        the handle is single-use (freed here — the prefill replica's
+        copy dies at handoff instead of surviving next to the decode
+        copy). A dead handle becomes a per-request KVHandoffError.
+        Plain arrays pass through for the host-staged path."""
+        from ray_tpu.runtime.device_store import TensorRef
+        if not isinstance(x, TensorRef):
+            return x
+        try:
+            arr = x.resolve()
+        except Exception as e:
+            raise KVHandoffError(
+                f"prefilled KV handle unresolvable: {e}") from e
+        x.free()                # cache write below copies it
+        return arr
+
     def _admit_impl(self, slot: int, r: _Request) -> int:
-        """Prefill (executor thread): pad to bucket, fill cache slot.
-        Returns the first sampled token. Remotely-prefilled requests
-        skip the forward pass: their shipped KV is written straight
-        into the slot."""
+        """Prefill (executor thread): pad to bucket, fill cache slot
+        (monolithic) or scatter into the request's block table
+        (paged). Returns the first sampled token. Remotely-prefilled
+        requests skip the forward pass: their shipped KV is written
+        straight into the slot."""
         jax, jnp = _jx()
         n = len(r.tokens)
         r.admitted_at = time.monotonic()
@@ -557,40 +713,42 @@ class LLMEngine:
                 "engine", "queue", r.trace, r.trace.span_id,
                 r.t_submit_wall,
                 r.t_submit_wall + (r.admitted_at - r.submitted))
+        if self._paged:
+            return self._admit_paged(slot, r)
         # Bucketed growth runs HERE (executor thread): padding and
         # re-uploading a multi-GB cache on the event loop would stall
         # every in-flight stream. Admits and decode blocks are awaited
         # one at a time by the loop, so cache mutation stays serialized.
         need = n + r.max_new_tokens
+        pad_to = 0
         if r.prefilled is not None:
-            need = max(need, int(r.prefilled["k"].shape[1]))
+            # pd.py ships BLOCK-granular KV (transfer scales with the
+            # prompt); re-pad to a bucket multiple here so the donated
+            # write_prefill_to_cache keeps bucket-bounded compile
+            # variants instead of one per distinct block count
+            L = int(r.prefilled["k"].shape[1])
+            big = self.buckets[-1]
+            pad_to = (lm.bucket_for(self.buckets, L) if L <= big
+                      else -(-L // big) * big)
+            pad_to = min(pad_to, self.max_len)
+            need = max(need, pad_to)
         if need > self._cache_len:
             self._grow_cache(need)
         if r.prefilled is not None:
             p = r.prefilled
             r.prefilled = None          # free the host copy after write
-            from ray_tpu.runtime.device_store import TensorRef
-
-            def take(x):
-                """Unwrap the device-path KV handoff (reference: RDT
-                tensor_transport_manager.py:37): same-process resolution
-                never leaves HBM; cross-process is one fetch +
-                device_put; the handle is single-use (freed here). A
-                dead handle becomes a per-request KVHandoffError. Plain
-                arrays pass through for the host-staged path."""
-                if not isinstance(x, TensorRef):
-                    return x
-                try:
-                    arr = x.resolve()
-                except Exception as e:
-                    raise KVHandoffError(
-                        f"prefilled KV handle unresolvable: {e}") from e
-                x.free()                # cache write below copies it
-                return arr
-
+            take = self._take_handoff
             t0 = time.monotonic()
-            kv = {"k": jnp.asarray(take(p["k"])),
-                  "v": jnp.asarray(take(p["v"]))}
+            kv_k = jnp.asarray(take(p["k"]))
+            kv_v = jnp.asarray(take(p["v"]))
+            r.handoff_bytes = int(kv_k.nbytes + kv_v.nbytes)
+            self._kvm["handoff_bytes"].inc(r.handoff_bytes)
+            padw = pad_to - kv_k.shape[1]
+            if padw > 0:
+                widths = ((0, 0), (0, padw), (0, 0), (0, 0))
+                kv_k = jnp.pad(kv_k, widths)
+                kv_v = jnp.pad(kv_v, widths)
+            kv = {"k": kv_k, "v": kv_v}
             self._cache = lm.write_prefill_to_cache(
                 self._cache, kv, slot, jnp.int32(n))
             logits_np = np.asarray(take(p["logits"]))
@@ -622,6 +780,111 @@ class LLMEngine:
         self._record_prefill_span(r)
         self._slots[slot] = r
         return self._sample_one(logits_np, r)
+
+    def _acc_len(self) -> int:
+        """Accumulator length for block-table prefill: the full table
+        span rounded to a chunk multiple PLUS one slack chunk — a
+        prefix-hit suffix whose first piece starts off the chunk grid
+        can bucket-pad past the next boundary, and dynamic_update_slice
+        must never clamp (a clamped write silently shifts the chunk
+        and corrupts earlier positions)."""
+        chunk = self.buckets[-1]
+        span = self._table_w * self._block
+        return ((span + chunk - 1) // chunk) * chunk + chunk
+
+    def _admit_paged(self, slot: int, r: _Request) -> int:
+        """Paged prefill: the scheduler already reserved the block
+        table (r.kv_alloc); write the prompt's KV through it. Three
+        paths: shipped-KV handoff (disaggregated), cold bucketed
+        prefill (one forward, scatter — bitwise-identical to the
+        monolithic path), and prefix-hit / long-prompt chunked prefill
+        (gather cached prefix blocks, run lm.prefill_chunk on the
+        suffix only — the prefix's device time is ~eliminated)."""
+        jax, jnp = _jx()
+        n = len(r.tokens)
+        table = r.kv_alloc["table"]
+        hit = r.prefix_hit
+        B = self._block
+        self._tables[slot] = table
+        t0 = time.monotonic()
+        if r.prefilled is not None:
+            p = r.prefilled
+            r.prefilled = None
+            take = self._take_handoff
+            k_np = np.asarray(take(p["k"]))
+            v_np = np.asarray(take(p["v"]))
+            logits_np = np.asarray(take(p["logits"]))
+            r.handoff_bytes = int(k_np.nbytes + v_np.nbytes)
+            self._kvm["handoff_bytes"].inc(r.handoff_bytes)
+            acc_len = self._acc_len()
+            pad = acc_len - k_np.shape[1]
+            widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+            acc = {"k": jnp.asarray(np.pad(k_np, widths)),
+                   "v": jnp.asarray(np.pad(v_np, widths))}
+            # shared prefix blocks (a hit makes the shipped bytes for
+            # them redundant) and beyond-horizon slots write to trash
+            targets = table.copy()
+            targets[:hit // B] = kvcache.TRASH
+            self._pool = kvcache.scatter_table(self._pool, acc,
+                                               jnp.asarray(targets))
+        elif hit == 0 and n <= self.buckets[-1]:
+            # cache-cold short prompt: the SAME lm.prefill forward the
+            # monolithic engine runs (bitwise parity), padded only to
+            # its bucket; pad-garbage blocks redirect to trash via the
+            # table's unallocated tail
+            b = self._bucket_for(n)
+            padded = lm.pad_prompt(r.tokens, b)
+            logits, kv = lm.prefill(self.params, jnp.asarray(padded),
+                                    jnp.int32(n), self.cfg, b)
+            nb = b // B
+            phys = np.full((nb,), kvcache.TRASH, np.int32)
+            phys[:min(nb, self._table_w)] = table[:min(nb,
+                                                       self._table_w)]
+            self._pool = kvcache.scatter_bucket(
+                self._pool, kv, jnp.asarray(phys), nb)
+            logits_np = np.asarray(logits)
+        else:
+            logits_np = self._prefill_into_blocks(r, table, hit)
+        jax.block_until_ready(self._pool["k"])
+        r.kv_written = True
+        r.prefill_device_s = time.monotonic() - t0
+        self._record_prefill_span(r)
+        self._slots[slot] = r
+        return self._sample_one(logits_np, r)
+
+    def _prefill_into_blocks(self, r: _Request, table: np.ndarray,
+                             hit: int) -> np.ndarray:
+        """Prefix-hit (and long-prompt) prefill: gather the table's
+        cached blocks into a contiguous accumulator, run the suffix
+        through lm.prefill_chunk at the prefix offset (pieces aligned
+        to the absolute chunk grid so a cold and a hit request compute
+        every suffix row identically — the bitwise-parity contract the
+        tests pin), then scatter the NEW positions' KV back into the
+        request's own blocks. Shared prefix blocks are never written
+        (their scatter targets are the trash block)."""
+        jax, jnp = _jx()
+        n = len(r.tokens)
+        B = self._block
+        chunk = self.buckets[-1]
+        acc_len = self._acc_len()
+        acc = kvcache.gather_table(self._pool, jnp.asarray(table),
+                                   acc_len)
+        off = hit
+        logits = None
+        while off < n:
+            end = min(n, ((off // chunk) + 1) * chunk)
+            part = r.tokens[off:end]
+            b = self._bucket_for(len(part))
+            padded = lm.pad_prompt(part, b)
+            logits, acc = lm.prefill_chunk(
+                self.params, jnp.asarray(padded),
+                jnp.int32(len(part)), jnp.int32(off), acc, self.cfg)
+            off = end
+        targets = table.copy()
+        targets[:hit // B] = kvcache.TRASH
+        self._pool = kvcache.scatter_table(self._pool, acc,
+                                           jnp.asarray(targets))
+        return np.asarray(logits)
 
     @staticmethod
     def _record_prefill_span(r: _Request) -> None:
@@ -708,11 +971,26 @@ class LLMEngine:
         # a filter (None compiles the plain sampler — one extra jit
         # variant, bounded).
         filters_on = bool((top_ps < 1.0).any() or (top_ks > 0).any())
+        tp = jnp.asarray(top_ps) if filters_on else None
+        tk = jnp.asarray(top_ks) if filters_on else None
+        if self._paged:
+            # per-slot write positions are host-derived (prompt +
+            # emitted - 1: the last emitted token's KV lands this
+            # step), matching the monolithic cache's device-side
+            # length counter by construction; empty slots write into
+            # the trash block
+            lengths = np.zeros((self.max_slots,), np.int32)
+            for i, r in enumerate(self._slots):
+                if r is not None:
+                    lengths[i] = len(r.tokens) + len(r.out) - 1
+            out, self._pool = kvcache.paged_decode_steps(
+                self.params, self._pool, jnp.asarray(self._tables),
+                jnp.asarray(lengths), jnp.asarray(tokens),
+                jnp.asarray(temps), key, self.cfg, block, tp, tk)
+            return np.asarray(out)
         out, self._cache = lm.decode_steps(
             self.params, self._cache, jnp.asarray(tokens),
-            jnp.asarray(temps), key, self.cfg, block,
-            jnp.asarray(top_ps) if filters_on else None,
-            jnp.asarray(top_ks) if filters_on else None)
+            jnp.asarray(temps), key, self.cfg, block, tp, tk)
         return np.asarray(out)
 
     def _sample_one(self, logits: np.ndarray, r: _Request) -> int:
@@ -777,16 +1055,47 @@ class LLMEngine:
         the loop's shutdown sweep can all reach a request)."""
         if r.trace is None:
             return
+        extra = {}
+        if self._paged:
+            extra["prefix_hit_tokens"] = r.prefix_hit
+        if r.handoff_bytes:
+            extra["kv_handoff_bytes"] = r.handoff_bytes
         tracing.record_request_span(
             "engine", "generate", r.trace, r.trace.span_id,
             r.t_submit_wall, time.time(), error=error,
             tokens=len(r.out),
             kv_bytes=int(self._kv_per_token_bytes()
-                         * (len(r.tokens) + len(r.out))))
+                         * (len(r.tokens) + len(r.out))), **extra)
         r.trace = None
+
+    def _free_kv(self, r: _Request, slot: Optional[int]) -> None:
+        """Return a finished/failed request's blocks to the pool; its
+        full prompt+output block chain enters the prefix index (a
+        follow-up conversation turn extends the same chain). The
+        slot's table row reverts to trash so post-finish garbage
+        writes can't land in reallocated blocks."""
+        if not self._paged or r.kv_alloc is None:
+            return
+        # kv_written gates the prefix-cache insert: a request that
+        # failed BEFORE its prefill scatter holds zero/stale blocks —
+        # caching them under the prompt's hashes would serve garbage
+        # KV to every later request sharing the prefix. The FINAL
+        # sampled token is excluded from the cached chain: each decode
+        # step writes the PREVIOUS token's KV, so the last token's
+        # position is never written — a stream ending exactly on a
+        # block boundary would otherwise cache one stale position.
+        stream = list(r.tokens) + list(r.out)
+        if r.out:
+            stream = stream[:-1]
+        self._kv.free_seq(r.seq, stream, cache=r.kv_written)
+        r.kv_alloc = None
+        if slot is not None:
+            self._tables[slot] = kvcache.TRASH
+        self._kv_account()
 
     def _finish(self, r: _Request, slot: Optional[int]):
         self._record_done(r, error=False)
+        self._free_kv(r, slot)
         if slot is not None and self._slots[slot] is r:
             self._slots[slot] = None
         if r.stream is not None:
@@ -807,6 +1116,7 @@ class LLMEngine:
     def _fail(self, r: _Request, slot: Optional[int], e: BaseException):
         from ray_tpu.serve.fault import DeadlineExceeded
         self._record_done(r, error=True)
+        self._free_kv(r, slot)
         # deadline cancellations cross the serve boundary TYPED so the
         # proxy can answer 504 instead of a generic 500
         err = e if isinstance(e, DeadlineExceeded) else RuntimeError(

@@ -1,0 +1,611 @@
+"""Paged KV cache: fixed-size token blocks, prefix reuse, COW, LRU.
+
+The memory subsystem production serving needs (reference capability:
+vLLM's PagedAttention — block tables over a fixed pool bound HBM by
+LIVE tokens, and ref-counted block sharing lets requests with a common
+system-prompt prefix skip prefill for the shared blocks). Rebuilt
+TPU-native on the engine's static-shape rules:
+
+- the POOL is one preallocated tensor pair per engine,
+  ``(layers, num_blocks, block_size, kv_heads, head_dim)`` — shapes
+  never change, so XLA compiles the paged decode step exactly once;
+- each request owns a BLOCK TABLE (fixed width ``max_len //
+  block_size``) of physical block ids; decode gathers the table's
+  blocks into the attention view and scatters the new token's KV back
+  through it (bitwise-identical to the monolithic cache: gathered
+  values are the same bytes in the same order, and masked tail
+  positions contribute exact zeros);
+- a PREFIX CHAIN INDEX (hash-chained per full token block, the radix
+  structure flattened into parent links) maps prompt prefixes to
+  cached block chains: a request sharing a cached prefix adopts those
+  blocks ref-counted and prefills only its suffix (lm.prefill_chunk at
+  the prefix offset — the spike-verified bitwise-parity path);
+- blocks are copy-on-write: a shared (or cached) block is never
+  written; ``ensure_writable`` gives a forked sequence its own copy at
+  the first divergent write;
+- refcount-0 chains stay cached and are LRU-evicted LEAF-FIRST under
+  pool pressure (a parent evicted before its child would orphan the
+  child: chain lookups walk from the root).
+
+Physical block 0 is the TRASH block: writes for finished/empty slots
+and bucket-padding garbage are redirected there so freed blocks can be
+reallocated immediately without a device sync.
+
+Host bookkeeping (``KVBlockManager``) is pure python/numpy — unit-
+testable without jax; device ops (pool init, gather/scatter, the paged
+decode step) live beside it and are only imported by the engine.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+TRASH = 0   # physical block 0: garbage-write target, never allocated
+
+
+def kvcache_metrics() -> dict:
+    """Get-or-create the paged-KV gauges/counters (shared process
+    registry, pushed to the head like every llm_* series). Catalog:
+
+      llm_kv_blocks_used          blocks referenced by live requests
+      llm_kv_blocks_cached        refcount-0 blocks held by the prefix
+                                  index (reclaimable via LRU eviction)
+      llm_kv_blocks_evicted_total cached chains evicted under pressure
+      llm_prefix_hit_tokens_total prompt tokens whose prefill was
+                                  skipped via a prefix-cache hit
+      llm_kv_handoff_bytes_total  KV bytes shipped prefill->decode at
+                                  block granularity (llm/pd.py)
+    """
+    from ray_tpu.util import metrics as m
+    return {
+        "used": m.Gauge(
+            "llm_kv_blocks_used",
+            "KV pool blocks referenced by live requests"),
+        "cached": m.Gauge(
+            "llm_kv_blocks_cached",
+            "Refcount-0 KV pool blocks held by the prefix index "
+            "(reclaimable by LRU eviction)"),
+        "evicted": m.Counter(
+            "llm_kv_blocks_evicted_total",
+            "Cached KV blocks evicted from the prefix index under "
+            "pool pressure"),
+        "hit_tokens": m.Counter(
+            "llm_prefix_hit_tokens_total",
+            "Prompt tokens served from cached prefix blocks instead "
+            "of prefill compute"),
+        "handoff_bytes": m.Counter(
+            "llm_kv_handoff_bytes_total",
+            "KV bytes shipped prefill->decode at block granularity "
+            "in the disaggregated path"),
+    }
+
+
+def chain_hashes(tokens: Sequence[int], block_size: int, *,
+                 seed: bytes = b"", start_block: int = 0) -> List[str]:
+    """One digest per FULL block of ``tokens`` from ``start_block``
+    on; each digest covers the entire prefix up to that block's end
+    (hash chaining), so equal digests imply equal prefixes — the
+    prefix-index key. ``seed`` is the digest of block start_block-1
+    (chain extension: free_seq continues a stored prompt chain over
+    the generated tokens without rehashing the prompt)."""
+    out: List[str] = []
+    h = seed
+    for i in range(start_block, len(tokens) // block_size):
+        blk = tokens[i * block_size:(i + 1) * block_size]
+        d = hashlib.blake2b(digest_size=16)
+        d.update(h)
+        d.update(np.asarray(blk, np.int64).tobytes())
+        h = d.digest()
+        out.append(h.hex())
+    return out
+
+
+@dataclass
+class _CacheEntry:
+    phys: int
+    hash: str
+    parent: Optional[str]       # previous block's chain hash
+    children: int = 0           # cached continuations (evict leaves 1st)
+    last_used: int = 0          # manager tick, LRU order
+
+
+@dataclass
+class _Seq:
+    table: List[int]            # logical block idx -> physical id
+    n_prompt: int
+    hit_tokens: int
+    hashes: List[str] = field(default_factory=list)  # full prompt blocks
+
+
+class BlockPoolExhausted(RuntimeError):
+    """The request can NEVER fit: its full horizon needs more blocks
+    than the pool holds even if everything cacheable were evicted."""
+
+
+class KVBlockManager:
+    """Host-side accounting for one engine's block pool. Not
+    thread-safe by itself — the engine serializes admits/frees on its
+    scheduler loop, matching the monolithic cache's discipline."""
+
+    def __init__(self, num_blocks: int, block_size: int, *,
+                 table_width: int, prefix_cache: bool = True,
+                 metrics: Optional[dict] = None):
+        if num_blocks < 2:
+            raise ValueError("pool needs >= 2 blocks (one is trash)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.table_width = int(table_width)
+        self.prefix_cache = bool(prefix_cache)
+        self.free: deque = deque(range(1, num_blocks))   # 0 = trash
+        self.ref: Dict[int, int] = {}                    # phys -> count
+        self.entries: Dict[str, _CacheEntry] = {}        # hash -> entry
+        self.by_phys: Dict[int, _CacheEntry] = {}
+        self.seqs: Dict[object, _Seq] = {}
+        self.evicted_total = 0
+        self.hit_tokens_total = 0
+        self._tick = 0
+        self._m = metrics
+
+    # -- introspection ---------------------------------------------------
+
+    def used_blocks(self) -> int:
+        return sum(1 for c in self.ref.values() if c > 0)
+
+    def cached_blocks(self) -> int:
+        return sum(1 for h, e in self.entries.items()
+                   if self.ref.get(e.phys, 0) == 0)
+
+    def free_blocks(self) -> int:
+        return len(self.free)
+
+    def _publish(self) -> None:
+        if self._m is None:
+            return
+        self._m["used"].set(self.used_blocks())
+        self._m["cached"].set(self.cached_blocks())
+
+    def blocks_needed(self, n_tokens: int, max_new: int) -> int:
+        """Full-horizon reservation: admission allocates every block
+        the request can ever touch, so decode can never fail mid-
+        flight on pool pressure (the pool's overload answer is a
+        queued admit, not a dropped stream)."""
+        return -(-(n_tokens + max_new) // self.block_size)
+
+    # -- prefix lookup ---------------------------------------------------
+
+    def lookup(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
+        """(hit_tokens, physical blocks) for the longest cached chain
+        of FULL prompt blocks — capped one token short of the prompt
+        so the last token's logits always come from live compute (a
+        full-hit request still needs something to sample from)."""
+        hit, phys, _ = self._lookup(tokens)
+        return hit, phys
+
+    def _lookup(self, tokens: Sequence[int]
+                ) -> Tuple[int, List[int], List[str]]:
+        """lookup + the prompt's chain hashes (alloc_seq records them
+        on the sequence — hashing a long prompt once, not twice)."""
+        hashes = chain_hashes(tokens, self.block_size) \
+            if self.prefix_cache else []
+        if not self.prefix_cache:
+            return 0, [], hashes
+        cap_blocks = (len(tokens) - 1) // self.block_size
+        phys: List[int] = []
+        self._tick += 1
+        for h in hashes[:cap_blocks]:
+            e = self.entries.get(h)
+            if e is None:
+                break
+            e.last_used = self._tick
+            phys.append(e.phys)
+        return len(phys) * self.block_size, phys, hashes
+
+    # -- allocation ------------------------------------------------------
+
+    def alloc_seq(self, seq_id, tokens: Sequence[int],
+                  max_new: int) -> Optional[dict]:
+        """Admit one request: adopt the cached prefix (ref-counted),
+        reserve fresh blocks for the rest of its horizon. Returns
+        {"table": np.int32 (table_width,), "hit_tokens": int,
+        "new_blocks": [phys]} — or None when the pool can't cover it
+        right now (caller re-queues the request; eviction of
+        refcount-0 chains was already attempted). Raises
+        BlockPoolExhausted when the request can never fit."""
+        if seq_id in self.seqs:
+            raise ValueError(f"seq {seq_id!r} already allocated")
+        n = len(tokens)
+        total = self.blocks_needed(n, max_new)
+        if total > self.table_width:
+            raise BlockPoolExhausted(
+                f"request horizon spans {total} blocks > table width "
+                f"{self.table_width}")
+        if total > self.num_blocks - 1:
+            raise BlockPoolExhausted(
+                f"request horizon needs {total} blocks; pool holds "
+                f"{self.num_blocks - 1}")
+        hit_tokens, hit_phys, hashes = self._lookup(tokens)
+        # pin the hit blocks BEFORE any eviction: at refcount 0 they
+        # are themselves eviction candidates once their chain suffix
+        # is gone, and an evicted-then-reallocated hit block would
+        # appear TWICE in the table (prefix view + fresh write target)
+        # — silent KV corruption
+        for p in hit_phys:
+            self.ref[p] = self.ref.get(p, 0) + 1
+        need = total - len(hit_phys)
+        if need > len(self.free):
+            self.evict(need - len(self.free))
+        if need > len(self.free):
+            for p in hit_phys:          # un-pin; caller re-queues
+                self._release(p)
+            return None
+        table = np.full((self.table_width,), TRASH, np.int32)
+        for i, p in enumerate(hit_phys):
+            table[i] = p
+        new_blocks = []
+        for i in range(len(hit_phys), total):
+            p = self.free.popleft()
+            self.ref[p] = 1
+            table[i] = p
+            new_blocks.append(p)
+        self.seqs[seq_id] = _Seq(list(table), n, hit_tokens, hashes)
+        self.hit_tokens_total += hit_tokens
+        if self._m is not None and hit_tokens:
+            self._m["hit_tokens"].inc(hit_tokens)
+        self._publish()
+        return {"table": table, "hit_tokens": hit_tokens,
+                "new_blocks": new_blocks}
+
+    def _release(self, phys: int) -> None:
+        """Drop one live reference; a block neither referenced nor
+        cached returns to the free list."""
+        c = self.ref.get(phys, 0) - 1
+        if c > 0:
+            self.ref[phys] = c
+            return
+        self.ref.pop(phys, None)
+        if phys not in self.by_phys and phys != TRASH:
+            self.free.append(phys)
+
+    def free_seq(self, seq_id, out_tokens: Sequence[int] = (),
+                 cache: bool = True) -> None:
+        """Finish one request: insert its full-block chain (prompt +
+        generated tokens — a follow-up turn extends the same chain)
+        into the prefix index, then drop the live references. Cached
+        blocks stay resident at refcount 0 until LRU eviction.
+        ``cache=False`` skips the insert — REQUIRED for a request
+        whose KV was never written (admit failed before the scatter):
+        indexing its zero/stale blocks under the prompt's chain hashes
+        would poison every later request sharing the prefix."""
+        seq = self.seqs.pop(seq_id, None)
+        if seq is None:
+            return
+        if self.prefix_cache and cache:
+            # ``out_tokens`` is the FULL token stream (prompt +
+            # generated) when the caller wants generated full blocks
+            # cached too (a follow-up conversation turn extends the
+            # same chain); absent, the alloc-time prompt hashes
+            # serve. The stored prompt chain is EXTENDED from its
+            # last digest — the prompt (a 100k shared context on the
+            # target workload) is never rehashed at finish.
+            hashes = seq.hashes
+            if len(out_tokens) >= seq.n_prompt:
+                seed = bytes.fromhex(hashes[-1]) if hashes else b""
+                hashes = hashes + chain_hashes(
+                    list(out_tokens), self.block_size, seed=seed,
+                    start_block=len(hashes))
+            self._tick += 1
+            parent: Optional[str] = None
+            for i, h in enumerate(hashes):
+                phys = seq.table[i]
+                if phys == TRASH:
+                    break
+                cur = self.entries.get(h)
+                if cur is None:
+                    # only cache blocks this seq exclusively owns or
+                    # already-cached shared ones; a shared-but-uncached
+                    # block (fork) must not be indexed under a hash
+                    # another writer could invalidate
+                    e = _CacheEntry(phys, h, parent,
+                                    last_used=self._tick)
+                    if phys in self.by_phys:
+                        # same phys already cached under another hash
+                        # (can't happen via chain hashing; guard)
+                        break
+                    self.entries[h] = e
+                    self.by_phys[phys] = e
+                    if parent is not None and parent in self.entries:
+                        self.entries[parent].children += 1
+                else:
+                    cur.last_used = self._tick
+                parent = h
+        for phys in seq.table:
+            if phys != TRASH:
+                self._release(phys)
+        self._publish()
+
+    # -- copy-on-write / fork --------------------------------------------
+
+    def fork_seq(self, src_id, dst_id) -> List[int]:
+        """Share every block of ``src`` with a new sequence (parallel
+        sampling / beam fork). Writes to shared blocks must go through
+        ensure_writable."""
+        src = self.seqs.get(src_id)
+        if src is None:
+            raise KeyError(src_id)
+        if dst_id in self.seqs:
+            raise ValueError(f"seq {dst_id!r} already allocated")
+        for p in src.table:
+            if p != TRASH:
+                self.ref[p] = self.ref.get(p, 0) + 1
+        self.seqs[dst_id] = _Seq(list(src.table), src.n_prompt,
+                                 src.hit_tokens, list(src.hashes))
+        self._publish()
+        return list(src.table)
+
+    def ensure_writable(self, seq_id,
+                        logical: int) -> Optional[Tuple[int, int]]:
+        """Copy-on-write guard: before writing into ``logical``, a
+        block that is shared (refcount > 1) or held by the prefix
+        index is replaced by a private copy. Returns (old_phys,
+        new_phys) when the caller must issue the device block copy,
+        None when the block was already private."""
+        seq = self.seqs[seq_id]
+        phys = seq.table[logical]
+        if phys == TRASH:
+            return None
+        if self.ref.get(phys, 0) <= 1 and phys not in self.by_phys:
+            return None
+        if not self.free:
+            self.evict(1)
+        if not self.free:
+            return None     # caller treats as pool pressure
+        new = self.free.popleft()
+        self.ref[new] = 1
+        seq.table[logical] = new
+        self._release(phys)
+        self._publish()
+        return phys, new
+
+    # -- eviction --------------------------------------------------------
+
+    def evict(self, k: int) -> int:
+        """Evict up to ``k`` cached refcount-0 blocks, LRU leaf-first
+        (children evict before parents so surviving chains stay
+        walkable from the root). One heapify + O(k log n) — this runs
+        on the engine's serialized admit path, so a per-block rescan
+        of every cache entry would stall in-flight streams under a
+        large prefix cache. Returns blocks actually freed."""
+        import heapq
+        heap = [(e.last_used, e.hash) for e in self.entries.values()
+                if e.children == 0 and self.ref.get(e.phys, 0) == 0]
+        heapq.heapify(heap)
+        freed = 0
+        while freed < k and heap:
+            _, h = heapq.heappop(heap)
+            e = self.entries.get(h)
+            if e is None or e.children != 0 \
+                    or self.ref.get(e.phys, 0) != 0:
+                continue            # stale heap entry
+            del self.entries[h]
+            self.by_phys.pop(e.phys, None)
+            if e.parent is not None:
+                p = self.entries.get(e.parent)
+                if p is not None:
+                    p.children -= 1
+                    if p.children == 0 and \
+                            self.ref.get(p.phys, 0) == 0:
+                        heapq.heappush(heap, (p.last_used, p.hash))
+            self.free.append(e.phys)
+            freed += 1
+            self.evicted_total += 1
+            if self._m is not None:
+                self._m["evicted"].inc()
+        if freed:
+            self._publish()
+        return freed
+
+
+# --- device ops (jax only from here down) ------------------------------
+
+
+def _jx():
+    import jax
+    import jax.numpy as jnp
+    return jax, jnp
+
+
+def init_pool(cfg, num_blocks: int, block_size: int, dtype) -> dict:
+    """The pool tensors: k/v of shape
+    (layers, num_blocks, block_size, kv_heads, head_dim)."""
+    _, jnp = _jx()
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def pool_block_bytes(pool: dict) -> int:
+    """Device bytes one block costs (k + v, all layers)."""
+    nb = pool["k"].shape[1]
+    return (pool["k"].nbytes + pool["v"].nbytes) // nb
+
+
+def auto_pool_blocks(slots: int, table_width: int, block_bytes: int,
+                     configured: int = 0) -> int:
+    """Pool size: the explicit knob wins; otherwise worst case (every
+    slot at max_len) plus one full chain of prefix-cache headroom,
+    capped by the devmon HBM headroom gauges when the backend reports
+    them (half the free HBM — the engine is not the only tenant).
+    The cap never shrinks below ONE full-horizon request
+    (table_width blocks): a max_len-sized request must be servable —
+    serially — on any pool the engine auto-sizes, matching what the
+    monolithic cache guarantees."""
+    if configured:
+        return max(2, int(configured))
+    base = slots * table_width + table_width
+    try:
+        from ray_tpu.util import devmon
+        rows = devmon.hbm_snapshot(record=False)
+        headrooms = [r["limit_bytes"] - r["used_bytes"] for r in rows
+                     if r.get("limit_bytes")]
+        if headrooms:
+            cap = int(min(headrooms) * 0.5 // max(1, block_bytes))
+            base = max(table_width, min(base, cap))
+    except Exception:   # noqa: BLE001 — sizing hint only
+        pass
+    return base + 1     # + trash block
+
+
+_JITS: dict = {}    # name -> jitted callable, built once per process
+
+
+def _jit(name: str):
+    """Build-once cache for the jitted device ops: jax must not be
+    imported at module import time (the engine's lazy-import rule),
+    and a fresh jax.jit wrapper per call would retrace every call."""
+    fn = _JITS.get(name)
+    if fn is not None:
+        return fn
+    jax, jnp = _jx()
+
+    if name == "scatter_bucket":
+        @partial(jax.jit, donate_argnums=(0,), static_argnames=("nb",))
+        def fn(pool, kv, phys, nb):
+            L = kv["k"].shape[0]
+            bs = pool["k"].shape[2]
+            k = kv["k"].reshape(L, nb, bs, *kv["k"].shape[2:])
+            v = kv["v"].reshape(L, nb, bs, *kv["v"].shape[2:])
+            return {"k": pool["k"].at[:, phys].set(
+                        k.astype(pool["k"].dtype)),
+                    "v": pool["v"].at[:, phys].set(
+                        v.astype(pool["v"].dtype))}
+    elif name == "gather_table":
+        @partial(jax.jit, static_argnames=("acc_len",))
+        def fn(pool, phys, acc_len):
+            L, _, bs, kvh, hd = pool["k"].shape
+            w = phys.shape[0]
+            out = {}
+            for key in ("k", "v"):
+                g = pool[key][:, phys]           # (L, w, bs, kvh, hd)
+                g = g.reshape(L, w * bs, kvh, hd)
+                pad = acc_len - w * bs
+                if pad > 0:
+                    g = jnp.pad(g, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                out[key] = g
+            return out
+    elif name == "scatter_table":
+        @partial(jax.jit, donate_argnums=(0,))
+        def fn(pool, acc, phys):
+            L, _, bs, kvh, hd = pool["k"].shape
+            w = phys.shape[0]
+            out = {}
+            for key in ("k", "v"):
+                a = acc[key][:, :w * bs].reshape(L, w, bs, kvh, hd)
+                out[key] = pool[key].at[:, phys].set(
+                    a.astype(pool[key].dtype))
+            return out
+    elif name == "copy_block":
+        @partial(jax.jit, donate_argnums=(0,))
+        def fn(pool, src, dst):
+            return {"k": pool["k"].at[:, dst].set(pool["k"][:, src]),
+                    "v": pool["v"].at[:, dst].set(pool["v"][:, src])}
+    else:
+        raise KeyError(name)
+    _JITS[name] = fn
+    return fn
+
+
+def scatter_bucket(pool: dict, kv: dict, phys, nb: int) -> dict:
+    """Write a bucket-padded prefill's KV into ``nb`` physical blocks
+    (pad-garbage blocks redirected to trash by the caller's phys).
+    One compile per bucket size."""
+    return _jit("scatter_bucket")(pool, kv, phys, nb)
+
+
+def gather_table(pool: dict, phys, acc_len: int) -> dict:
+    """Gather one block table's KV into a contiguous accumulator
+    (layers, acc_len, kvh, hd) for chunked prefill over a cached
+    prefix. acc_len >= table_width * block_size (zero tail)."""
+    return _jit("gather_table")(pool, phys, acc_len)
+
+
+def scatter_table(pool: dict, acc: dict, phys) -> dict:
+    """Write an accumulator back through a full-width physical target
+    vector (shared-prefix and beyond-horizon slots point at trash so
+    shared blocks are never written). One compile total."""
+    return _jit("scatter_table")(pool, acc, phys)
+
+
+def copy_block(pool: dict, src: int, dst: int) -> dict:
+    """Device-side block copy (the COW divergence path)."""
+    _, jnp = _jx()
+    return _jit("copy_block")(pool, jnp.int32(src), jnp.int32(dst))
+
+
+def _paged_decode_core(params, pool, tables, lengths, tokens, temps,
+                       key, cfg, top_ps=None, top_ks=None):
+    """One token for every slot against the paged pool. Runs
+    lm.decode_token_core — the SAME transformer body as the monolithic
+    cache — with block-table write/gather plugged in: the gathered
+    (slots, W*bs, kvh, hd) view holds the same bytes in the same order
+    as the monolithic cache, so the attention math (and therefore the
+    sampled tokens) is bitwise identical (pinned by
+    tests/test_zz_kvcache.py parity tests)."""
+    jax, jnp = _jx()
+    from ray_tpu.llm.model import decode_token_core
+    b = tokens.shape[0]
+    bs = pool["k"].shape[2]
+    w = tables.shape[1]
+    positions = lengths
+    blk = jnp.clip(positions // bs, 0, w - 1)
+    off = positions % bs
+    phys = tables[jnp.arange(b), blk]
+
+    def write(ck, cv, k, v):    # ck/cv: (num_blocks, bs, kvh, hd)
+        return (ck.at[phys, off].set(k.astype(ck.dtype)),
+                cv.at[phys, off].set(v.astype(cv.dtype)))
+
+    def view(ck, cv):
+        return (ck[tables].reshape(b, w * bs, cfg.n_kv_heads,
+                                   cfg.head_dim),
+                cv[tables].reshape(b, w * bs, cfg.n_kv_heads,
+                                   cfg.head_dim))
+
+    out, nk, nv = decode_token_core(
+        params, pool["k"], pool["v"], tokens, positions, temps, key,
+        cfg, write, view, top_ps, top_ks)
+    return out, {"k": nk, "v": nv}
+
+
+def paged_decode_steps(params, pool, tables, lengths, tokens, temps,
+                       key, cfg, n: int, top_ps=None, top_ks=None):
+    """n chained decode steps against the block pool in ONE dispatch —
+    the paged twin of lm.decode_steps (same fold_in schedule, same
+    block semantics; slots past their request produce discardable
+    garbage in the trash block)."""
+    fn = _JITS.get("paged_decode_steps")
+    if fn is None:
+        jax, jnp = _jx()
+        from jax import lax as _lax
+
+        @partial(jax.jit, static_argnames=("cfg", "n"),
+                 donate_argnums=(1,))
+        def fn(params, pool, tables, lengths, tokens, temps, key, cfg,
+               n, top_ps, top_ks):
+            def body(carry, i):
+                pool, toks = carry
+                out, pool = _paged_decode_core(
+                    params, pool, tables, lengths + i, toks, temps,
+                    jax.random.fold_in(key, i), cfg, top_ps, top_ks)
+                return (pool, out), out
+            (pool, _), outs = _lax.scan(body, (pool, tokens),
+                                        jnp.arange(n, dtype=jnp.int32))
+            return outs, pool
+        _JITS["paged_decode_steps"] = fn
+    return fn(params, pool, tables, lengths, tokens, temps, key,
+              cfg, n, top_ps, top_ks)

@@ -362,6 +362,48 @@ def sample(logits: jax.Array, temps: jax.Array, key: jax.Array,
     return jnp.where(temps <= 0, greedy, drawn)
 
 
+def decode_token_core(params: dict, kcache: jax.Array,
+                      vcache: jax.Array, tokens: jax.Array,
+                      positions: jax.Array, temps: jax.Array,
+                      key: jax.Array, cfg: LlamaConfig,
+                      write, view,
+                      top_ps: Optional[jax.Array] = None,
+                      top_ks: Optional[jax.Array] = None):
+    """THE decode-step transformer, shared by the monolithic slot
+    cache and the paged block pool (llm/kvcache.py) so the two can
+    never drift numerically — the paged engine's bitwise-parity
+    contract hangs on both running exactly this op sequence. The
+    cache layout is abstracted by two callables applied per layer:
+    ``write(ck, cv, k, v) -> (ck, cv)`` appends the new token's KV
+    (k/v: (slots, kvh, hd)); ``view(ck, cv) -> (vk, vv)`` yields the
+    (slots, L, kvh, hd) attention view. Returns (sampled tokens,
+    new kcache, new vcache)."""
+    x = jnp.take(params["embed"], tokens[:, None], axis=0)  # (b, 1, emb)
+    rc, rs = _rope_tables(positions[:, None], cfg.head_dim,
+                          cfg.rope_theta)
+
+    def layer(carry, xs):
+        x = carry
+        lp, ck, cv = xs
+        y = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(y, lp, cfg)  # (b, 1, ...)
+        q, k = _rope(q, rc, rs), _rope(k, rc, rs)
+        ck, cv = write(ck, cv, k[:, 0], v[:, 0])
+        vk, vv = view(ck, cv)
+        o = _gqa_attend_cached(q[:, 0], vk, vv, positions + 1, cfg)
+        x = x + (o.astype(x.dtype) @ lp["wo"])[:, None]
+        y = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + ((jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"]))
+                 @ lp["w_down"])
+        return x, (ck, cv)
+
+    x, (nk, nv) = lax.scan(layer, x, (params["layers"],
+                                      kcache, vcache))
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return sample(logits, temps, key, top_ps, top_ks), nk, nv
+
+
 def _decode_core(params: dict, cache: dict, tokens: jax.Array,
                  temps: jax.Array, key: jax.Array,
                  cfg: LlamaConfig,
@@ -375,31 +417,17 @@ def _decode_core(params: dict, cache: dict, tokens: jax.Array,
     (sampled next tokens (slots,) int32, updated cache)."""
     b = tokens.shape[0]
     positions = cache["length"]  # (b,) where the new token goes
-    x = jnp.take(params["embed"], tokens[:, None], axis=0)  # (b, 1, emb)
-    rc, rs = _rope_tables(positions[:, None], cfg.head_dim, cfg.rope_theta)
 
-    def layer(carry, xs):
-        x = carry
-        lp, ck, cv = xs  # ck/cv: (b, L, kvh, hd) this layer's cache
-        y = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q, k, v = _qkv(y, lp, cfg)  # (b, 1, ...)
-        q, k = _rope(q, rc, rs), _rope(k, rc, rs)
-        ck = ck.at[jnp.arange(b), positions].set(
-            k[:, 0].astype(ck.dtype))
-        cv = cv.at[jnp.arange(b), positions].set(
-            v[:, 0].astype(cv.dtype))
-        o = _gqa_attend_cached(q[:, 0], ck, cv, positions + 1, cfg)
-        x = x + (o.astype(x.dtype) @ lp["wo"])[:, None]
-        y = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        x = x + ((jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"]))
-                 @ lp["w_down"])
-        return x, (ck, cv)
+    def write(ck, cv, k, v):
+        return (ck.at[jnp.arange(b), positions].set(k.astype(ck.dtype)),
+                cv.at[jnp.arange(b), positions].set(v.astype(cv.dtype)))
 
-    x, (nk, nv) = lax.scan(layer, x, (params["layers"],
-                                      cache["k"], cache["v"]))
-    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
-    out = sample(logits, temps, key, top_ps, top_ks)
+    def view(ck, cv):
+        return ck, cv           # the slot cache IS the attention view
+
+    out, nk, nv = decode_token_core(
+        params, cache["k"], cache["v"], tokens, positions, temps, key,
+        cfg, write, view, top_ps, top_ks)
     return out, {"k": nk, "v": nv, "length": cache["length"] + 1}
 
 

@@ -17,6 +17,7 @@ dynamic_update_slice — no forward pass.
 
 from __future__ import annotations
 
+import math
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -29,20 +30,45 @@ class PrefillEngine:
     """Stateless prompt prefill: tokens -> {kv, logits, length}.
 
     Shape-bucketed like LLMEngine's in-engine prefill (one compile per
-    bucket); the returned KV is bucket-sized, and
-    LLMEngine.generate_prefilled() writes it into a decode slot.
+    bucket); the returned KV is sliced to BLOCK granularity (the paged
+    cache's token-block size) before shipping, so the handoff moves
+    ceil(n / block) blocks instead of a whole padded bucket — a
+    65-token prompt ships 80 positions at block 16, not 128. The
+    decode engine re-pads on arrival (paged: into its accumulator;
+    monolithic: to its bucket) and frees the prefill side's copy at
+    handoff (TensorRef handles are single-use; the host-staged numpy
+    copy dies with the request object).
     """
 
     def __init__(self, cfg: LlamaConfig, params, *,
                  prefill_buckets: Sequence[int] = (64, 128, 256, 512),
                  max_len: int = 1024,
-                 cache_dtype: str = "bfloat16"):
+                 cache_dtype: str = "bfloat16",
+                 block_size: Optional[int] = None):
         self.cfg = cfg
         self.params = params
         self.max_len = max_len
         self.buckets = tuple(sorted(b for b in prefill_buckets
                                     if b <= max_len)) or (max_len,)
         self.cache_dtype = cache_dtype
+        if block_size is None:
+            from ray_tpu.config import get_config
+            block_size = int(getattr(get_config(),
+                                     "kvcache_block_size", 16))
+        # same gcd adjustment the engine applies, so both tiers agree
+        # on what a block is; 0 = bucket-granular legacy shipping
+        if block_size > 0:
+            for v in (*self.buckets, max_len):
+                block_size = math.gcd(block_size, v)
+        self.block_size = max(0, block_size)
+
+    def _ship_len(self, n: int, upper: int) -> int:
+        """Positions to ship for an n-token prompt: the smallest block
+        multiple covering it (bucket-granular when blocks are off)."""
+        if self.block_size <= 0:
+            return upper
+        b = self.block_size
+        return min(upper, -(-n // b) * b)
 
     def prefill(self, tokens: Sequence[int], *,
                 device: bool = False) -> dict:
@@ -73,11 +99,13 @@ class PrefillEngine:
         if n <= big:
             b = lm.bucket_for(self.buckets, n)
             padded = lm.pad_prompt(tokens, b)
-            # pad KV only to the bucket (not max_len): the shipped
-            # payload scales with the prompt
+            # compute at the bucket shape (bounded compiles), ship
+            # only the covering BLOCKS: the payload scales with the
+            # prompt at block granularity, not bucket granularity
             logits, kv = lm.prefill(self.params, jnp.asarray(padded),
                                     jnp.int32(n), self.cfg, b)
-            k, v = kv["k"], kv["v"]
+            ship = self._ship_len(n, b)
+            k, v = kv["k"][:, :ship], kv["v"][:, :ship]
         else:
             cfg = self.cfg
             # accumulate into the smallest bucket-multiple >= n: chunk
@@ -99,10 +127,11 @@ class PrefillEngine:
                     self.params, jnp.asarray(padded),
                     jnp.int32(len(part)), jnp.int32(off), acc, cfg)
                 off += len(part)
-            # decode caches span max_len positions; the bucket-rounded
-            # tail beyond it is pad garbage
-            k = acc["k"][:, :self.max_len]
-            v = acc["v"][:, :self.max_len]
+            # ship the covering blocks (capped at max_len — decode
+            # caches span max_len positions; anything past is garbage)
+            ship = self._ship_len(n, self.max_len)
+            k = acc["k"][:, :ship]
+            v = acc["v"][:, :ship]
         if device:
             from ray_tpu.runtime.device_store import put_device
             return {"k": put_device(k.astype(dt)),

@@ -113,6 +113,12 @@ class ServeController:
         self._creating: set = set()    # replica names mid-create_actor
         self._gang_slots_creating: Dict[str, set] = {}
         self._last_orphan_sweep = 0.0
+        # SLO-driven autoscaling (serve/autoscale.py): created lazily
+        # at the first SLO-policy deployment or proxy hint; the burn
+        # advice cache bounds health_state fetches to one per interval
+        self._autoscaler = None
+        self._burn_advice_cache: Dict[str, Any] = {"ts": 0.0,
+                                                   "advice": {}}
 
     # -- internal async cluster ops ---------------------------------------
 
@@ -399,6 +405,14 @@ class ServeController:
                     "pg_id": dep.pg_id.hex() if dep.pg_id else None,
                     "error": dep.pg_error,
                 }
+            auto = dep.spec.get("autoscaling_config")
+            if auto:
+                from ray_tpu.serve import autoscale as _asc
+                if _asc.is_slo(auto):
+                    out[name]["autoscale"] = \
+                        self._get_autoscaler().describe(name)
+                else:
+                    out[name]["autoscale"] = {"policy": "ongoing"}
         return out
 
     # -- reconcile ---------------------------------------------------------
@@ -466,6 +480,8 @@ class ServeController:
                 if dep.pg_id is not None:
                     await self._remove_pg(dep.pg_id)
                     dep.pg_id = None
+                if self._autoscaler is not None:
+                    self._autoscaler.forget(name)
                 del self.deployments[name]
 
     async def _converge(self, dep: _DeploymentState):
@@ -719,21 +735,99 @@ class ServeController:
 
     # -- autoscaling -------------------------------------------------------
 
-    async def _autoscale(self, dep: _DeploymentState):
-        auto = dep.spec.get("autoscaling_config")
-        if not auto or dep.spec.get("_deleted"):
-            return
-        running = dep.running()
-        if not running:
-            return
-        total_ongoing = 0
+    def _get_autoscaler(self):
+        if self._autoscaler is None:
+            from ray_tpu.serve.autoscale import SLOAutoscaler
+            self._autoscaler = SLOAutoscaler()
+        return self._autoscaler
+
+    async def autoscale_hint(self, deployment: str,
+                             tier: str = "page") -> bool:
+        """Proxy fast path (serve/proxy.py shed advisory): a request
+        was shed while the deployment's SLO budget was burning. The
+        hint counts as a page-tier signal at the autoscaler's next
+        tick — the scale-up doesn't wait for the controller's own
+        burn-advice fetch."""
+        self._get_autoscaler().note_hint(str(deployment), str(tier))
+        return True
+
+    async def _poll_ongoing(self, running: List[_ReplicaInfo]) -> int:
+        """Refresh per-replica in-flight counts; both actuator
+        policies read them."""
+        total = 0
         for r in running:
             try:
                 m = await self._acall(r.actor_id, "metrics", timeout=2.0)
                 r.ongoing = int(m["ongoing"])
             except Exception:
                 continue
-            total_ongoing += r.ongoing
+            total += r.ongoing
+        return total
+
+    async def _fetch_burn_advice(self) -> dict:
+        """The head health plane's per-deployment burn_advice map,
+        cached one autoscale interval (a reconcile loop at 4 Hz must
+        not stampede the head). Stale advice beats none on a fetch
+        failure."""
+        cache = self._burn_advice_cache
+        now = time.time()
+        if now - cache["ts"] < self._get_autoscaler().interval_s:
+            return cache["advice"]
+        cache["ts"] = now
+        try:
+            ctx = self._ctx()
+            st = await ctx.pool.call(ctx.head_addr, "health_state",
+                                     timeout=2.0)
+            cache["advice"] = (st or {}).get("burn_advice") or {}
+        except Exception:
+            pass
+        return cache["advice"]
+
+    async def _autoscale(self, dep: _DeploymentState):
+        """Exactly ONE actuator per deployment: an SLO policy config
+        ({"policy": "slo", ...}) routes to serve/autoscale.py; plain
+        configs keep the legacy target_ongoing_requests loop as the
+        fallback. Running both would have them fight over dep.target
+        (tests/test_zz_autoscale.py pins the dispatch)."""
+        auto = dep.spec.get("autoscaling_config")
+        if not auto or dep.spec.get("_deleted"):
+            return
+        running = dep.running()
+        if not running:
+            return
+        from ray_tpu.serve import autoscale as _asc
+        if _asc.is_slo(auto):
+            await self._autoscale_slo(dep, auto, running)
+        else:
+            await self._autoscale_legacy(dep, auto, running)
+
+    async def _autoscale_slo(self, dep: _DeploymentState, auto: dict,
+                             running: List[_ReplicaInfo]):
+        from ray_tpu.serve import autoscale as _asc
+        asc = self._get_autoscaler()
+        st = asc.state(dep.name)
+        now = time.time()
+        if now - getattr(st, "last_eval", 0.0) < asc.interval_s:
+            return
+        st.last_eval = now
+        total = await self._poll_ongoing(running)
+        advice = await self._fetch_burn_advice()
+        inp = _asc.Inputs(
+            running=len(running), target=dep.target, ongoing=total,
+            max_ongoing=int(dep.spec.get("max_ongoing_requests", 16)),
+            burn=advice.get(dep.name))
+        d = asc.apply(dep.name, inp, auto)
+        if d.target != dep.target:
+            dep.target = d.target
+            dep.last_scale_change = now
+            # scale-down victims DRAIN via _converge's retire() path —
+            # the in-flight streams that were running when utilization
+            # dropped finish before their replica stops
+
+    async def _autoscale_legacy(self, dep: _DeploymentState,
+                                auto: dict,
+                                running: List[_ReplicaInfo]):
+        total_ongoing = await self._poll_ongoing(running)
         target_per = float(auto.get("target_ongoing_requests", 2.0))
         lo = int(auto.get("min_replicas", 1))
         hi = int(auto.get("max_replicas", 8))

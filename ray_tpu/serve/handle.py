@@ -63,6 +63,10 @@ class _Router:
         self.max_ongoing = 16               # per-replica, from the table
         self.fetched_at = 0.0
         self.inflight: Dict[bytes, int] = {}
+        # per-replica smoothed call latency (seconds): the p2c score
+        # weights in-flight counts by it, so a slow replica sheds load
+        # to fast peers instead of just to idle ones
+        self.ewma_s: Dict[bytes, float] = {}
         self.breakers: Dict[bytes, fault.CircuitBreaker] = {}
         self._probing: set = set()          # rids with a live probe task
         self.lock = threading.Lock()
@@ -112,6 +116,8 @@ class _Router:
                     del self.breakers[gone]
                     self._fm["ejected"].set(
                         0, tags={"replica": gone.hex()})
+                for gone in [r for r in self.ewma_s if r not in live]:
+                    del self.ewma_s[gone]
             if self.replicas or not block_until_nonempty:
                 return
             _budget()   # raises DeadlineExceeded if the CLIENT budget died
@@ -157,6 +163,13 @@ class _Router:
             else:
                 b.record_failure()
             now_state = b.state
+            if latency_s is not None and ok:
+                # load-aware routing input: smoothed per-replica call
+                # latency (failures excluded — the breaker handles
+                # sick replicas; this steers load among healthy ones)
+                e = self.ewma_s.get(rid)
+                self.ewma_s[rid] = (latency_s if e is None
+                                    else e + 0.2 * (latency_s - e))
         if now_state == was:
             return
         tags = {"replica": rid.hex()}
@@ -213,8 +226,22 @@ class _Router:
             with self.lock:
                 self._probing.discard(rid)
 
+    def _score(self, rid: bytes) -> float:
+        """Expected queued work on one replica: (in-flight + 1) x its
+        EWMA call latency. A replica with no latency sample yet scores
+        at the mean of known peers — a fresh autoscaled replica is
+        then the cheapest choice at in-flight 0 and actually absorbs
+        load, instead of competing on counts alone against warmed-up
+        peers. Callers hold self.lock."""
+        e = self.ewma_s.get(rid)
+        if e is None:
+            e = (sum(self.ewma_s.values()) / len(self.ewma_s)
+                 if self.ewma_s else 1.0)
+        return (self.inflight.get(rid, 0) + 1) * e
+
     def pick(self, model_id: Optional[str] = None) -> bytes:
-        """Power-of-two-choices by local in-flight counts. With a
+        """Power-of-two-choices over expected work — in-flight counts
+        weighted by per-replica EWMA latency (_score). With a
         multiplexed model id, replicas that already hold the model are
         preferred (p2c among them); a cold model falls through to plain
         p2c and the chosen replica loads it. Breaker-ejected replicas
@@ -253,9 +280,8 @@ class _Router:
             return reps[0]
         a, b = random.sample(reps, 2)
         with self.lock:
-            ia = self.inflight.get(a, 0)
-            ib = self.inflight.get(b, 0)
-        return a if ia <= ib else b
+            sa, sb = self._score(a), self._score(b)
+        return a if sa <= sb else b
 
     def track(self, rid: bytes, ref) -> None:
         with self.lock:

@@ -48,6 +48,14 @@ class LLMConfig:
     # models larger than one chip's HBM serve (reference:
     # llm_config.py:181-186 tensor_parallel_size)
     tensor_parallel: int = 1
+    # Paged KV cache (llm/kvcache.py): None = the Config knobs
+    # (kvcache_block_size / kvcache_pool_blocks /
+    # kvcache_prefix_cache); 0 blocks = monolithic cache. Prefix reuse
+    # is what makes a shared system prompt cheap: requests sharing
+    # cached prefix blocks skip prefill for them.
+    kv_block_size: Optional[int] = None
+    kv_pool_blocks: Optional[int] = None
+    prefix_cache: Optional[bool] = None
 
 
 def _serving_mesh(tensor_parallel: int):
@@ -97,7 +105,10 @@ class _LLMServer:
             max_len=cfg.max_len, prefill_buckets=cfg.prefill_buckets,
             cache_dtype=cfg.cache_dtype,
             steps_per_sync=cfg.steps_per_sync, seed=cfg.seed,
-            mesh=_serving_mesh(cfg.tensor_parallel))
+            mesh=_serving_mesh(cfg.tensor_parallel),
+            kv_block_size=cfg.kv_block_size,
+            kv_pool_blocks=cfg.kv_pool_blocks,
+            prefix_cache=cfg.prefix_cache)
 
     async def generate(self, tokens, max_new_tokens: int = 64,
                        temperature: float = 0.0,
@@ -187,7 +198,8 @@ class _PrefillServer:
         model_cfg, params = _load_model(cfg)
         self.engine = PrefillEngine(
             model_cfg, params, prefill_buckets=cfg.prefill_buckets,
-            max_len=cfg.max_len, cache_dtype=cfg.cache_dtype)
+            max_len=cfg.max_len, cache_dtype=cfg.cache_dtype,
+            block_size=cfg.kv_block_size)
 
     async def prefill(self, tokens) -> dict:
         import asyncio

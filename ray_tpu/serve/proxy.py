@@ -184,8 +184,9 @@ class HTTPProxy:
         self._m = proxy_metrics()
         self._fm = fault.fault_metrics()
         self._adm: Dict[str, _Admission] = {}
-        # cached head health snapshot for the shed advisory (log-only
-        # for now; the actuation hook for ROADMAP item 3's autoscaler)
+        # cached head health snapshot for the shed advisory — the
+        # autoscaler's FAST PATH: a shed while the budget burns fires
+        # an autoscale_hint RPC at the controller (serve/autoscale.py)
         self._health_advice = {"ts": 0.0, "state": None}
 
     def _admission(self, dep: str) -> _Admission:
@@ -423,13 +424,12 @@ class HTTPProxy:
             if dep:
                 self._m["requests"].inc(
                     tags={"deployment": dep, "code": "503"})
-                # Health-plane advisory (LOG-ONLY for now): a shed
-                # while the deployment's availability/latency budget is
-                # already burning is exactly the moment SLO-driven
-                # replica autoscaling (ROADMAP item 3) would scale out.
-                # The actuation hook is the head's `health_state`
-                # burn_advice map this consults — an autoscaler swaps
-                # the log line below for a scale-up RPC.
+                # Health-plane actuation: a shed while the deployment's
+                # availability/latency budget is already burning is
+                # exactly the moment SLO-driven replica autoscaling
+                # scales out — _consult_health fires the controller's
+                # autoscale_hint RPC (the fast path; the controller's
+                # own burn-advice fetch is the slow path).
                 try:
                     asyncio.ensure_future(self._consult_health(dep))
                 except RuntimeError:
@@ -459,11 +459,15 @@ class HTTPProxy:
                              headers=hdrs or None)
 
     async def _consult_health(self, dep: str) -> None:
-        """Log-only advisory off the cluster health plane: fetch (and
-        briefly cache) the head's SLO snapshot; when the deployment's
-        availability or latency budget is burning, say so next to the
-        shed decision. Never raises — an unreachable head or a
-        disabled plane silently skips the advisory."""
+        """The autoscaler's fast-path signal off the cluster health
+        plane: fetch (and briefly cache) the head's SLO snapshot; when
+        the deployment's availability or latency budget is burning,
+        fire ONE autoscale_hint RPC at the serve controller per cache
+        window (serve/autoscale.py treats it as a page-tier signal —
+        the scale-up doesn't wait for the controller's own advice
+        fetch) and log next to the shed decision. Never raises — an
+        unreachable head/controller or a disabled plane silently skips
+        the actuation; the controller's slow path still scales."""
         try:
             cache = self._health_advice
             now = time.monotonic()
@@ -481,18 +485,47 @@ class HTTPProxy:
             if adv and (adv.get("availability_burning")
                         or adv.get("latency_burning")) \
                     and now - cache.get("logged_ts", 0.0) > 5.0:
-                # one advisory line per cache window, not one per
-                # shed — a shed storm must not also be a log storm
+                # one hint + one log line per cache window, not one
+                # per shed — a shed storm must not also be a hint/log
+                # storm (the hint is level-triggered at the receiver)
                 cache["logged_ts"] = now
+                # log BEFORE the hint RPC: when the controller is the
+                # thing that's down, the operator's only
+                # shedding-while-burning signal must still appear
                 _log.warning(
                     "serve[%s]: shedding while the %s-tier SLO budget "
-                    "is burning (availability=%s latency=%s) — replica "
-                    "scale-out would relieve this (autoscaler hook, "
-                    "ROADMAP item 3)", dep, adv.get("tier") or "?",
+                    "is burning (availability=%s latency=%s) — "
+                    "sending autoscale_hint (serve/autoscale.py "
+                    "scales out within its cooldown)", dep,
+                    adv.get("tier") or "?",
                     adv.get("availability_burning"),
                     adv.get("latency_burning"))
+                await self._send_autoscale_hint(
+                    dep, adv.get("tier") or "page")
         except Exception:  # noqa: BLE001 — advisory only
             pass
+
+    async def _send_autoscale_hint(self, dep: str, tier: str) -> None:
+        """One scale-up hint to the serve controller. The result ref
+        is awaited and freed — a long-lived proxy must not accumulate
+        one un-fetched store entry per hint window (same rule as the
+        streaming path's per-token free)."""
+        from ray_tpu.serve.handle import CONTROLLER_NAME, SERVE_NAMESPACE
+        ctx = api._g.ctx
+        info = await ctx.pool.call(ctx.head_addr, "get_named_actor",
+                                   name=CONTROLLER_NAME,
+                                   namespace=SERVE_NAMESPACE)
+        if not info or info.get("state") == "DEAD":
+            return
+        refs = await ctx.submit_actor_call(
+            info["actor_id"], "autoscale_hint", (dep, tier), {})
+        try:
+            await ctx.get(refs[0], 2.0)
+        finally:
+            try:
+                await ctx.free(refs)
+            except Exception:
+                pass
 
     async def _dispatch(self, writer, method, path, headers, body):
         self._requests += 1

@@ -224,6 +224,33 @@ async def _serve(fetch: Fetch, query: str = "") -> bytes:
             + _table(("deployment", "alive replicas", "replicas"), rows)
             + "<h2>control plane</h2>"
             + _table(("actor", "state", "node"), prows))
+    # SLO autoscaler actuation (serve/autoscale.py): last replica
+    # target + recent decisions per deployment off the head
+    # time-series store (absent when the health plane is off or
+    # nothing autoscales)
+    try:
+        arows = []
+        for dep in sorted(deps):
+            sel = {"deployment": dep}
+            reps = await fetch("query_series",
+                               name="serve_autoscale_replicas",
+                               since_s=900.0, labels=sel)
+            pts = (reps or {}).get("points") or []
+            if not pts:
+                continue
+            decs = await fetch("query_series",
+                               name="serve_autoscale_decisions_total",
+                               since_s=900.0, labels=sel)
+            n_dec = sum(p.get("inc", 0)
+                        for p in (decs or {}).get("points") or [])
+            arows.append((_esc(dep), str(int(pts[-1].get("value", 0))),
+                          str(int(n_dec))))
+        if arows:
+            body += ("<h2>slo autoscaler</h2>"
+                     + _table(("deployment", "target replicas",
+                               "decisions (15m)"), arows))
+    except Exception:
+        pass
     return _page("serve", body)
 
 

@@ -55,9 +55,12 @@ from typing import Deque, Dict, List
 #   ckpt        durable checkpoint plane (train/ckptio.py): manifest
 #               commits, restores, preemption-notice flushes — rare,
 #               but a crash-looping saver must age against itself
+#   serve       serve control-plane actuation: SLO autoscale decisions
+#               (serve/autoscale.py) — instants on a "serve" timeline
+#               lane next to the health alerts that triggered them
 CATEGORIES = ("trace", "collective", "train", "worker", "cgroup",
               "memory", "request", "device", "device_window",
-              "pipeline", "health", "ckpt")
+              "pipeline", "health", "ckpt", "serve")
 
 _DEFAULT_CAP = 65536
 # Dedicated sub-budgets: the key also names the bucket. Everything
@@ -88,7 +91,11 @@ _CATEGORY_CAPS: Dict[str, int] = {"collective": 16384, "train": 4096,
                                   # one commit span per save interval
                                   # — but a tight-loop saver (bench,
                                   # chaos) must age against itself
-                                  "ckpt": 2048}
+                                  "ckpt": 2048,
+                                  # scale decisions are rare, but a
+                                  # misconfigured (thrashing) loop
+                                  # must thrash against its own budget
+                                  "serve": 2048}
 
 _BUFS: Dict[str, Deque[dict]] = {}
 _LOCK = threading.Lock()

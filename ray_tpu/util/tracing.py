@@ -351,6 +351,9 @@ _HEALTH_ARGS = ("objective", "tier", "state", "kind", "metric",
                 "burn_short", "burn_long", "deployment", "trace",
                 "sentinel", "stat", "live", "baseline", "tolerance")
 
+_SERVE_ARGS = ("deployment", "direction", "reason", "target",
+               "prev_target", "running", "ongoing", "util")
+
 
 def to_chrome(evs: List[dict], path: Optional[str] = None,
               clock_offsets: Optional[dict] = None,
@@ -511,6 +514,18 @@ def to_chrome(evs: List[dict], path: Optional[str] = None,
                         "ts": adj_us(e, e["ts"]), "s": "g",
                         "pid": node_pid, "tid": "health",
                         "args": {k: e[k] for k in _HEALTH_ARGS
+                                 if e.get(k) is not None}})
+        elif cat == "serve":
+            # autoscale actuation instants (serve/autoscale.py) on a
+            # "serve" lane — a scale-up sits in the same timeline as
+            # the page-tier alert (health lane) that triggered it
+            out.append({"ph": "I", "cat": "serve",
+                        "name": f"autoscale:{e.get('deployment', '?')}"
+                                f":{e.get('direction', '?')}"
+                                f"->{e.get('target', '?')}",
+                        "ts": adj_us(e, e["ts"]), "s": "g",
+                        "pid": node_pid, "tid": "serve",
+                        "args": {k: e[k] for k in _SERVE_ARGS
                                  if e.get(k) is not None}})
         elif cat == "collective":
             ts_us = adj_us(e, e["ts"])

@@ -90,12 +90,14 @@ def instantiate_all() -> dict:
 
     from ray_tpu.runtime import core
     take(core._M_TASKS())
-    from ray_tpu.llm import engine
+    from ray_tpu.llm import engine, kvcache
     take(engine.engine_metrics())
-    from ray_tpu.serve import fault, proxy, replica
+    take(kvcache.kvcache_metrics())
+    from ray_tpu.serve import autoscale, fault, proxy, replica
     take(proxy.proxy_metrics())
     take(replica.replica_metrics())
     take(fault.fault_metrics())
+    take(autoscale.autoscale_metrics())
     from ray_tpu.dag import ring
     take(ring.allreduce_metrics())
     from ray_tpu.train import zero
@@ -184,9 +186,14 @@ DEVICE_METRIC_PREFIXES = ("device_", "xla_", "llm_kv_")
 HEALTH_METRIC_PREFIXES = ("health_", "slo_")
 # ``ckpt_`` came with the durable checkpoint plane (train/ckptio.py).
 CKPT_METRIC_PREFIXES = ("ckpt_",)
+# ``serve_autoscale_`` is the SLO autoscaler's actuation family
+# (serve/autoscale.py); ``llm_kv_`` (above) extends over the paged KV
+# cache's block gauges/counters (llm/kvcache.py).
+SERVE_METRIC_PREFIXES = ("serve_autoscale_",)
 METRIC_FAMILY_PREFIXES = (DEVICE_METRIC_PREFIXES
                           + HEALTH_METRIC_PREFIXES
-                          + CKPT_METRIC_PREFIXES)
+                          + CKPT_METRIC_PREFIXES
+                          + SERVE_METRIC_PREFIXES)
 
 # prefixed literals that are NOT metric names: control RPC method
 # names etc. (Config knob names are exempted wholesale below — the
@@ -277,6 +284,12 @@ KNOB_FAMILIES = {
     # preemption-aware shutdown: the SIGTERM grace window
     # (runtime/worker.py + ckptio preemption hooks)
     "preempt": ("preempt_", ""),
+    # paged KV cache: block size, pool sizing, prefix-reuse switch
+    # (llm/kvcache.py + llm/engine.py paged mode)
+    "kvcache": ("kvcache_", ""),
+    # SLO-driven replica autoscaling: interval, cooldown, step,
+    # utilization deadband (serve/autoscale.py)
+    "autoscale": ("serve_autoscale_", ""),
 }
 
 

@@ -1,0 +1,220 @@
+"""Serve SLO benchmark: sustained req/s at a TTFT/TPOT SLO with a
+shared-system-prompt workload, prefix cache ON vs OFF.
+
+The production-serving acceptance bench for the paged KV cache
+(llm/kvcache.py): every request carries the SAME system prompt plus a
+unique user suffix — the workload millions-of-users serving actually
+sees. With prefix reuse on, the shared blocks' prefill is skipped
+(hit tokens reported per request), so client-measured TTFT drops while
+sustained req/s holds. Results land under the ``slo`` key of
+SERVE_BENCH.json.
+
+Run from the repo root: python scripts/serve_slo_bench.py
+(CPU-friendly; pass --model bench340m on a real TPU box).
+"""
+
+import argparse
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def _one_request(addr, route, prompt, max_new, deadline_s, out, idx):
+    t0 = time.monotonic()
+    try:
+        conn = http.client.HTTPConnection(addr["host"], addr["port"],
+                                          timeout=deadline_s + 30)
+        conn.request(
+            "POST", route,
+            body=json.dumps({"tokens": prompt,
+                             "max_new_tokens": max_new}),
+            headers={"Content-Type": "application/json",
+                     "Accept": "text/event-stream",
+                     "X-Request-Deadline": str(deadline_s)})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            resp.read()
+            conn.close()
+            out[idx] = {"error": resp.status}
+            return
+        ttft = None
+        n_tokens = 0
+        buf = b""
+        while True:
+            chunk = resp.read(1)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if line.startswith(b"data: ") and b"token" in line:
+                    if ttft is None:
+                        ttft = time.monotonic() - t0
+                    n_tokens += 1
+        conn.close()
+        total = time.monotonic() - t0
+        out[idx] = {
+            "ttft_s": ttft, "tokens": n_tokens, "total_s": total,
+            "tpot_s": ((total - ttft) / max(1, n_tokens - 1)
+                       if ttft is not None else None)}
+    except Exception as e:  # noqa: BLE001 — a failed req is a row
+        out[idx] = {"error": f"{type(e).__name__}: {e}"}
+
+
+def _drive(addr, route, prompts, max_new, concurrency, deadline_s):
+    out = [None] * len(prompts)
+    t0 = time.monotonic()
+    sem = threading.Semaphore(concurrency)
+    threads = []
+
+    def run(i):
+        try:
+            _one_request(addr, route, prompts[i], max_new, deadline_s,
+                         out, i)
+        finally:
+            sem.release()
+
+    for i in range(len(prompts)):
+        sem.acquire()
+        t = threading.Thread(target=run, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=deadline_s + 60)
+    wall = time.monotonic() - t0
+    ok = [r for r in out if r and "error" not in r
+          and r.get("ttft_s") is not None]
+    errors = len(prompts) - len(ok)
+    ttfts = sorted(r["ttft_s"] for r in ok)
+    tpots = sorted(r["tpot_s"] for r in ok if r["tpot_s"] is not None)
+
+    def pct(v, p):
+        return round(float(v[min(len(v) - 1,
+                                 int(p * len(v)))]) * 1000, 1) \
+            if v else None
+    toks = sum(r["tokens"] for r in ok)
+    return {
+        "requests": len(prompts), "ok": len(ok), "errors": errors,
+        "wall_s": round(wall, 2),
+        "req_s": round(len(ok) / wall, 2),
+        "throughput_tok_s": round(toks / wall, 1),
+        "ttft_p50_ms": pct(ttfts, 0.50),
+        "ttft_p95_ms": pct(ttfts, 0.95),
+        "tpot_p50_ms": pct(tpots, 0.50),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=60)
+    ap.add_argument("--concurrency", type=int, default=6)
+    ap.add_argument("--system-prompt-len", type=int, default=256)
+    ap.add_argument("--user-len", type=int, default=16)
+    ap.add_argument("--max-new", type=int, default=24)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--ttft-slo-ms", type=float, default=2000.0)
+    ap.add_argument("--tpot-slo-ms", type=float, default=250.0)
+    ap.add_argument("--deadline-s", type=float, default=60.0)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMConfig, build_llm_deployment
+
+    overrides = dict(vocab_size=512, dim=256, n_layers=4, n_heads=8,
+                     n_kv_heads=4, ffn_dim=512, dtype="float32",
+                     logits_dtype="float32", attn_impl="reference")
+    rng = np.random.default_rng(0)
+    system = [int(x) for x in rng.integers(1, 500,
+                                           args.system_prompt_len)]
+    prompts = [system + [int(x) for x in rng.integers(1, 500,
+                                                      args.user_len)]
+               for _ in range(args.requests)]
+
+    ray_tpu.init(num_cpus=4)
+    results = {}
+    stats = {}
+    try:
+        for mode, prefix_on in (("prefix_off", False),
+                                ("prefix_on", True)):
+            name = f"slo_{mode}"
+            cfg = LLMConfig(
+                model="tiny", model_overrides=overrides,
+                max_slots=args.slots,
+                max_len=1024, prefill_buckets=(64, 256, 512),
+                steps_per_sync=8, prefix_cache=prefix_on)
+            h = serve.run(build_llm_deployment(cfg, name=name),
+                          name=f"app_{name}",
+                          route_prefix=f"/{name}")
+            addr = serve.proxy_address()
+            # warmup: compile prefill buckets + decode variants, and
+            # (prefix_on) seed the shared prefix into the cache
+            _drive(addr, f"/{name}", prompts[:2], args.max_new, 1,
+                   args.deadline_s)
+            results[mode] = _drive(addr, f"/{name}", prompts,
+                                   args.max_new, args.concurrency,
+                                   args.deadline_s)
+            stats[mode] = ray_tpu.get(h.stats.remote(), timeout=30)
+            print(f"# {mode}: {json.dumps(results[mode])}",
+                  file=sys.stderr)
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+    on, off = results["prefix_on"], results["prefix_off"]
+    hit = stats["prefix_on"].get("prefix_hit_tokens", 0)
+    doc = {
+        "what": ("sustained req/s at a TTFT/TPOT SLO, shared-system-"
+                 "prompt workload (every request: one shared "
+                 f"{args.system_prompt_len}-token system prompt + a "
+                 f"unique {args.user_len}-token user suffix), paged "
+                 "KV prefix cache on vs off"),
+        "slo": {"ttft_ms": args.ttft_slo_ms,
+                "tpot_ms": args.tpot_slo_ms},
+        "prefix_off": off,
+        "prefix_on": on,
+        "prefix_hit_tokens_total": int(hit),
+        "ttft_p50_x": (round(on["ttft_p50_ms"] / off["ttft_p50_ms"], 3)
+                       if on.get("ttft_p50_ms") and
+                       off.get("ttft_p50_ms") else None),
+        "req_s_x": (round(on["req_s"] / off["req_s"], 3)
+                    if off.get("req_s") else None),
+        "meets_slo": {
+            m: bool(r.get("ttft_p95_ms") is not None
+                    and r["ttft_p95_ms"] <= args.ttft_slo_ms
+                    and (r.get("tpot_p50_ms") is None
+                         or r["tpot_p50_ms"] <= args.tpot_slo_ms))
+            for m, r in results.items()},
+        "device": os.environ.get("JAX_PLATFORMS", "tpu"),
+        "config": {"requests": args.requests,
+                   "concurrency": args.concurrency,
+                   "slots": args.slots, "max_new": args.max_new},
+    }
+    print(json.dumps(doc, indent=1))
+    path = "SERVE_BENCH.json"
+    try:
+        with open(path) as f:
+            bench = json.load(f)
+    except Exception:
+        bench = {}
+    bench["slo"] = doc
+    with open(path, "w") as f:
+        json.dump(bench, f, indent=1)
+        f.write("\n")
+    print(f"# wrote {path} slo key", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

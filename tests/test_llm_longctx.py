@@ -37,9 +37,12 @@ def _engine(cfg, params, **kw):
 
 
 def test_cache_starts_small_and_grows_in_buckets():
+    """The MONOLITHIC cache's bucketed growth (kv_block_size=0 — the
+    fallback mode; the paged default bounds HBM by live blocks
+    instead, covered by tests/test_zz_kvcache.py)."""
     cfg = _tiny()
     eng = _engine(cfg, _params(cfg), max_len=8192,
-                  prefill_buckets=(64, 128, 256))
+                  prefill_buckets=(64, 128, 256), kv_block_size=0)
     assert eng._cache_len == 1024          # not 8192 up front
     assert eng.stats["cache_len"] == 1024
 
