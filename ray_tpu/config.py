@@ -139,10 +139,10 @@ class Config:
     # Token-block size of the engine's paged KV cache. The serving
     # default: fixed-size blocks from a preallocated pool, per-request
     # block tables, ref-counted prefix reuse for shared system
-    # prompts. 0 selects the legacy monolithic slot cache (bucketed
-    # doubling growth); tensor-parallel engines always use the
-    # monolithic cache. The effective size is gcd-adjusted to divide
-    # every prefill bucket and max_len.
+    # prompts — tensor-parallel engines included (the pool shards its
+    # kv-head dim over the mesh). 0 selects the legacy monolithic slot
+    # cache (bucketed doubling growth). The effective size is
+    # gcd-adjusted to divide every prefill bucket and max_len.
     kvcache_block_size: int = 16
     # Pool size in blocks (0 = auto: worst case — every slot at
     # max_len — plus one chain of prefix-cache headroom, capped at
@@ -153,6 +153,20 @@ class Config:
     # adopts those blocks ref-counted and prefills only its suffix.
     # Off: blocks free immediately at request finish.
     kvcache_prefix_cache: bool = True
+    # Paged decode attention impl: "paged_flash" walks each slot's
+    # block table directly in the pallas kernel
+    # (ops/pallas/paged_attention.py — no gathered (slots, max_len)
+    # view, no O(slots x max_len x layers) HBM copy per token);
+    # "gather" materializes the view per layer (the debug/parity
+    # path); "auto" = paged_flash on a real TPU backend, gather
+    # elsewhere. Engines also take this per-instance via
+    # LLMEngine(kv_impl=...).
+    paged_attn_impl: str = "auto"
+    # Force the pallas interpreter for the paged-flash kernel (it is
+    # forced automatically off-TPU so kv_impl="paged_flash" still runs
+    # the real kernel logic under JAX_PLATFORMS=cpu; the knob exists
+    # to debug kernel/compiler divergence ON a TPU).
+    paged_attn_interpret: bool = False
 
     # --- serve fault tolerance ---
     # Default per-request deadline budget (seconds) when the client

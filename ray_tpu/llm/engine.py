@@ -149,6 +149,7 @@ class LLMEngine:
                  kv_block_size: Optional[int] = None,
                  kv_pool_blocks: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
+                 kv_impl: Optional[str] = None,
                  detokenize: Optional[Callable[[List[int]], str]] = None):
         """With ``mesh``, the engine runs TENSOR-PARALLEL: params shard
         per lm.serve_param_specs (Megatron layout), the KV cache shards
@@ -185,11 +186,13 @@ class LLMEngine:
         self.detokenize = detokenize
         # Paged KV (llm/kvcache.py) is the default serving cache:
         # fixed-size token blocks from a preallocated pool, per-request
-        # block tables, and prefix reuse for shared system prompts.
-        # kv_block_size=0 selects the legacy MONOLITHIC cache (bucketed
-        # doubling growth); tensor-parallel engines always use it (the
-        # paged gather/scatter is not yet shard_map'd over the mesh).
-        # None reads the Config knobs (kvcache_block_size etc.).
+        # block tables, and prefix reuse for shared system prompts —
+        # tensor-parallel engines included (the pool shards its kv-head
+        # dim over the mesh; the block-index ops and the decode
+        # attention are head-local, so tables stay replicated and no
+        # collective is added). kv_block_size=0 selects the legacy
+        # MONOLITHIC cache (bucketed doubling growth). None reads the
+        # Config knobs (kvcache_block_size etc.).
         from ray_tpu.config import get_config
         _cfg = get_config()
         if kv_block_size is None:
@@ -199,9 +202,21 @@ class LLMEngine:
         if prefix_cache is None:
             prefix_cache = bool(getattr(_cfg, "kvcache_prefix_cache",
                                         True))
-        self._paged = kv_block_size > 0 and mesh is None
+        if kv_impl is None:
+            kv_impl = str(getattr(_cfg, "paged_attn_impl", "auto"))
+        self._paged = kv_block_size > 0
         self._kvm = kvcache.kvcache_metrics()
         if self._paged:
+            from ray_tpu.ops.attention import _on_tpu
+            # decode attention impl: the fused block-table kernel
+            # (paged_flash) vs the materialized gather view; "auto"
+            # resolves by backend. Off-TPU the kernel runs through the
+            # pallas interpreter — tier-1 exercises the real table
+            # walk, not a shadow path.
+            self._kv_impl = kvcache.resolve_attn_impl(kv_impl)
+            self._kv_interpret = bool(
+                getattr(_cfg, "paged_attn_interpret", False)) or (
+                    self._kv_impl == "paged_flash" and not _on_tpu())
             # effective block size must divide every prefill bucket
             # and max_len (prefill writes land block-aligned): shrink
             # to the gcd instead of erroring on small test buckets
@@ -218,6 +233,23 @@ class LLMEngine:
             self._cache_len = max_len     # no growth: tables span it
             self._pool = kvcache.init_pool(cfg, nb, self._block,
                                            jnp.dtype(cache_dtype))
+            if mesh is not None:
+                # pool shards its kv-head dim (Megatron layout, same
+                # axis as the monolithic cache); block ids index dim 1,
+                # orthogonal to the shard, so scatter/gather/copy jits
+                # run under GSPMD unchanged
+                from jax.sharding import NamedSharding, PartitionSpec \
+                    as P
+                s = NamedSharding(
+                    mesh, P(None, None, None, tensor_axis, None))
+                self._pool = {k: jax.device_put(v, s)
+                              for k, v in self._pool.items()}
+            # what one decode step would have copied materializing the
+            # gathered (slots, table_w * block) view, per layer and
+            # k+v — the bytes the fused kernel keeps out of HBM
+            self._gather_step_bytes = (
+                max_slots * self._table_w
+                * kvcache.pool_block_bytes(self._pool))
             self._kv = kvcache.KVBlockManager(
                 nb, self._block, table_width=self._table_w,
                 prefix_cache=prefix_cache, metrics=self._kvm)
@@ -272,7 +304,8 @@ class LLMEngine:
                        blocks_used=self._kv.used_blocks(),
                        blocks_cached=self._kv.cached_blocks(),
                        blocks_free=self._kv.free_blocks(),
-                       prefix_hit_tokens=self._kv.hit_tokens_total)
+                       prefix_hit_tokens=self._kv.hit_tokens_total,
+                       kv_impl=self._kv_impl)
         return out
 
     def _kv_per_token_bytes(self) -> float:
@@ -630,11 +663,19 @@ class LLMEngine:
                 # one span per decode BLOCK, linked to every member
                 # trace: the block is shared compute, so it belongs to
                 # all of them rather than to one (each member's
-                # waterfall pulls it in via the links)
+                # waterfall pulls it in via the links). The span also
+                # names the attention impl the block ran and the HBM
+                # copy bytes the fused kernel avoided — the trace
+                # answers "which decode path was this" directly.
+                kv_impl = self._kv_impl if self._paged else "monolithic"
+                avoided = (block * self._gather_step_bytes
+                           if self._paged
+                           and self._kv_impl == "paged_flash" else 0)
                 tracing.record_batch_span(
                     "engine", "decode", member_traces,
                     t_dec_wall, time.time(), block=block,
-                    slots=len(active))
+                    slots=len(active), kv_impl=kv_impl,
+                    gather_bytes_avoided=avoided)
                 # the same interval is a device-compute window (the
                 # decode block is block_until_ready-bounded by the
                 # host transfer of its sampled tokens)
@@ -792,6 +833,29 @@ class LLMEngine:
         span = self._table_w * self._block
         return ((span + chunk - 1) // chunk) * chunk + chunk
 
+    def _prefill_start(self, hit: int) -> int:
+        """First position the suffix prefill computes for a
+        ``hit``-token prefix hit. On a flash-capable chunked-prefill
+        path the start rounds DOWN to the chunk grid: every piece then
+        sits at a chunk-multiple offset and enters the per-offset
+        COMPILED flash variants (bounded: ceil(max_len/chunk)
+        compiles) instead of minting a fresh compile per distinct hit
+        length — or falling to the dynamic-offset XLA path. The
+        recomputed rows (< one chunk) land in full hit blocks, whose
+        scatter targets are already trash, and recomputation is
+        bitwise-identical to the cached values (same chunk grid a cold
+        request ran), so reuse accounting and parity are untouched."""
+        if hit == 0:
+            return 0
+        from ray_tpu.ops.attention import _on_tpu
+        impl = lm._serve_attn_impl(self.cfg)
+        flashy = impl in ("flash", "flash_interpret") or (
+            impl == "auto" and _on_tpu())
+        if not flashy:
+            return hit
+        chunk = self.buckets[-1]
+        return (hit // chunk) * chunk
+
     def _admit_paged(self, slot: int, r: _Request) -> int:
         """Paged prefill: the scheduler already reserved the block
         table (r.kv_alloc); write the prompt's KV through it. Three
@@ -869,7 +933,7 @@ class LLMEngine:
         acc_len = self._acc_len()
         acc = kvcache.gather_table(self._pool, jnp.asarray(table),
                                    acc_len)
-        off = hit
+        off = self._prefill_start(hit)
         logits = None
         while off < n:
             end = min(n, ((off // chunk) + 1) * chunk)
@@ -986,7 +1050,14 @@ class LLMEngine:
             out, self._pool = kvcache.paged_decode_steps(
                 self.params, self._pool, jnp.asarray(self._tables),
                 jnp.asarray(lengths), jnp.asarray(tokens),
-                jnp.asarray(temps), key, self.cfg, block, tp, tk)
+                jnp.asarray(temps), key, self.cfg, block, tp, tk,
+                impl=self._kv_impl, interpret=self._kv_interpret,
+                mesh=self.mesh, axis=self.tensor_axis)
+            self._kvm["attn_steps"].inc(
+                block, tags={"impl": self._kv_impl})
+            if self._kv_impl == "paged_flash":
+                self._kvm["gather_avoided"].inc(
+                    block * self._gather_step_bytes)
             return np.asarray(out)
         out, self._cache = lm.decode_steps(
             self.params, self._cache, jnp.asarray(tokens),

@@ -61,6 +61,11 @@ def kvcache_metrics() -> dict:
                                   skipped via a prefix-cache hit
       llm_kv_handoff_bytes_total  KV bytes shipped prefill->decode at
                                   block granularity (llm/pd.py)
+      llm_paged_attn_steps_total  paged decode steps by attention impl
+                                  ({impl}: paged_flash | gather)
+      llm_kv_gather_bytes_avoided_total
+                                  HBM bytes the fused kernel did NOT
+                                  copy materializing the gathered view
     """
     from ray_tpu.util import metrics as m
     return {
@@ -83,6 +88,17 @@ def kvcache_metrics() -> dict:
             "llm_kv_handoff_bytes_total",
             "KV bytes shipped prefill->decode at block granularity "
             "in the disaggregated path"),
+        "attn_steps": m.Counter(
+            "llm_paged_attn_steps_total",
+            "Paged decode steps taken, tagged by attention impl "
+            "(paged_flash = fused block-table kernel, gather = "
+            "materialized view)",
+            tag_keys=("impl",)),
+        "gather_avoided": m.Counter(
+            "llm_kv_gather_bytes_avoided_total",
+            "HBM bytes the fused paged-attention kernel avoided "
+            "copying versus materializing the gathered "
+            "(slots, max_len) attention view every decode step"),
     }
 
 
@@ -461,14 +477,25 @@ def auto_pool_blocks(slots: int, table_width: int, block_bytes: int,
     return base + 1     # + trash block
 
 
-_JITS: dict = {}    # name -> jitted callable, built once per process
+_JITS: dict = {}    # (op, pool geometry, dtype) -> jitted callable
 
 
-def _jit(name: str):
+def _pool_key(pool: dict) -> tuple:
+    """Cache-key component identifying one pool's compiled geometry."""
+    return (tuple(pool["k"].shape), str(pool["k"].dtype))
+
+
+def _jit(name: str, pool: dict):
     """Build-once cache for the jitted device ops: jax must not be
     imported at module import time (the engine's lazy-import rule),
-    and a fresh jax.jit wrapper per call would retrace every call."""
-    fn = _JITS.get(name)
+    and a fresh jax.jit wrapper per call would retrace every call.
+    Keyed on (op, pool geometry, dtype) — NOT op name alone: one
+    process serving two model configs (two replicas, a debug engine
+    next to a prod one) must not replay a callable whose donated
+    buffers and reshape constants were traced for the other pool's
+    shape."""
+    key = (name, *_pool_key(pool))
+    fn = _JITS.get(key)
     if fn is not None:
         return fn
     jax, jnp = _jx()
@@ -516,7 +543,7 @@ def _jit(name: str):
                     "v": pool["v"].at[:, dst].set(pool["v"][:, src])}
     else:
         raise KeyError(name)
-    _JITS[name] = fn
+    _JITS[key] = fn
     return fn
 
 
@@ -524,43 +551,80 @@ def scatter_bucket(pool: dict, kv: dict, phys, nb: int) -> dict:
     """Write a bucket-padded prefill's KV into ``nb`` physical blocks
     (pad-garbage blocks redirected to trash by the caller's phys).
     One compile per bucket size."""
-    return _jit("scatter_bucket")(pool, kv, phys, nb)
+    return _jit("scatter_bucket", pool)(pool, kv, phys, nb)
 
 
 def gather_table(pool: dict, phys, acc_len: int) -> dict:
     """Gather one block table's KV into a contiguous accumulator
     (layers, acc_len, kvh, hd) for chunked prefill over a cached
-    prefix. acc_len >= table_width * block_size (zero tail)."""
-    return _jit("gather_table")(pool, phys, acc_len)
+    prefix. acc_len >= table_width * block_size (zero tail). No
+    longer on the decode hot path — decode attends straight through
+    the table (ops/pallas/paged_attention.py); this stays for the
+    prefix-hit prefill accumulator and debug/parity tooling."""
+    return _jit("gather_table", pool)(pool, phys, acc_len)
 
 
 def scatter_table(pool: dict, acc: dict, phys) -> dict:
     """Write an accumulator back through a full-width physical target
     vector (shared-prefix and beyond-horizon slots point at trash so
     shared blocks are never written). One compile total."""
-    return _jit("scatter_table")(pool, acc, phys)
+    return _jit("scatter_table", pool)(pool, acc, phys)
 
 
 def copy_block(pool: dict, src: int, dst: int) -> dict:
     """Device-side block copy (the COW divergence path)."""
     _, jnp = _jx()
-    return _jit("copy_block")(pool, jnp.int32(src), jnp.int32(dst))
+    return _jit("copy_block", pool)(pool, jnp.int32(src),
+                                    jnp.int32(dst))
+
+
+def resolve_attn_impl(impl: str) -> str:
+    """Resolve the paged decode attention impl knob. ``auto`` picks
+    the fused block-table kernel on a real TPU backend and the gather
+    view elsewhere (CPU tier-1 still exercises the kernel explicitly
+    via impl='paged_flash' + interpret)."""
+    if impl not in ("auto", "paged_flash", "gather"):
+        raise ValueError(
+            f"paged attn impl must be auto|paged_flash|gather, "
+            f"got {impl!r}")
+    if impl == "auto":
+        from ray_tpu.ops.attention import _on_tpu
+        return "paged_flash" if _on_tpu() else "gather"
+    return impl
 
 
 def _paged_decode_core(params, pool, tables, lengths, tokens, temps,
-                       key, cfg, top_ps=None, top_ks=None):
+                       key, cfg, top_ps=None, top_ks=None, *,
+                       impl="gather", interpret=False, mesh=None,
+                       axis="tensor"):
     """One token for every slot against the paged pool. Runs
     lm.decode_token_core — the SAME transformer body as the monolithic
-    cache — with block-table write/gather plugged in: the gathered
-    (slots, W*bs, kvh, hd) view holds the same bytes in the same order
-    as the monolithic cache, so the attention math (and therefore the
-    sampled tokens) is bitwise identical (pinned by
-    tests/test_zz_kvcache.py parity tests)."""
+    cache — with block-table write/attend plugged in.
+
+    impl='gather': the attention view is materialized per layer as
+    ck[tables].reshape(b, W*bs, kvh, hd) — the gathered view holds the
+    same bytes in the same order as the monolithic cache, so the
+    attention math (and therefore the sampled tokens) is bitwise
+    identical (pinned by tests/test_zz_kvcache.py parity tests).
+
+    impl='paged_flash': the pallas kernel walks the block table
+    directly (ops/pallas/paged_attention.py) — no gathered view, no
+    O(slots x max_len x layers) copy per emitted token. Same f32
+    attention math; online softmax agrees with the gather path to f32
+    rounding (bitwise on the integer constructions
+    tests/test_zz_paged_attn.py pins).
+
+    With ``mesh``, the kernel path runs under shard_map: kv heads
+    sharded over ``axis``, block tables/lengths replicated — each
+    shard walks the same tables over its own head slice, no
+    collectives (the gather path needs nothing: GSPMD partitions the
+    plain-jnp view fine)."""
     jax, jnp = _jx()
     from ray_tpu.llm.model import decode_token_core
     b = tokens.shape[0]
     bs = pool["k"].shape[2]
     w = tables.shape[1]
+    kvh, hd = cfg.n_kv_heads, cfg.head_dim
     positions = lengths
     blk = jnp.clip(positions // bs, 0, w - 1)
     off = positions % bs
@@ -571,24 +635,56 @@ def _paged_decode_core(params, pool, tables, lengths, tokens, temps,
                 cv.at[phys, off].set(v.astype(cv.dtype)))
 
     def view(ck, cv):
-        return (ck[tables].reshape(b, w * bs, cfg.n_kv_heads,
-                                   cfg.head_dim),
-                cv[tables].reshape(b, w * bs, cfg.n_kv_heads,
-                                   cfg.head_dim))
+        return (ck[tables].reshape(b, w * bs, kvh, hd),
+                cv[tables].reshape(b, w * bs, kvh, hd))
+
+    attend = None
+    if impl == "paged_flash":
+        from ray_tpu.ops.pallas.paged_attention import paged_attention
+
+        def _kernel(qg, ck, cv, tb, ln):
+            return paged_attention(qg, ck, cv, tb, ln,
+                                   interpret=interpret)
+
+        def attend(q, ck, cv, pos):     # q: (b, h, hd)
+            g = cfg.n_heads // kvh
+            qg = q.reshape(b, kvh, g, hd)
+            if mesh is not None:
+                from jax.sharding import PartitionSpec as P
+                from ray_tpu.ops import shard_map
+                t = axis
+                fn = shard_map(
+                    _kernel, mesh,
+                    in_specs=(P(None, t, None, None),
+                              P(None, None, t, None),
+                              P(None, None, t, None), P(), P()),
+                    out_specs=P(None, t, None, None),
+                    check_vma=False)
+            else:
+                fn = _kernel
+            o = fn(qg, ck, cv, tables, pos + 1)
+            return o.reshape(b, cfg.n_heads * hd)
 
     out, nk, nv = decode_token_core(
         params, pool["k"], pool["v"], tokens, positions, temps, key,
-        cfg, write, view, top_ps, top_ks)
+        cfg, write, view, top_ps, top_ks, attend)
     return out, {"k": nk, "v": nv}
 
 
 def paged_decode_steps(params, pool, tables, lengths, tokens, temps,
-                       key, cfg, n: int, top_ps=None, top_ks=None):
+                       key, cfg, n: int, top_ps=None, top_ks=None, *,
+                       impl="gather", interpret=False, mesh=None,
+                       axis="tensor"):
     """n chained decode steps against the block pool in ONE dispatch —
     the paged twin of lm.decode_steps (same fold_in schedule, same
     block semantics; slots past their request produce discardable
-    garbage in the trash block)."""
-    fn = _JITS.get("paged_decode_steps")
+    garbage in the trash block). ``impl``/``interpret``/``mesh`` are
+    trace-time constants — each combination (x pool geometry) compiles
+    its own variant, cached in _JITS."""
+    impl = resolve_attn_impl(impl)
+    key_ = ("paged_decode_steps", *_pool_key(pool), impl,
+            bool(interpret), mesh, axis)
+    fn = _JITS.get(key_)
     if fn is None:
         jax, jnp = _jx()
         from jax import lax as _lax
@@ -601,11 +697,13 @@ def paged_decode_steps(params, pool, tables, lengths, tokens, temps,
                 pool, toks = carry
                 out, pool = _paged_decode_core(
                     params, pool, tables, lengths + i, toks, temps,
-                    jax.random.fold_in(key, i), cfg, top_ps, top_ks)
+                    jax.random.fold_in(key, i), cfg, top_ps, top_ks,
+                    impl=impl, interpret=interpret, mesh=mesh,
+                    axis=axis)
                 return (pool, out), out
             (pool, _), outs = _lax.scan(body, (pool, tokens),
                                         jnp.arange(n, dtype=jnp.int32))
             return outs, pool
-        _JITS["paged_decode_steps"] = fn
+        _JITS[key_] = fn
     return fn(params, pool, tables, lengths, tokens, temps, key,
               cfg, n, top_ps, top_ks)

@@ -368,7 +368,8 @@ def decode_token_core(params: dict, kcache: jax.Array,
                       key: jax.Array, cfg: LlamaConfig,
                       write, view,
                       top_ps: Optional[jax.Array] = None,
-                      top_ks: Optional[jax.Array] = None):
+                      top_ks: Optional[jax.Array] = None,
+                      attend=None):
     """THE decode-step transformer, shared by the monolithic slot
     cache and the paged block pool (llm/kvcache.py) so the two can
     never drift numerically — the paged engine's bitwise-parity
@@ -376,8 +377,12 @@ def decode_token_core(params: dict, kcache: jax.Array,
     cache layout is abstracted by two callables applied per layer:
     ``write(ck, cv, k, v) -> (ck, cv)`` appends the new token's KV
     (k/v: (slots, kvh, hd)); ``view(ck, cv) -> (vk, vv)`` yields the
-    (slots, L, kvh, hd) attention view. Returns (sampled tokens,
-    new kcache, new vcache)."""
+    (slots, L, kvh, hd) attention view. ``attend(q, ck, cv,
+    positions) -> (slots, h*hd) f32`` REPLACES the view +
+    _gqa_attend_cached pair when set — the paged-flash path computes
+    attention straight through the block table without ever
+    materializing the view (ops/pallas/paged_attention.py). Returns
+    (sampled tokens, new kcache, new vcache)."""
     x = jnp.take(params["embed"], tokens[:, None], axis=0)  # (b, 1, emb)
     rc, rs = _rope_tables(positions[:, None], cfg.head_dim,
                           cfg.rope_theta)
@@ -389,8 +394,11 @@ def decode_token_core(params: dict, kcache: jax.Array,
         q, k, v = _qkv(y, lp, cfg)  # (b, 1, ...)
         q, k = _rope(q, rc, rs), _rope(k, rc, rs)
         ck, cv = write(ck, cv, k[:, 0], v[:, 0])
-        vk, vv = view(ck, cv)
-        o = _gqa_attend_cached(q[:, 0], vk, vv, positions + 1, cfg)
+        if attend is not None:
+            o = attend(q[:, 0], ck, cv, positions)
+        else:
+            vk, vv = view(ck, cv)
+            o = _gqa_attend_cached(q[:, 0], vk, vv, positions + 1, cfg)
         x = x + (o.astype(x.dtype) @ lp["wo"])[:, None]
         y = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         x = x + ((jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"]))

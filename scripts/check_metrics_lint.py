@@ -188,8 +188,10 @@ HEALTH_METRIC_PREFIXES = ("health_", "slo_")
 CKPT_METRIC_PREFIXES = ("ckpt_",)
 # ``serve_autoscale_`` is the SLO autoscaler's actuation family
 # (serve/autoscale.py); ``llm_kv_`` (above) extends over the paged KV
-# cache's block gauges/counters (llm/kvcache.py).
-SERVE_METRIC_PREFIXES = ("serve_autoscale_",)
+# cache's block gauges/counters (llm/kvcache.py); ``llm_paged_`` is
+# the paged-attention decode family (kernel-vs-gather impl counters,
+# llm/kvcache.py + ops/pallas/paged_attention.py).
+SERVE_METRIC_PREFIXES = ("serve_autoscale_", "llm_paged_")
 METRIC_FAMILY_PREFIXES = (DEVICE_METRIC_PREFIXES
                           + HEALTH_METRIC_PREFIXES
                           + CKPT_METRIC_PREFIXES
@@ -290,6 +292,9 @@ KNOB_FAMILIES = {
     # SLO-driven replica autoscaling: interval, cooldown, step,
     # utilization deadband (serve/autoscale.py)
     "autoscale": ("serve_autoscale_", ""),
+    # paged-attention decode path: kernel-vs-gather impl selection and
+    # the pallas interpret override (ops/pallas/paged_attention.py)
+    "paged_attn": ("paged_attn_", ""),
 }
 
 
