@@ -298,6 +298,20 @@ class Config:
     # device-compute windows over.
     devmon_hbm_interval_s: float = 5.0
     devmon_duty_horizon_s: float = 30.0
+    # Goodput ledger (util/goodput.py): per-rank, per-step wall-time
+    # anatomy (compute / comm_exposed / bubble / ckpt_stall / compile
+    # / idle, summing exactly to step wall). "off" = every clock read
+    # removed (same discipline as collective_trace_level); "step" =
+    # one row per training step (default — a handful of perf_counter
+    # reads per step is noise against a step that moves MBs).
+    goodput_level: str = "step"
+    # Online straggler detection (train controller): robust z-score a
+    # rank's p50 (compute - comm_exposed) must clear against the
+    # ring's median/MAD before it is named in a "goodput"/"straggler"
+    # event + the goodput_straggler_rank gauge, and the rolling
+    # per-rank step window the p50s are taken over.
+    goodput_straggler_z: float = 6.0
+    goodput_straggler_window_steps: int = 32
 
     # --- durable checkpoint plane (train/ckptio.py) ---
     # How long the rank-0 commit coordinator waits for every rank's

@@ -656,6 +656,15 @@ class _RingTrace:
                 cur["wait_s"], tags={"rank": str(self.rank)})
         except Exception:
             pass
+        try:
+            # the round's recv wait is by construction NOT hidden under
+            # compute (the caller is blocked in the collective) — it is
+            # the goodput ledger's comm_exposed category, attributed to
+            # whatever step window is open on this thread
+            from ray_tpu.util import goodput
+            goodput.add("comm_exposed", cur["wait_s"])
+        except Exception:
+            pass
         events.record(
             "collective", "round", ph="X", ts=cur["t0"], dur=dur,
             kind=kind, op=cur["op"], codec=cur["codec"],

@@ -821,6 +821,7 @@ def pipe_exec_loop(instance, spec: dict) -> dict:
             bubble = 0.0
             recv0 = stats["recv_s"]
             ov0 = stats["overlapped_recv_s"]
+            comp0 = stats["compute_s"]
             try:
                 for j, op in enumerate(sched):
                     kind, mb = op[0], int(op[1])
@@ -920,6 +921,20 @@ def pipe_exec_loop(instance, spec: dict) -> dict:
                     step=step_tag, group=group,
                     bubble_s=round(bubble, 6),
                     update_s=round(u1 - u0, 6), pid=os.getpid())
+                try:
+                    # this stage's step anatomy, pre-aggregated (the
+                    # exec loop measures compute/bubble itself — no
+                    # interval stamping). "rank" is the STAGE index:
+                    # stage processes have no train rank, and per-stage
+                    # rows are what the bubble-fraction cross-check in
+                    # scripts/goodput_bench.py reads
+                    from ray_tpu.util import goodput
+                    goodput.record_step(
+                        step_tag, step_dur, rank=stage,
+                        compute=stats["compute_s"] - comp0,
+                        bubble=bubble)
+                except Exception:   # noqa: BLE001
+                    pass
                 res_out.write(serialize({
                     "result": result,
                     # per-step values only (THIS step's deltas); the

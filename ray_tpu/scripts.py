@@ -694,6 +694,76 @@ def cmd_devices(args) -> int:
     return 0
 
 
+def cmd_goodput(args) -> int:
+    """Per-rank step-time anatomy off the goodput ledger
+    (util/goodput.py events in the cluster timeline): one stacked
+    breakdown bar per rank (compute / comm_exposed / bubble /
+    ckpt_stall / compile / idle — the categories sum to step wall by
+    the ledger's identity), the derived goodput fraction, plus the
+    train_mfu trend and the straggler verdict from the head's
+    time-series store. Same rows as the dashboard /goodput page."""
+    from ray_tpu.util.health import parse_since, spark
+    from ray_tpu.util.state import goodput_from_events
+    addr = _resolve_address(args)
+    r = _call_head(addr, "collect_timeline")
+    rows = goodput_from_events(r.get("events", []), limit=args.limit)
+    since_s = parse_since(args.since, 900.0)
+    mfu_vals = []
+    straggler = None
+    try:
+        q = _call_head(addr, "query_series", name="train_mfu",
+                       since_s=since_s)
+        mfu_vals = [p.get("value") for p in q.get("points", [])
+                    if p.get("value") is not None]
+        qs = _call_head(addr, "query_series",
+                        name="goodput_straggler_rank", since_s=since_s)
+        pts = qs.get("points", [])
+        if pts:
+            # a rank id: read the newest SAMPLE, not the window mean
+            # (a window that saw both -1/healthy and rank N averages
+            # to garbage)
+            v = pts[-1].get("last", pts[-1].get("value"))
+            if v is not None:
+                straggler = int(v)
+    except Exception:   # noqa: BLE001 — anatomy renders without trends
+        pass
+    if args.json:
+        print(json.dumps({"rows": rows, "mfu_trend": mfu_vals,
+                          "straggler_rank": straggler},
+                         default=str, indent=2))
+        return 0
+    if not rows:
+        print("no goodput events in the timeline (is "
+              "goodput_level=off, or has no trace_step-wrapped train "
+              "loop run yet?)")
+        return 0
+    cats = (("compute", "#"), ("comm_exposed", "x"), ("bubble", "~"),
+            ("ckpt_stall", "k"), ("compile", "c"), ("idle", "."))
+    width = 40
+    print(f"{'rank':>4}  {'steps':>5}  {'wall':>9}  "
+          f"{'goodput':>7}  anatomy "
+          + " ".join(f"{sym}={name}" for name, sym in cats))
+    for row in rows:
+        wall = row["mean_wall_s"]
+        bar = ""
+        for name, sym in cats:
+            frac = row[f"mean_{name}_s"] / wall if wall > 0 else 0.0
+            bar += sym * int(round(frac * width))
+        bar = (bar + "." * width)[:width]
+        print(f"{str(row['rank']):>4}  {row['steps']:>5}  "
+              f"{wall * 1e3:7.1f}ms  "
+              f"{row['goodput_fraction'] * 100:6.1f}%  [{bar}]"
+              + (f"  mfu={row['mfu'] * 100:.1f}%"
+                 if row.get("mfu") is not None else ""))
+    if mfu_vals:
+        print(f"train_mfu ({args.since}): {spark(mfu_vals)} "
+              f"last={mfu_vals[-1] * 100:.1f}%")
+    if straggler is not None and straggler >= 0:
+        print(f"STRAGGLER: rank {straggler} p50 anatomy diverges "
+              f"beyond goodput_straggler_z")
+    return 0
+
+
 def cmd_job(args) -> int:
     from ray_tpu.job_submission import JobSubmissionClient
     addr = _resolve_address(args)
@@ -862,6 +932,17 @@ def main(argv=None) -> int:
     pdv.add_argument("--json", action="store_true")
     pdv.add_argument("--limit", type=int, default=500)
     pdv.set_defaults(fn=cmd_devices)
+
+    pgp = sub.add_parser(
+        "goodput",
+        help="per-rank step-time anatomy (compute / exposed comm / "
+             "bubble / ckpt stall / compile / idle) + MFU trend")
+    pgp.add_argument("--address")
+    pgp.add_argument("--json", action="store_true")
+    pgp.add_argument("--limit", type=int, default=64)
+    pgp.add_argument("--since", default="15m",
+                     help="trend window for train_mfu (e.g. 15m, 2h)")
+    pgp.set_defaults(fn=cmd_goodput)
 
     pc = sub.add_parser("collectives",
                         help="summarize recent ring collective rounds "

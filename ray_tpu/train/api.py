@@ -466,10 +466,16 @@ class TrainContext:
         """
         import contextlib
 
-        from ray_tpu.util import devmon, tracing
+        from ray_tpu.util import devmon, goodput, tracing
 
         @contextlib.contextmanager
         def _span():
+            # the step span doubles as the goodput ledger's step
+            # window: subsystems (ring wait, ckpt stall, compile,
+            # stamped compute) attribute into it, step_end pins the
+            # sum-to-wall identity. Re-entrant, so a nested
+            # trace_step depth-counts instead of opening a new row.
+            goodput.step_begin(self.collective_step, rank=self.rank)
             # join the ambient trace as a CHILD span (nested
             # trace_step, or a step opened inside a traced request);
             # only the outermost mint is the trace's root
@@ -490,6 +496,7 @@ class TrainContext:
                     yield None
                 finally:
                     devmon.record_device_window(name, t0, time.time())
+                    goodput.step_end()
                 return
             tok = tracing.set_request_context(tctx)
             step = self.collective_step
@@ -534,6 +541,7 @@ class TrainContext:
                     "train", name, tctx, parent, t0, time.time(),
                     span_id=tctx.span_id, error=not ok,
                     step=step, rank=self.rank, **extra)
+                goodput.step_end()
         return _span()
 
     def report(self, metrics: Dict[str, Any],

@@ -1036,7 +1036,17 @@ class AsyncCheckpointer:
         self._last_enqueued_step = int(step)
         self._ensure_thread()
         self._q.put(job)
-        self._m["snapshot"].observe(time.monotonic() - t0)
+        stall_s = time.monotonic() - t0
+        self._m["snapshot"].observe(stall_s)
+        try:
+            # the step path paid this much for the save (backpressure
+            # wait + host snapshot copy) — the goodput ledger's
+            # ckpt_stall category, same measured span as the
+            # ckpt_snapshot_s histogram above
+            from ray_tpu.util import goodput
+            goodput.add("ckpt_stall", stall_s)
+        except Exception:   # noqa: BLE001 — observability must not raise
+            pass
         if block:
             if deadline is None:
                 job["done"].wait()

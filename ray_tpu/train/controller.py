@@ -463,6 +463,8 @@ class TrainController:
         self._last_mirrors = {}
         self._last_pipeline = {}
         self._preempt_notice = {}
+        self._straggler_det = self._make_straggler_detector()
+        self._straggler_last = -1
         sync = self._grad_sync_specs(group_id)
         n = len(self._workers)
         refs = []
@@ -664,6 +666,52 @@ class TrainController:
         print(f"[train] rank {rank} reported preemption notice "
               f"(grace {grace}s) — will recover proactively")
 
+    def _make_straggler_detector(self):
+        """Online straggler detector over the ranks' polled goodput
+        anatomies (util/goodput.py): knobs from config, the p50 window
+        sized by the same goodput_straggler_window_steps the worker
+        ledgers roll over."""
+        from ray_tpu.config import get_config
+        from ray_tpu.util import goodput
+        cfg = get_config()
+        win = int(getattr(cfg, "goodput_straggler_window_steps", 32))
+        return goodput.StragglerDetector(
+            z_threshold=float(getattr(cfg, "goodput_straggler_z",
+                                      6.0)),
+            min_steps=max(4, win // 4))
+
+    def _note_goodput(self, polls: Dict[int, dict]) -> None:
+        """Feed this poll batch's per-rank step anatomies to the
+        straggler detector, publish the verdict on the
+        goodput_straggler_rank gauge, and record a named-rank
+        "goodput"/"straggler" event on each healthy->flagged
+        transition (the health plane derives a gauge objective from
+        the same metric, so a persistent straggler pages)."""
+        det = getattr(self, "_straggler_det", None)
+        if det is None:
+            return
+        try:
+            for i, p in polls.items():
+                an = p.get("goodput")
+                if an:
+                    det.observe(int(p.get("rank", i)), an)
+            verdict = det.check()
+            rank = int(verdict["rank"])
+            from ray_tpu.util import goodput
+            goodput.goodput_metrics()["straggler"].set(float(rank))
+            if rank != self._straggler_last and rank >= 0:
+                events.record(
+                    "goodput", "straggler", ph="i", ts=time.time(),
+                    rank=rank, z=round(float(verdict["z"]), 2),
+                    gap_s=round(float(verdict["gap_s"]), 6),
+                    group=self._group_id[:12])
+                print(f"[train] goodput straggler: rank {rank} p50 "
+                      f"anatomy diverges (z={verdict['z']:.1f}, "
+                      f"gap={verdict['gap_s'] * 1e3:.1f}ms)")
+            self._straggler_last = rank
+        except Exception:   # noqa: BLE001 — observability must not
+            pass            # break the liveness loop
+
     def _poll_until_done(self, poll_s: float = 0.2):
         pending = set(range(len(self._workers)))
         grow_iv = self.scaling.elastic_grow_interval_s
@@ -688,6 +736,7 @@ class TrainController:
                         dead.append((i, e))
             if self._stop_requested:
                 raise TrainGroupError("stop requested")
+            self._note_goodput(polls)
             for i, p in sorted(polls.items()):
                 for rep in p["reports"]:
                     self._handle_report(p["rank"], rep)
@@ -854,6 +903,9 @@ class TrainController:
         self._infos = [self._infos[i] for i in survivors]
         self._last_mirrors = {}
         self._last_pipeline = {}
+        # old rank indices (and their anatomy history) are now invalid
+        self._straggler_det = self._make_straggler_detector()
+        self._straggler_last = -1
         n = len(self._workers)
         import uuid
         gid = uuid.uuid4().hex

@@ -1034,6 +1034,18 @@ class Pipeline:
                     self._broken = err
                     raise err from e
         reports = self._collect_reports(deadline)
+        try:
+            # the slowest stage's idle this step (the same max the
+            # bubble_fraction property takes) — attributed into the
+            # driver's open goodput step window as `bubble`, so a
+            # pipeline-bound step's anatomy names the schedule, not
+            # an opaque residual
+            from ray_tpu.util import goodput
+            goodput.add("bubble", max(
+                (float(r["stats"]["bubble_s"]) for r in reports
+                 if r.get("stats")), default=0.0))
+        except Exception:   # noqa: BLE001
+            pass
         loss_vals = [r["result"]["loss"] for r in reports
                      if r["stage"] == self.num_stages - 1
                      and r["result"].get("loss") is not None]

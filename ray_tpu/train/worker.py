@@ -19,6 +19,16 @@ import cloudpickle
 from ray_tpu.train.api import Checkpoint, TrainContext, set_context
 
 
+def _goodput_anatomy():
+    """This rank's rolling step anatomy for poll() — never raises
+    (poll is the liveness probe; observability must not break it)."""
+    try:
+        from ray_tpu.util import goodput
+        return goodput.anatomy()
+    except Exception:   # noqa: BLE001
+        return None
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("", 0))
@@ -108,6 +118,8 @@ class TrainWorker:
 
         def run():
             set_context(self.ctx)
+            from ray_tpu.util import goodput
+            goodput.set_rank(self.rank)
             try:
                 if train_loop_config is not None:
                     self._result = fn(train_loop_config)
@@ -153,7 +165,11 @@ class TrainWorker:
                 # must NOT re-form a ring around a lost pipeline stage
                 # (its parameters exist nowhere else — restart instead)
                 "pipeline": bool(getattr(self.ctx, "pipeline_group",
-                                         None)) if self.ctx else False}
+                                         None)) if self.ctx else False,
+                # rolling step-anatomy summary (util/goodput.py): p50
+                # per category over the window — the controller's
+                # straggler detector compares these across the ring
+                "goodput": _goodput_anatomy()}
 
     # --- elastic reshape -------------------------------------------------
 

@@ -44,6 +44,7 @@ import numpy as np
 
 from ray_tpu.dag.ring import (_UNSET, _flatten, _wire_dtype,
                               rebuild_from_layout, resolve_wire_dtype)
+from ray_tpu.util import goodput
 
 
 def zero_metrics() -> dict:
@@ -353,8 +354,11 @@ class ShardedOptimizer:
         # of the flat space never gets copied (that is the point of
         # sharding the update)
         pshard = _slice_leaves(leaves, wire, lo, hi)
-        updates, new_state = self.opt.update(gshard, state, pshard)
-        new_shard = pshard + np.asarray(updates, dtype=wire)
+        # the shard's optimizer math is this step's host-side compute
+        # (the collectives around it attribute their own exposed wait)
+        with goodput.interval("compute"):
+            updates, new_state = self.opt.update(gshard, state, pshard)
+            new_shard = pshard + np.asarray(updates, dtype=wire)
         if g is None:
             new_flat = new_shard
             if self.param_wire_dtype is not None:
@@ -424,8 +428,9 @@ class ShardedOptimizer:
             [_slice_leaves(leaves[a:b], wire, lo, hi)
              for a, b, _, lo, hi in buckets]) \
             if buckets else np.empty(0, wire)
-        updates, new_state = self.opt.update(gshard, state, pshard)
-        new_shard = pshard + np.asarray(updates, dtype=wire)
+        with goodput.interval("compute"):
+            updates, new_state = self.opt.update(gshard, state, pshard)
+            new_shard = pshard + np.asarray(updates, dtype=wire)
         pieces, off = [], 0
         for ln in lens:
             pieces.append(np.ascontiguousarray(new_shard[off:off + ln]))

@@ -41,6 +41,7 @@ _NAV = ("<nav><a href='/'>overview</a><a href='/nodes'>nodes</a>"
         "<a href='/pgs'>placement groups</a><a href='/serve'>serve</a>"
         "<a href='/tasks'>tasks</a><a href='/traces'>traces</a>"
         "<a href='/devices'>devices</a>"
+        "<a href='/goodput'>goodput</a>"
         "<a href='/health'>health</a>"
         "<a href='/history'>history</a>"
         "<a href='/profile'>profile</a>"
@@ -427,6 +428,84 @@ async def _devices(fetch: Fetch, query: str = "") -> bytes:
     return _page("devices", body)
 
 
+async def _goodput(fetch: Fetch, query: str = "") -> bytes:
+    """Goodput ledger view (util/goodput.py events off the cluster
+    timeline): one stacked per-rank step-anatomy bar (compute /
+    comm_exposed / bubble / ckpt_stall / compile / idle — categories
+    sum to step wall by the ledger's identity), the derived goodput
+    fraction, the train_mfu trend, and the straggler verdict."""
+    from ray_tpu.util.state import goodput_from_events
+    r = await fetch("collect_timeline")
+    rows = goodput_from_events(r.get("events", []))
+    body = ""
+    straggler = None
+    mfu_vals: list = []
+    try:
+        qs = await fetch("query_series", name="goodput_straggler_rank",
+                         since_s=900.0)
+        pts = qs.get("points") or []
+        if pts:
+            # newest sample, not the window mean — see cmd_goodput
+            v = pts[-1].get("last", pts[-1].get("value"))
+            if v is not None:
+                straggler = int(v)
+        qm = await fetch("query_series", name="train_mfu",
+                         since_s=900.0)
+        mfu_vals = [p.get("value") for p in (qm.get("points") or [])]
+    except Exception:   # noqa: BLE001 — anatomy renders without trends
+        pass
+    if straggler is not None and straggler >= 0:
+        body += (f"<p class=bad>straggler flagged &mdash; rank "
+                 f"{straggler}'s p50 step anatomy diverges beyond "
+                 f"goodput_straggler_z</p>")
+    if not rows:
+        body += ("<p class=dim>no goodput events yet (is "
+                 "<code>goodput_level=off</code>, or has no "
+                 "<code>trace_step</code>-wrapped train loop run?)"
+                 "</p>")
+        return _page("goodput", body)
+    cats = ("compute", "comm_exposed", "bubble", "ckpt_stall",
+            "compile", "idle")
+    colors = {"compute": "#2a4", "comm_exposed": "#e63",
+              "bubble": "#fa0", "ckpt_stall": "#a4e",
+              "compile": "#49e", "idle": "#bbb"}
+    grows = []
+    for row in rows:
+        wall = row["mean_wall_s"]
+        bar = "<span style='display:inline-block;width:240px'>"
+        for c in cats:
+            frac = (row[f"mean_{c}_s"] / wall) if wall > 0 else 0.0
+            w = int(round(frac * 240))
+            if w > 0:
+                bar += (f"<span title='{_esc(c)}' style='display:"
+                        f"inline-block;height:12px;width:{w}px;"
+                        f"background:{colors[c]}'></span>")
+        bar += "</span>"
+        grows.append((
+            _esc(str(row["rank"])), str(row["steps"]),
+            f"{wall * 1e3:.1f}",
+            f"{row['goodput_fraction'] * 100:.1f}%",
+            bar,
+            f"{row['mean_comm_exposed_s'] * 1e3:.1f}",
+            f"{row['mean_bubble_s'] * 1e3:.1f}",
+            f"{(row['mfu'] * 100):.1f}%" if row.get("mfu") is not None
+            else "-",
+        ))
+    legend = " ".join(
+        f"<span style='background:{colors[c]};padding:0 6px'>"
+        f"&nbsp;</span> {c}" for c in cats)
+    body += ("<h2>per-rank step anatomy</h2>"
+             f"<p class=dim>{legend} &mdash; categories sum to step "
+             "wall (the ledger identity); CLI: "
+             "<code>ray-tpu goodput</code></p>"
+             + _table(("rank", "steps", "wall (ms)", "goodput",
+                       "anatomy", "comm exposed (ms)", "bubble (ms)",
+                       "MFU"), grows))
+    if any(v is not None for v in mfu_vals):
+        body += "<h2>train_mfu (15m)</h2>" + _spark(mfu_vals)
+    return _page("goodput", body)
+
+
 async def _health(fetch: Fetch, query: str = "") -> bytes:
     """Cluster health plane (util/health.py off the head's time-series
     store): SLO objectives with multi-window burn rates, active
@@ -729,7 +808,8 @@ async def _profile(fetch: Fetch, query: str = "") -> bytes:
 _PAGES = {"/": _overview, "/overview": _overview, "/nodes": _nodes,
           "/actors": _actors, "/jobs": _jobs, "/pgs": _pgs,
           "/serve": _serve, "/tasks": _tasks, "/traces": _traces,
-          "/devices": _devices, "/health": _health,
+          "/devices": _devices, "/goodput": _goodput,
+          "/health": _health,
           "/history": _history, "/profile": _profile}
 
 

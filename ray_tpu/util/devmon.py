@@ -225,6 +225,13 @@ def record_compile(fn: str, dur_s: float, *,
         return
     m["compiles"].inc(tags={"fn": fn})
     m["compile_s"].observe(dur_s, exemplar=trace or None)
+    try:
+        # a real compile (not a cache retrieval) stalls the step that
+        # triggered it — the goodput ledger's compile category
+        from ray_tpu.util import goodput
+        goodput.add("compile", dur_s)
+    except Exception:   # noqa: BLE001
+        pass
     _note_compile(fn, now, m)
 
 

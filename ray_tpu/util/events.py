@@ -58,9 +58,12 @@ from typing import Deque, Dict, List
 #   serve       serve control-plane actuation: SLO autoscale decisions
 #               (serve/autoscale.py) — instants on a "serve" timeline
 #               lane next to the health alerts that triggered them
+#   goodput     step-anatomy ledger (util/goodput.py): one "step" span
+#               per training step with the category breakdown, plus
+#               controller-side "straggler" instants naming the rank
 CATEGORIES = ("trace", "collective", "train", "worker", "cgroup",
               "memory", "request", "device", "device_window",
-              "pipeline", "health", "ckpt", "serve")
+              "pipeline", "health", "ckpt", "serve", "goodput")
 
 _DEFAULT_CAP = 65536
 # Dedicated sub-budgets: the key also names the bucket. Everything
@@ -95,7 +98,11 @@ _CATEGORY_CAPS: Dict[str, int] = {"collective": 16384, "train": 4096,
                                   # scale decisions are rare, but a
                                   # misconfigured (thrashing) loop
                                   # must thrash against its own budget
-                                  "serve": 2048}
+                                  "serve": 2048,
+                                  # one span per training step — a
+                                  # long run's anatomy must age out
+                                  # against itself, not the task spans
+                                  "goodput": 4096}
 
 _BUFS: Dict[str, Deque[dict]] = {}
 _LOCK = threading.Lock()

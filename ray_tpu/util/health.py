@@ -287,6 +287,14 @@ class HealthEngine:
                 direction="above",
                 description="a rank is persistently flagged as the "
                             "gradient-sync straggler (-1 = healthy)"))
+        if "goodput_straggler_rank" in names:
+            out.append(Objective(
+                name="goodput_straggler", kind="gauge",
+                metric="goodput_straggler_rank", threshold=-0.5,
+                direction="above",
+                description="a rank's p50 step anatomy diverges from "
+                            "the ring beyond goodput_straggler_z "
+                            "(-1 = healthy)"))
         if "device_hbm_used_bytes" in names \
                 and "device_hbm_limit_bytes" in names:
             out.append(Objective(

@@ -146,6 +146,52 @@ def pipeline_from_events(events, limit: int = 50) -> List[dict]:
     return rows[:limit]
 
 
+def goodput_from_events(events, limit: int = 64) -> List[dict]:
+    """Timeline "goodput" STEP spans -> one anatomy row per rank,
+    newest-window means. The ONE place the goodput step-span shape is
+    interpreted: steps seen, mean wall, mean seconds per category
+    (compute/comm_exposed/bubble/ckpt_stall/compile/idle — they sum to
+    wall by the ledger's identity), the derived goodput fraction
+    (compute / wall), and the last reported MFU."""
+    cats = ("compute", "comm_exposed", "bubble", "ckpt_stall",
+            "compile", "idle")
+    acc: dict = {}
+    for e in events:
+        if e.get("cat") != "goodput" or e.get("name") != "step":
+            continue
+        r = e.get("rank", -1)
+        row = acc.setdefault(r, {
+            "rank": r, "steps": 0, "wall_sum": 0.0, "last_ts": 0.0,
+            "last_step": 0, "mfu": None,
+            **{f"{c}_sum": 0.0 for c in cats}})
+        row["steps"] += 1
+        row["wall_sum"] += float(e.get("wall_s", e.get("dur", 0.0)))
+        for c in cats:
+            row[f"{c}_sum"] += float(e.get(f"{c}_s", 0.0))
+        ts = float(e.get("ts", 0.0))
+        if ts >= row["last_ts"]:
+            row["last_ts"] = ts
+            row["last_step"] = e.get("step", 0)
+            if e.get("mfu") is not None:
+                row["mfu"] = float(e["mfu"])
+    rows = []
+    for row in acc.values():
+        n = max(1, row["steps"])
+        wall = row.pop("wall_sum") / n
+        out = {"rank": row["rank"], "steps": row["steps"],
+               "last_step": row["last_step"],
+               "last_ts": row["last_ts"], "mfu": row["mfu"],
+               "mean_wall_s": wall}
+        for c in cats:
+            out[f"mean_{c}_s"] = row.pop(f"{c}_sum") / n
+        out["goodput_fraction"] = (out["mean_compute_s"] / wall) \
+            if wall > 0 else 0.0
+        rows.append(out)
+    rows.sort(key=lambda x: (x["rank"] if isinstance(x["rank"], int)
+                             else 1 << 30))
+    return rows[:limit]
+
+
 def traces_from_events(events, limit: int = 100) -> List[dict]:
     """Timeline "request" spans -> one row per SAMPLED trace (a trace
     is sampled iff its proxy-side ROOT span was recorded — util/tracing

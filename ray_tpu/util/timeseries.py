@@ -439,6 +439,8 @@ class TimeSeriesStore:
                                             b["min"])
                             mb["max"] = max(mb.get("max", b["max"]),
                                             b["max"])
+                            if "last" in b:
+                                mb["last"] = b["last"]
                     else:
                         cs = b.get("counts")
                         if cs:
@@ -459,9 +461,15 @@ class TimeSeriesStore:
                                "inc": b.get("inc", 0.0)})
             elif kind == "gauge":
                 if b.get("n"):
-                    points.append({"t": t,
-                                   "value": b["sum"] / b["n"],
-                                   "min": b["min"], "max": b["max"]})
+                    # "value" is the window mean (trend surfaces);
+                    # "last" is the newest sample — enum-ish gauges
+                    # (e.g. a straggler RANK id) are meaningless
+                    # averaged across a window that saw both -1 and N
+                    pt = {"t": t, "value": b["sum"] / b["n"],
+                          "min": b["min"], "max": b["max"]}
+                    if "last" in b:
+                        pt["last"] = b["last"]
+                    points.append(pt)
             else:
                 cs = b.get("counts")
                 if cs:

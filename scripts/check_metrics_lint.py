@@ -112,6 +112,8 @@ def instantiate_all() -> dict:
     take(devmon.devmon_metrics())
     from ray_tpu.util import health
     take(health.health_metrics())
+    from ray_tpu.util import goodput
+    take(goodput.goodput_metrics())
     return out
 
 
@@ -192,15 +194,32 @@ CKPT_METRIC_PREFIXES = ("ckpt_",)
 # the paged-attention decode family (kernel-vs-gather impl counters,
 # llm/kvcache.py + ops/pallas/paged_attention.py).
 SERVE_METRIC_PREFIXES = ("serve_autoscale_", "llm_paged_")
+# ``goodput_`` is the step-anatomy ledger's family (util/goodput.py:
+# seconds/steps counters + the straggler-rank gauge); ``train_mfu``
+# covers extensions of the MFU gauge family.
+GOODPUT_METRIC_PREFIXES = ("goodput_", "train_mfu")
 METRIC_FAMILY_PREFIXES = (DEVICE_METRIC_PREFIXES
                           + HEALTH_METRIC_PREFIXES
                           + CKPT_METRIC_PREFIXES
-                          + SERVE_METRIC_PREFIXES)
+                          + SERVE_METRIC_PREFIXES
+                          + GOODPUT_METRIC_PREFIXES)
 
 # prefixed literals that are NOT metric names: control RPC method
 # names etc. (Config knob names are exempted wholesale below — the
 # health plane reads its knobs via quoted getattr calls).
-EXEMPT_METRIC_LITERALS = {"health_state"}
+EXEMPT_METRIC_LITERALS = {"health_state",
+                          # derived row field in state.goodput rows
+                          # (compute/wall share), not a metric series
+                          "goodput_fraction",
+                          # goodput ledger anatomy category (collides
+                          # with the ckpt_ family), not a series name
+                          "ckpt_stall",
+                          # health objective name (util/health.py),
+                          # not a series name
+                          "goodput_straggler",
+                          # jax device attribute probed via getattr
+                          # (util/goodput.py), not a series name
+                          "device_kind"}
 
 _DEVICE_METRIC_RE = re.compile(
     r"""['"]((?:%s)[a-z0-9_]+)['"]"""
@@ -295,6 +314,9 @@ KNOB_FAMILIES = {
     # paged-attention decode path: kernel-vs-gather impl selection and
     # the pallas interpret override (ops/pallas/paged_attention.py)
     "paged_attn": ("paged_attn_", ""),
+    # goodput ledger: level switch + straggler z-threshold/window
+    # (util/goodput.py, train/controller.py detector)
+    "goodput": ("goodput_", ""),
 }
 
 

@@ -14,16 +14,7 @@ import jax.numpy as jnp
 sys.path.insert(0, ".")
 from ray_tpu.models import llama  # noqa: E402
 from ray_tpu.parallel import mesh as pmesh  # noqa: E402
-
-PEAK = {"v5e": 197.0, "v5p": 459.0, "v6": 918.0, "v4": 275.0}
-
-
-def peak_tflops(kind):
-    kind = kind.lower()
-    for k, v in PEAK.items():
-        if k in kind:
-            return v
-    return 197.0
+from ray_tpu.util.accelerators import peak_tflops  # noqa: E402
 
 
 def run_variant(name, cfg, batch, iters=10, warmup=3):
