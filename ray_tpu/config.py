@@ -168,6 +168,31 @@ class Config:
     # to debug kernel/compiler divergence ON a TPU).
     paged_attn_interpret: bool = False
 
+    # --- speculative decoding (llm/spec.py) ---
+    # Draft-and-verify generation in the paged engine (speculative
+    # sampling, arxiv 2211.17192): a model-free prompt-lookup drafter
+    # proposes up to spec_draft_tokens tokens by matching the
+    # request's recent suffix against its own prompt+output history;
+    # the engine scores all k+1 positions in one batched forward and
+    # accepts the longest agreeing prefix (exact greedy match at
+    # temperature<=0, rejection sampling otherwise so the output
+    # distribution is unchanged). Off by default; engines also take
+    # this per-instance via LLMEngine(spec=...).
+    spec_decode: bool = False
+    # Max draft tokens per verify round (the k in draft-and-verify).
+    # Verify widths are padded to a small bucket set derived from
+    # this, so distinct accepted lengths never compile new programs.
+    spec_draft_tokens: int = 4
+    # Longest suffix n-gram the prompt-lookup drafter tries to match
+    # (it backs down to 1-grams before giving up).
+    spec_ngram_max: int = 3
+    # Accept-rate backoff: the drafter tracks acceptance over a
+    # sliding window of this many drafted tokens and stops proposing
+    # when the windowed accept rate drops below ~25%, re-probing
+    # periodically — adversarial low-hit prompts degrade to vanilla
+    # decode instead of paying verify overhead forever.
+    spec_backoff_window: int = 16
+
     # --- serve fault tolerance ---
     # Default per-request deadline budget (seconds) when the client
     # sends no X-Request-Deadline header. The budget is spent across

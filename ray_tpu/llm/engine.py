@@ -28,7 +28,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from ray_tpu.llm import kvcache, model as lm
+from ray_tpu.llm import kvcache, model as lm, spec as specdec
 from ray_tpu.models.llama import LlamaConfig
 from ray_tpu.util import devmon, tracing
 
@@ -137,6 +137,14 @@ class _Request:
     prefix_hit: int = 0
     kv_written: bool = False    # prefill scatter reached the pool
     handoff_bytes: int = 0      # disaggregated KV shipped for this req
+    # speculative decoding (engine spec mode): the per-request
+    # prompt-lookup drafter (accept-window state; the token history it
+    # matches against IS tokens+out) and the request's draft/accept
+    # totals — accept rate lands on the terminal trace span and the
+    # llm_spec_accept_rate gauge
+    drafter: Optional[specdec.PromptLookupDrafter] = None
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
 
 class LLMEngine:
@@ -150,6 +158,7 @@ class LLMEngine:
                  kv_pool_blocks: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  kv_impl: Optional[str] = None,
+                 spec: Optional[bool] = None,
                  detokenize: Optional[Callable[[List[int]], str]] = None):
         """With ``mesh``, the engine runs TENSOR-PARALLEL: params shard
         per lm.serve_param_specs (Megatron layout), the KV cache shards
@@ -204,7 +213,19 @@ class LLMEngine:
                                         True))
         if kv_impl is None:
             kv_impl = str(getattr(_cfg, "paged_attn_impl", "auto"))
+        if spec is None:
+            spec = bool(getattr(_cfg, "spec_decode", False))
         self._paged = kv_block_size > 0
+        # Speculative decoding (llm/spec.py): draft-and-verify rides
+        # the block-table verify forward, so it requires paged mode;
+        # on the monolithic cache the knob is ignored.
+        self._spec = bool(spec) and self._paged
+        self._spec_k = max(1, int(getattr(_cfg, "spec_draft_tokens", 4)))
+        self._spec_ngram = max(1, int(getattr(_cfg, "spec_ngram_max", 3)))
+        self._spec_window = max(1, int(getattr(_cfg,
+                                               "spec_backoff_window", 16)))
+        self._spec_buckets = specdec.width_buckets(self._spec_k)
+        self._specm = specdec.spec_metrics() if self._spec else None
         self._kvm = kvcache.kvcache_metrics()
         if self._paged:
             from ray_tpu.ops.attention import _on_tpu
@@ -305,7 +326,8 @@ class LLMEngine:
                        blocks_cached=self._kv.cached_blocks(),
                        blocks_free=self._kv.free_blocks(),
                        prefix_hit_tokens=self._kv.hit_tokens_total,
-                       kv_impl=self._kv_impl)
+                       kv_impl=self._kv_impl,
+                       spec=self._spec)
         return out
 
     def _kv_per_token_bytes(self) -> float:
@@ -472,6 +494,10 @@ class LLMEngine:
         if self._paged:
             self._seq_counter += 1
             r.seq = self._seq_counter
+        if self._spec:
+            r.drafter = specdec.PromptLookupDrafter(
+                k=self._spec_k, ngram_max=self._spec_ngram,
+                window=self._spec_window)
         self._waiting.put_nowait(r)
         self._requests += 1
         self._ensure_loop()
@@ -608,6 +634,40 @@ class LLMEngine:
                         # idle: park until work arrives
                         r = await self._waiting.get()
                         self._waiting.put_nowait(r)
+                    continue
+                # 2a) speculative verify round (engine spec mode): ask
+                # each active slot's drafter for a continuation guess.
+                # Any drafting slot flips this round from "one decode
+                # step per emitted token" to ONE batched verify forward
+                # scoring 1..k+1 positions per slot — non-drafting
+                # slots co-batch at width 1 (their row emits exactly
+                # its first verified token). When NOBODY drafts (spec
+                # off, drafters cooling off on low-hit prompts, or
+                # nothing to match yet) the engine falls through to the
+                # vanilla block path below — that fallback plus the
+                # drafter's accept-rate backoff is what bounds the
+                # adversarial-prompt overhead.
+                drafts: dict = {}
+                if self._spec:
+                    for i in active:
+                        r = self._slots[i]
+                        if r.drafter is None:
+                            continue
+                        # leave room for the bonus token and never
+                        # draft past the request's horizon
+                        budget = min(
+                            self._spec_k,
+                            r.max_new_tokens - len(r.out) - 1,
+                            self._cache_len - len(r.tokens)
+                            - len(r.out) - 1)
+                        if budget < 1:
+                            continue
+                        d = r.drafter.propose(r.tokens + r.out, budget)
+                        if d:
+                            drafts[i] = d
+                if drafts:
+                    await self._spec_round(loop, active, drafts)
+                    await asyncio.sleep(0)
                     continue
                 # 2) a BLOCK of decode steps for every active slot, one
                 # host sync per block. Sampling is on-device
@@ -1064,29 +1124,129 @@ class LLMEngine:
             jnp.asarray(temps), key, self.cfg, block, tp, tk)
         return np.asarray(out)
 
+    async def _spec_round(self, loop, active: List[int],
+                          drafts: dict) -> None:
+        """One draft-and-verify round: pad every active slot's
+        [last_token, draft...] row to a verify-width bucket (repeating
+        the last token — pad rows write garbage KV beyond the slot's
+        logical length, masked out of every attention and overwritten
+        by the next real write), score all positions in one forward,
+        accept per slot (exact greedy match / rejection sampling in
+        llm/spec.py), roll back the host block accounting for rejected
+        tails, and emit 1..k+1 tokens per slot."""
+        w = specdec.bucket_width(
+            self._spec_buckets,
+            1 + max(len(d) for d in drafts.values()))
+        tokens_bw = np.zeros((self.max_slots, w), np.int32)
+        lengths = np.zeros((self.max_slots,), np.int32)
+        for i in active:
+            r = self._slots[i]
+            row = [r.out[-1]] + drafts.get(i, [])
+            row += [row[-1]] * (w - len(row))
+            tokens_bw[i] = row
+            lengths[i] = len(r.tokens) + len(r.out) - 1
+        member_traces = sorted(
+            {self._slots[i].trace.trace_id for i in active
+             if self._slots[i] is not None
+             and self._slots[i].trace is not None})
+        first_ctx = next(
+            (self._slots[i].trace for i in active
+             if self._slots[i] is not None
+             and self._slots[i].trace is not None), None)
+        t_dec = time.monotonic()
+        t_dec_wall = time.time()
+        logits = await loop.run_in_executor(
+            None, self._verify_sync, tokens_bw, lengths, first_ctx)
+        emitted_total = 0
+        for i in active:
+            r = self._slots[i]
+            if r is None:
+                continue
+            d = drafts.get(i, [])
+            emitted, n_acc = specdec.accept_tokens(
+                logits[i, :len(d) + 1], d,
+                temperature=r.temperature, top_k=r.top_k,
+                top_p=r.top_p, rng=self._rng)
+            if d:
+                r.drafter.record(len(d), n_acc)
+                r.spec_drafted += len(d)
+                r.spec_accepted += n_acc
+                self._specm["tokens"].inc(len(d),
+                                          tags={"kind": "drafted"})
+                if n_acc:
+                    self._specm["tokens"].inc(
+                        n_acc, tags={"kind": "accepted"})
+                if len(d) > n_acc:
+                    self._specm["tokens"].inc(
+                        len(d) - n_acc, tags={"kind": "rejected"})
+                    # host-side rollback of the rejected tail. Under
+                    # the engine's full-horizon reservation this frees
+                    # no blocks (min_blocks pins the reservation —
+                    # giving promised blocks back could deadlock a
+                    # re-acquire against a newer admit); it keeps the
+                    # sequence's hash chain honest and IS the real
+                    # rollback for COW forks (tests pin both).
+                    self._kv.truncate_seq(
+                        r.seq,
+                        len(r.tokens) + len(r.out) + len(emitted),
+                        min_blocks=self._kv.blocks_needed(
+                            len(r.tokens), r.max_new_tokens))
+            emitted_total += len(emitted)
+            for t in emitted:
+                if self._slots[i] is not r:
+                    break   # finished mid-accept (eos/stop/max_new):
+                            # the tail of an accepted draft is dropped
+                self._emit_token(r, int(t), i)
+        ex = first_ctx.trace_id if first_ctx is not None else None
+        self._m["batch"].observe(len(active), exemplar=ex)
+        per_slot = max(1.0, emitted_total / max(1, len(active)))
+        self._m["tpot"].observe(
+            (time.monotonic() - t_dec) / per_slot, exemplar=ex)
+        tracing.record_batch_span(
+            "engine", "decode", member_traces,
+            t_dec_wall, time.time(), block=emitted_total,
+            slots=len(active), kv_impl=self._kv_impl,
+            gather_bytes_avoided=0, spec_k=w - 1)
+        devmon.record_device_window(
+            "decode", t_dec_wall, time.time(), trace=ex or "")
+
+    def _verify_sync(self, tokens_bw: np.ndarray, lengths: np.ndarray,
+                     trace_ctx: Optional[tracing.TraceContext] = None
+                     ) -> np.ndarray:
+        """Returns (slots, w, vocab) f32 verify logits; binds the first
+        member trace like _decode_sync so a cold verify-width compile
+        is attributed to a real request."""
+        if trace_ctx is None:
+            return self._verify_impl(tokens_bw, lengths)
+        tok = tracing.set_request_context(trace_ctx)
+        try:
+            return self._verify_impl(tokens_bw, lengths)
+        finally:
+            tracing.reset_request_context(tok)
+
+    def _verify_impl(self, tokens_bw: np.ndarray,
+                     lengths: np.ndarray) -> np.ndarray:
+        jax, jnp = _jx()
+        logits, self._pool = kvcache.paged_verify_steps(
+            self.params, self._pool, jnp.asarray(self._tables),
+            jnp.asarray(lengths), jnp.asarray(tokens_bw), self.cfg,
+            impl=self._kv_impl, interpret=self._kv_interpret,
+            mesh=self.mesh, axis=self.tensor_axis)
+        self._kvm["attn_steps"].inc(1, tags={"impl": self._kv_impl})
+        return np.asarray(logits)
+
     def _sample_one(self, logits: np.ndarray, r: _Request) -> int:
         """Host-side sampling for the FIRST token (prefill output is a
-        single logits vector). Mirrors lm.sample's temperature ->
-        top-k -> top-p order; also serves as the numpy reference the
-        on-device sampler is parity-tested against."""
+        single logits vector). Built on spec.host_probs ->
+        lm.filter_logits — the ONE temperature -> top-k -> top-p
+        transform shared with the on-device sampler and the
+        speculative verify-acceptance path, so the three can never
+        drift (this host path is also the numpy reference the device
+        sampler is parity-tested against)."""
         if r.temperature <= 0:
             return int(np.argmax(logits))
-        z = logits.astype(np.float64) / r.temperature
-        if r.top_k > 0:
-            kth = np.sort(z)[::-1][min(r.top_k, len(z)) - 1]
-            z = np.where(z < kth, -np.inf, z)
-        if r.top_p < 1.0:
-            zm = z - z[np.isfinite(z)].max()
-            p = np.exp(zm)
-            p /= p.sum()
-            order = np.argsort(p)[::-1]
-            sp = p[order]
-            keep_sorted = (np.cumsum(sp) - sp) < r.top_p
-            thresh = sp[keep_sorted].min()
-            z = np.where(p < thresh, -np.inf, z)
-        z -= z[np.isfinite(z)].max()
-        p = np.exp(z)
-        p /= p.sum()
+        p = specdec.host_probs(np.asarray(logits), r.temperature,
+                               r.top_k, r.top_p)
         return int(self._rng.choice(len(p), p=p))
 
     def _emit_token(self, r: _Request, tok: int, slot: int):
@@ -1124,6 +1284,10 @@ class LLMEngine:
         bytes) — the trace drill-down shows what the request cost in
         HBM, not just time. Recorded at most once (finish, fail, and
         the loop's shutdown sweep can all reach a request)."""
+        # the accept-rate gauge tracks every finished speculative
+        # request, traced or not (the span extra below needs a trace)
+        if r.spec_drafted and self._specm is not None:
+            self._specm["accept_rate"].set(r.spec_accepted / r.spec_drafted)
         if r.trace is None:
             return
         extra = {}
@@ -1131,6 +1295,9 @@ class LLMEngine:
             extra["prefix_hit_tokens"] = r.prefix_hit
         if r.handoff_bytes:
             extra["kv_handoff_bytes"] = r.handoff_bytes
+        if r.spec_drafted:
+            rate = r.spec_accepted / r.spec_drafted
+            extra["spec_accept_rate"] = round(rate, 4)
         tracing.record_request_span(
             "engine", "generate", r.trace, r.trace.span_id,
             r.t_submit_wall, time.time(), error=error,

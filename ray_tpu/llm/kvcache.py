@@ -388,6 +388,45 @@ class KVBlockManager:
         self._publish()
         return phys, new
 
+    def truncate_seq(self, seq_id, n_tokens: int, *,
+                     min_blocks: int = 0) -> List[int]:
+        """Roll a live sequence back to its first ``n_tokens`` tokens —
+        the speculative-decode rejection path, and the branch-abandon
+        primitive for COW forks. Table blocks whose every position lies
+        beyond ``n_tokens`` are released (refcount decrement: a shared
+        or prefix-indexed block survives for its other holders — the
+        prefix index's own accounting is never touched) and the row is
+        re-pointed at trash. The sequence's hash chain is cut to the
+        full blocks ``n_tokens`` still covers, so a digest over
+        truncated content can never reach the prefix index at
+        ``free_seq`` — a rolled-back draft tail must never satisfy a
+        later prefix hit.
+
+        ``min_blocks`` keeps at least that many leading table rows
+        (the engine passes its full-horizon reservation so a rollback
+        never returns blocks admission already promised the request —
+        re-acquiring them later could deadlock against a newer admit).
+        No device op: rejected-draft KV lives beyond the sequence's
+        logical length, so it is masked out of every attention (exact
+        zeros) and overwritten by the next real write at that position.
+        Returns the physical blocks released."""
+        seq = self.seqs.get(seq_id)
+        if seq is None:
+            raise KeyError(seq_id)
+        keep = max(-(-n_tokens // self.block_size), min_blocks)
+        freed: List[int] = []
+        for i in range(len(seq.table) - 1, keep - 1, -1):
+            phys = seq.table[i]
+            if phys == TRASH:
+                continue
+            seq.table[i] = TRASH
+            self._release(phys)
+            freed.append(phys)
+        seq.hashes = seq.hashes[:n_tokens // self.block_size]
+        seq.n_prompt = min(seq.n_prompt, n_tokens)
+        self._publish()
+        return freed
+
     # -- eviction --------------------------------------------------------
 
     def evict(self, k: int) -> int:
@@ -707,3 +746,105 @@ def paged_decode_steps(params, pool, tables, lengths, tokens, temps,
         _JITS[key_] = fn
     return fn(params, pool, tables, lengths, tokens, temps, key,
               cfg, n, top_ps, top_ks)
+
+
+def _paged_verify_core(params, pool, tables, lengths, tokens, cfg, *,
+                       impl="gather", interpret=False, mesh=None,
+                       axis="tensor"):
+    """Speculative verify against the block pool: score w in-flight
+    tokens per slot (last emitted + up to w-1 drafts) in ONE forward.
+    Runs lm.verify_tokens_core — decode_token_core widened to w — with
+    the block-table write/attend plugged in, so verify numerics can
+    never drift from sequential paged decode.
+
+    tokens: (b, w) int32, column 0 at cache position ``lengths``;
+    writes all w KVs through the table (positions past a slot's table
+    clamp into its last row — within the full-horizon reservation
+    those writes land beyond the logical length, masked out of every
+    attention and overwritten by the next real write, so no rollback
+    device op exists). Returns ((b, w, vocab) f32 logits, pool): row j
+    is the distribution for position lengths+j+1, the verdict on
+    draft j+1. Acceptance is a host decision (llm/spec.py) — the
+    device ships w*vocab floats per slot per ROUND, not per token.
+
+    impl='paged_flash' uses the gather-twin multi-query attention
+    (ops/pallas/paged_attention.paged_attention_verify) — the fused
+    single-query kernel doesn't take multi-query rows yet; the twin
+    still gathers ONCE per round where sequential decode gathered per
+    token, which is the spec-decode win the bench measures."""
+    jax, jnp = _jx()
+    from ray_tpu.llm.model import verify_tokens_core
+    b, wq = tokens.shape
+    bs = pool["k"].shape[2]
+    w = tables.shape[1]
+    kvh, hd = cfg.n_kv_heads, cfg.head_dim
+    positions = lengths
+    pos = positions[:, None] + jnp.arange(wq, dtype=jnp.int32)[None]
+    blk = jnp.clip(pos // bs, 0, w - 1)
+    off = pos % bs
+    phys = jnp.take_along_axis(tables, blk, axis=1)     # (b, wq)
+
+    def write(ck, cv, k, v):    # k/v: (b, wq, kvh, hd)
+        return (ck.at[phys, off].set(k.astype(ck.dtype)),
+                cv.at[phys, off].set(v.astype(cv.dtype)))
+
+    def view(ck, cv):
+        return (ck[tables].reshape(b, w * bs, kvh, hd),
+                cv[tables].reshape(b, w * bs, kvh, hd))
+
+    attend = None
+    if impl == "paged_flash":
+        from ray_tpu.ops.pallas.paged_attention import (
+            paged_attention_verify)
+
+        def attend(q, ck, cv, pos_grid):    # q: (b, wq, h, hd)
+            g = cfg.n_heads // kvh
+            qg = q.reshape(b, wq, kvh, g, hd)
+            if mesh is not None:
+                from jax.sharding import PartitionSpec as P
+                from ray_tpu.ops import shard_map
+                t = axis
+                fn = shard_map(
+                    paged_attention_verify, mesh,
+                    in_specs=(P(None, None, t, None, None),
+                              P(None, None, t, None),
+                              P(None, None, t, None), P(), P()),
+                    out_specs=P(None, None, t, None, None),
+                    check_vma=False)
+            else:
+                fn = paged_attention_verify
+            o = fn(qg, ck, cv, tables, pos_grid + 1)
+            return o.reshape(b, wq, cfg.n_heads * hd)
+
+    logits, nk, nv = verify_tokens_core(
+        params, pool["k"], pool["v"], tokens, positions, cfg,
+        write, view, attend)
+    return logits, {"k": nk, "v": nv}
+
+
+def paged_verify_steps(params, pool, tables, lengths, tokens, cfg, *,
+                       impl="gather", interpret=False, mesh=None,
+                       axis="tensor"):
+    """One speculative verify round in one dispatch — the verify twin
+    of paged_decode_steps. tokens: (b, w) with w drawn from the
+    engine's verify-width buckets; each (pool geometry, w, impl)
+    combination compiles exactly once, cached in _JITS (the
+    compile-discipline tests count both the _JITS keys and devmon's
+    jit(paged_verify_steps) compile spans)."""
+    impl = resolve_attn_impl(impl)
+    wq = int(tokens.shape[1])
+    key_ = ("paged_verify_steps", wq, *_pool_key(pool), impl,
+            bool(interpret), mesh, axis)
+    fn = _JITS.get(key_)
+    if fn is None:
+        jax, _ = _jx()
+
+        @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+        def paged_verify_steps(params, pool, tables, lengths, tokens,
+                               cfg):
+            return _paged_verify_core(
+                params, pool, tables, lengths, tokens, cfg, impl=impl,
+                interpret=interpret, mesh=mesh, axis=axis)
+        fn = paged_verify_steps
+        _JITS[key_] = fn
+    return fn(params, pool, tables, lengths, tokens, cfg)

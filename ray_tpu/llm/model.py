@@ -338,28 +338,52 @@ def sample(logits: jax.Array, temps: jax.Array, key: jax.Array,
     b, v = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    masked = filter_logits(scaled, top_ks, top_ps)
+    keys = jax.random.split(key, b)
+    drawn = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
+    return jnp.where(temps <= 0, greedy, drawn)
+
+
+def filter_logits(scaled, top_ks=None, top_ps=None):
+    """The top-k -> top-p logits mask, shared by the on-device sampler
+    (`sample`, above) and the HOST-side rejection-sampling acceptance
+    in speculative decoding (llm/spec.py). The host sampler is what
+    the device sampler is parity-tested against, and the speculative
+    accept must judge draft tokens under exactly the distribution the
+    device would sample from — so there is ONE implementation of the
+    filter order, generic over jnp (traced inside jit) and plain
+    numpy (host float arrays). `scaled` is logits already divided by
+    temperature, shape (slots, vocab); top_ks (slots,) int32 with 0
+    disabling; top_ps (slots,) f32 in (0, 1] with 1.0 disabling.
+    Returns masked logits with filtered entries at -inf."""
+    import numpy as np
+    onp = isinstance(scaled, np.ndarray)
+    xp = np if onp else jnp
+    v = scaled.shape[-1]
     masked = scaled
     if top_ks is not None:
-        desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-        kth = jnp.take_along_axis(
-            desc, jnp.clip(top_ks - 1, 0, v - 1)[:, None], axis=1)
-        masked = jnp.where((top_ks[:, None] > 0) & (scaled < kth),
-                           -jnp.inf, masked)
+        desc = xp.sort(scaled, axis=-1)[:, ::-1]
+        kth = xp.take_along_axis(
+            desc, xp.clip(top_ks - 1, 0, v - 1)[:, None], axis=1)
+        masked = xp.where((top_ks[:, None] > 0) & (scaled < kth),
+                          -xp.inf, masked)
     if top_ps is not None:
-        probs = jax.nn.softmax(masked, axis=-1)
-        sp = jnp.sort(probs, axis=-1)[:, ::-1]
-        cum = jnp.cumsum(sp, axis=-1)
+        if onp:
+            e = np.exp(masked - np.max(masked, axis=-1, keepdims=True))
+            probs = e / np.sum(e, axis=-1, keepdims=True)
+        else:
+            probs = jax.nn.softmax(masked, axis=-1)
+        sp = xp.sort(probs, axis=-1)[:, ::-1]
+        cum = xp.cumsum(sp, axis=-1)
         # nucleus rule: keep the smallest prefix of the sorted probs
         # whose mass reaches p — i.e. tokens whose EXCLUSIVE cumulative
         # mass is still < p (the top token always survives)
         keep = (cum - sp) < top_ps[:, None]
-        thresh = jnp.min(jnp.where(keep, sp, jnp.inf), axis=-1)
+        thresh = xp.min(xp.where(keep, sp, xp.inf), axis=-1)
         enabled = (top_ps < 1.0)[:, None]
-        masked = jnp.where(enabled & (probs < thresh[:, None]),
-                           -jnp.inf, masked)
-    keys = jax.random.split(key, b)
-    drawn = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
-    return jnp.where(temps <= 0, greedy, drawn)
+        masked = xp.where(enabled & (probs < thresh[:, None]),
+                          -xp.inf, masked)
+    return masked
 
 
 def decode_token_core(params: dict, kcache: jax.Array,
@@ -410,6 +434,80 @@ def decode_token_core(params: dict, kcache: jax.Array,
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
     return sample(logits, temps, key, top_ps, top_ks), nk, nv
+
+
+def _gqa_attend_multi(q, cache_k, cache_v, lengths, cfg: LlamaConfig):
+    """Multi-query twin of _gqa_attend_cached for the speculative
+    verify forward: w in-flight queries per slot attend the same cache
+    view under a PER-QUERY causal mask (query j sees keys < its own
+    position + 1 — cached history plus the draft tokens written ahead
+    of it this round). q: (b, w, h*hd); cache_k/v: (b, L, kvh, hd);
+    lengths: (b, w) valid entries per query (incl. that query's own
+    token). Exact-zero masking (-1e30 then softmax) keeps cache bytes
+    beyond each mask bitwise-irrelevant, and the per-row reduction
+    order matches the single-query path — verify row j reproduces what
+    sequential decode would compute at that position."""
+    b, w = q.shape[:2]
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    g = h // kvh
+    qg = q.reshape(b, w, kvh, g, hd).astype(jnp.float32)
+    kf = cache_k.astype(jnp.float32)
+    scores = jnp.einsum("bwkgd,blkd->bwkgl", qg, kf) / jnp.sqrt(hd)
+    mask = (jnp.arange(cache_k.shape[1])[None, None]
+            < lengths[:, :, None])                      # (b, w, L)
+    scores = jnp.where(mask[:, :, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bwkgl,blkd->bwkgd", probs,
+                     cache_v.astype(jnp.float32))
+    return out.reshape(b, w, h * hd)
+
+
+def verify_tokens_core(params: dict, kcache: jax.Array,
+                       vcache: jax.Array, tokens: jax.Array,
+                       positions: jax.Array, cfg: LlamaConfig,
+                       write, view, attend=None):
+    """The speculative-verify transformer: decode_token_core widened
+    from one token per slot to w — same layer scan, same cache
+    write/view plumbing, so the verify forward can never drift from
+    sequential decode. tokens: (b, w) int32 where column 0 is the last
+    emitted token and columns 1..w-1 the draft; positions: (b,) cache
+    position of column 0 (= tokens_so_far - 1). All w KVs are written
+    (position p+j for column j); the returned logits (b, w, vocab)
+    f32 row j is the model's distribution for position p+j+1 — the
+    verdict on draft token j+1. No device sampling: acceptance is a
+    host decision (llm/spec.py) so rejection sampling can inspect the
+    full distribution. ``write(ck, cv, k, v)`` takes (b, w, kvh, hd)
+    slabs; ``attend(q, ck, cv, pos)`` takes q (b, w, h, hd) and the
+    (b, w) positions grid."""
+    b, w = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)           # (b, w, emb)
+    pos = positions[:, None] + jnp.arange(w, dtype=jnp.int32)[None]
+    rc, rs = _rope_tables(pos, cfg.head_dim, cfg.rope_theta)
+
+    def layer(carry, xs):
+        x = carry
+        lp, ck, cv = xs
+        y = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q, k, v = _qkv(y, lp, cfg)                          # (b, w, ...)
+        q, k = _rope(q, rc, rs), _rope(k, rc, rs)
+        ck, cv = write(ck, cv, k, v)
+        if attend is not None:
+            o = attend(q, ck, cv, pos)
+        else:
+            vk, vv = view(ck, cv)
+            o = _gqa_attend_multi(q.reshape(b, w, -1), vk, vv,
+                                  pos + 1, cfg)
+        x = x + o.astype(x.dtype) @ lp["wo"]
+        y = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + ((jax.nn.silu(y @ lp["w_gate"]) * (y @ lp["w_up"]))
+                 @ lp["w_down"])
+        return x, (ck, cv)
+
+    x, (nk, nv) = lax.scan(layer, x, (params["layers"],
+                                      kcache, vcache))
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)    # (b, w, V)
+    return logits, nk, nv
 
 
 def _decode_core(params: dict, cache: dict, tokens: jax.Array,

@@ -1,0 +1,248 @@
+"""Speculative decoding: prompt-lookup drafts + rejection-sampling accept.
+
+Draft-and-verify generation (speculative sampling, arxiv 2211.17192)
+for the paged engine. Single-token decode leaves the MXU idle between
+tiny matmuls — the paged decode kernel made each step cheap, but the
+step COUNT is untouched, so TPOT is still bounded by sequential
+forwards. Here a model-free drafter guesses up to k tokens, the engine
+scores all k+1 positions in ONE batched forward
+(kvcache.paged_verify_steps), and the longest agreeing prefix is
+accepted — emitted tokens per forward go from exactly 1 to 1..k+1
+with the output stream UNCHANGED:
+
+- at ``temperature <= 0`` acceptance is exact greedy match: a draft
+  token survives iff it equals the model's argmax at its position, so
+  the emitted stream is token-for-token identical to vanilla greedy
+  decode (pinned by tests/test_zz_spec_decode.py);
+- at ``temperature > 0`` acceptance is rejection sampling against the
+  model's (temperature -> top-k -> top-p filtered) distribution: the
+  drafter is a point mass, so draft d is accepted with probability
+  p(d) and a rejection resamples from p with d zeroed-and-renormalized
+  — the classic argument makes each emitted token an exact sample
+  from p, so the output DISTRIBUTION is unchanged (the acceptance
+  filter is lm.filter_logits, the same transform the device sampler
+  runs — one implementation, no drift).
+
+The DRAFTER is prompt-lookup / n-gram matching (reference idiom:
+vLLM's ngram speculative config, transformers' prompt_lookup_decoding):
+match the longest suffix n-gram of the request's own prompt+output
+history against that same history and propose the k tokens that
+followed the match. No draft model, no extra weights, no device work —
+drafting is pure host bookkeeping, which on agentic/RAG-style
+workloads (the answer quotes the prompt) is where most of the
+speculative win lives anyway. An accept-rate window backs the drafter
+off on adversarial low-hit prompts so verify overhead is bounded.
+
+Rejected drafts need no device rollback: their KV lands beyond the
+sequence's logical length — masked out of every attention by exact
+zeros and overwritten by the next real write at that position — and
+the host block accounting rolls back via kvcache.truncate_seq.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def spec_metrics() -> dict:
+    """Get-or-create the speculative-decoding series (shared process
+    registry, pushed to the head like every llm_* family). Catalog:
+
+      llm_spec_accept_rate    drafted-token accept rate of the most
+                              recently finished speculative request
+      llm_spec_tokens_total   draft pipeline volume, tagged {kind}:
+                              drafted | accepted | rejected
+    """
+    from ray_tpu.util import metrics as m
+    return {
+        "accept_rate": m.Gauge(
+            "llm_spec_accept_rate",
+            "Draft-token accept rate of the most recently finished "
+            "speculative request (accepted / drafted)"),
+        "tokens": m.Counter(
+            "llm_spec_tokens_total",
+            "Speculative-decode token volume by kind (drafted = "
+            "proposed by the drafter, accepted = survived verify, "
+            "rejected = rolled back)",
+            tag_keys=("kind",)),
+    }
+
+
+def width_buckets(k_max: int) -> Tuple[int, ...]:
+    """Verify-width buckets for up to ``k_max`` draft tokens: the
+    verify forward takes (slots, w) token rows and XLA compiles one
+    program per distinct w — so w is padded UP to 1+2^j (capped at
+    k_max+1), bounding compiles at ~log2(k_max)+1 regardless of how
+    accepted lengths vary (k_max=4 -> (2, 3, 5); the compile-
+    discipline test counts exactly these)."""
+    if k_max < 1:
+        raise ValueError(f"k_max must be >= 1, got {k_max}")
+    out = set()
+    j = 0
+    while True:
+        w = 1 + (1 << j)
+        out.add(min(w, k_max + 1))
+        if w >= k_max + 1:
+            return tuple(sorted(out))
+        j += 1
+
+
+def bucket_width(buckets: Sequence[int], w: int) -> int:
+    """Smallest verify bucket holding w in-flight tokens."""
+    for b in buckets:
+        if w <= b:
+            return b
+    return buckets[-1]
+
+
+class PromptLookupDrafter:
+    """Model-free n-gram drafter with accept-rate backoff. Stateless
+    over the token HISTORY (the engine passes prompt+output each
+    round — no duplicated stream to keep in sync); stateful over the
+    accept WINDOW: a sliding window of the last ``window`` drafted
+    tokens' verdicts, and when its accept rate drops below
+    ``min_rate`` the drafter goes quiet for an exponentially growing
+    cooldown (probing again after it), so a low-hit request converges
+    to vanilla decode cost instead of paying a useless verify forward
+    every round."""
+
+    def __init__(self, *, k: int = 4, ngram_max: int = 3,
+                 window: int = 16, min_rate: float = 0.25):
+        self.k = int(k)
+        self.ngram_max = int(ngram_max)
+        self.window = int(window)
+        self.min_rate = float(min_rate)
+        self._recent: deque = deque(maxlen=self.window)
+        self._cooldown = 0          # quiet rounds left before a probe
+        self._backoff = 4           # next cooldown length (doubles)
+        self.drafted = 0
+        self.accepted = 0
+
+    @property
+    def accept_rate(self) -> float:
+        return self.accepted / self.drafted if self.drafted else 0.0
+
+    def propose(self, hist: Sequence[int],
+                max_k: Optional[int] = None) -> List[int]:
+        """Up to min(k, max_k) draft tokens continuing ``hist``: the
+        longest suffix n-gram (ngram_max down to 1) is matched against
+        the history itself, preferring the LATEST match that still has
+        a full k-token continuation (a match flush against the end of
+        history predicts almost nothing — on periodic streams the
+        full-continuation preference is the difference between
+        drafting 1 token and drafting k). Returns [] when no n-gram
+        matches or the drafter is cooling off."""
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return []
+        k = self.k if max_k is None else min(self.k, int(max_k))
+        if k < 1:
+            return []
+        hist = list(hist)
+        n_hist = len(hist)
+        for n in range(min(self.ngram_max, n_hist - 1), 0, -1):
+            suf = hist[-n:]
+            best = None
+            for s in range(n_hist - n - 1, -1, -1):
+                if hist[s:s + n] == suf:
+                    if best is None:
+                        best = s
+                    if s + n + k <= n_hist:
+                        best = s
+                        break
+            if best is not None:
+                return hist[best + n:best + n + k]
+        return []
+
+    def record(self, n_drafted: int, n_accepted: int) -> None:
+        """Feed one verify round's verdict back into the window."""
+        self.drafted += n_drafted
+        self.accepted += n_accepted
+        for i in range(n_drafted):
+            self._recent.append(1 if i < n_accepted else 0)
+        if len(self._recent) < self.window:
+            return
+        rate = sum(self._recent) / len(self._recent)
+        if rate < self.min_rate:
+            self._cooldown = self._backoff
+            self._backoff = min(self._backoff * 2, 64)
+            self._recent.clear()
+        else:
+            self._backoff = 4
+
+
+def host_probs(logits: np.ndarray, temperature: float, top_k: int,
+               top_p: float) -> np.ndarray:
+    """The model's sampling distribution for ONE position, on the
+    host: temperature scale + lm.filter_logits (the SAME transform the
+    on-device sampler runs — the rejection-sampling accept must judge
+    drafts under exactly the distribution the device would sample
+    from) + softmax. Returns float64 probs summing to 1."""
+    from ray_tpu.llm.model import filter_logits
+    scaled = (np.asarray(logits, np.float32)
+              / max(float(temperature), 1e-6))[None]
+    masked = filter_logits(
+        scaled, np.asarray([top_k], np.int32),
+        np.asarray([top_p], np.float32))[0].astype(np.float64)
+    e = np.exp(masked - masked.max())
+    return e / e.sum()
+
+
+def accept_tokens(logits: np.ndarray, draft: Sequence[int], *,
+                  temperature: float, top_k: int, top_p: float,
+                  rng: np.random.Generator) -> Tuple[List[int], int]:
+    """Judge one slot's verify round. ``logits``: (len(draft)+1, V)
+    f32 — row j is the model's distribution for the position draft[j]
+    sits at (row len(draft) is the bonus position past the last
+    draft). Returns (emitted tokens, n_accepted):
+
+    - temperature <= 0: draft[j] survives while it equals argmax(row
+      j); emission is argmax(row 0..m) — the accepted drafts ARE those
+      argmaxes, plus the first disagreeing argmax (or the bonus row's
+      when everything agreed), so the stream is exactly vanilla
+      greedy's.
+    - temperature > 0: rejection sampling against p_j = host_probs(row
+      j). The point-mass drafter means draft d is accepted with
+      probability p_j(d); on rejection the replacement is drawn from
+      p_j with d zeroed and renormalized (the max(0, p-q) residual for
+      a point mass q), and a fully accepted draft earns a bonus sample
+      from the last row — each emitted token is an exact sample from
+      p_j, so the output distribution matches vanilla decode.
+
+    Always emits at least 1 token (the round replaces one decode
+    step); with an empty draft this reduces to plain host sampling of
+    row 0."""
+    draft = [int(t) for t in draft]
+    emitted: List[int] = []
+    if temperature <= 0:
+        targets = np.argmax(np.asarray(logits), axis=-1)
+        n_acc = 0
+        for j, d in enumerate(draft):
+            if int(targets[j]) != d:
+                break
+            n_acc += 1
+        emitted = [int(targets[j]) for j in range(n_acc + 1)]
+        return emitted, n_acc
+    n_acc = 0
+    for j, d in enumerate(draft):
+        p = host_probs(logits[j], temperature, top_k, top_p)
+        if rng.random() < p[d]:
+            n_acc += 1
+            emitted.append(d)
+            continue
+        residual = p.copy()
+        residual[d] = 0.0
+        s = residual.sum()
+        if s <= 0.0:        # p was a point mass ON d (degenerate):
+            emitted.append(d)       # the "rejection" can't happen
+            n_acc += 1              # under real arithmetic; accept
+            continue
+        residual /= s
+        emitted.append(int(rng.choice(len(residual), p=residual)))
+        return emitted, n_acc
+    p = host_probs(logits[len(draft)], temperature, top_k, top_p)
+    emitted.append(int(rng.choice(len(p), p=p)))
+    return emitted, n_acc

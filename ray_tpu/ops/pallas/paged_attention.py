@@ -179,3 +179,42 @@ def paged_attention_reference(q, k_pool, v_pool, tables, lengths):
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bkgl,blkd->bkgd", probs,
                       vv.astype(jnp.float32))
+
+
+def paged_attention_verify(q, k_pool, v_pool, tables, lengths):
+    """Multi-query verify attention through block tables — the gather
+    twin of ``paged_attention`` widened to w in-flight queries per
+    slot for speculative decoding: query j attends the cached history
+    PLUS the draft tokens written ahead of it this round, under a
+    per-query causal mask.
+
+    q: (slots, w, kv_heads, group, head_dim) — the last emitted token
+    plus up to w-1 draft tokens per slot; k_pool/v_pool: one layer of
+    the engine pool as in ``paged_attention``; tables: (slots, width)
+    int32; lengths: (slots, w) int32 valid positions per QUERY
+    including that query's own token (column j = cached + j + 1).
+    Returns (slots, w, kv_heads, group, head_dim) float32.
+
+    This is a gather-based implementation (materializes the table view
+    per layer, like ``paged_attention_reference``): one verify round
+    replaces w sequential decode steps, so it pays ONE gather where
+    the sequential gather path paid w — the win the spec-decode bench
+    measures. Extending the fused one-query-per-block-walk kernel
+    above to multi-query rows is future work; exact-zero masking
+    (NEG_INF then softmax) keeps pool bytes beyond each query's mask
+    bitwise-irrelevant, so verify rows reproduce sequential decode's
+    attention exactly."""
+    b, wq, kvh, g, hd = q.shape
+    _, bs, _, _ = k_pool.shape
+    w = tables.shape[1]
+    vk = k_pool[tables].reshape(b, w * bs, kvh, hd)
+    vv = v_pool[tables].reshape(b, w * bs, kvh, hd)
+    qf = q.astype(jnp.float32)
+    scores = jnp.einsum("bwkgd,blkd->bwkgl", qf,
+                        vk.astype(jnp.float32)) / jnp.sqrt(hd)
+    mask = (jnp.arange(w * bs)[None, None]
+            < lengths[:, :, None])                  # (b, wq, w*bs)
+    scores = jnp.where(mask[:, :, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bwkgl,blkd->bwkgd", probs,
+                      vv.astype(jnp.float32))

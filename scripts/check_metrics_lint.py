@@ -90,9 +90,10 @@ def instantiate_all() -> dict:
 
     from ray_tpu.runtime import core
     take(core._M_TASKS())
-    from ray_tpu.llm import engine, kvcache
+    from ray_tpu.llm import engine, kvcache, spec
     take(engine.engine_metrics())
     take(kvcache.kvcache_metrics())
+    take(spec.spec_metrics())
     from ray_tpu.serve import autoscale, fault, proxy, replica
     take(proxy.proxy_metrics())
     take(replica.replica_metrics())
@@ -192,8 +193,11 @@ CKPT_METRIC_PREFIXES = ("ckpt_",)
 # (serve/autoscale.py); ``llm_kv_`` (above) extends over the paged KV
 # cache's block gauges/counters (llm/kvcache.py); ``llm_paged_`` is
 # the paged-attention decode family (kernel-vs-gather impl counters,
-# llm/kvcache.py + ops/pallas/paged_attention.py).
-SERVE_METRIC_PREFIXES = ("serve_autoscale_", "llm_paged_")
+# llm/kvcache.py + ops/pallas/paged_attention.py); ``llm_spec_`` is
+# the speculative-decoding family (accept-rate gauge + draft token
+# volume counter, llm/spec.py).
+SERVE_METRIC_PREFIXES = ("serve_autoscale_", "llm_paged_",
+                         "llm_spec_")
 # ``goodput_`` is the step-anatomy ledger's family (util/goodput.py:
 # seconds/steps counters + the straggler-rank gauge); ``train_mfu``
 # covers extensions of the MFU gauge family.
@@ -317,6 +321,9 @@ KNOB_FAMILIES = {
     # goodput ledger: level switch + straggler z-threshold/window
     # (util/goodput.py, train/controller.py detector)
     "goodput": ("goodput_", ""),
+    # speculative decoding: master switch, draft length, n-gram
+    # horizon, accept-rate backoff window (llm/spec.py + llm/engine.py)
+    "spec": ("spec_", ""),
 }
 
 
