@@ -84,6 +84,25 @@ class Config:
     # wire traffic drops to ~1/ranks-per-node; "flat" keeps the
     # one-level ring regardless of topology.
     collective_hierarchy: str = "auto"
+    # Wire codec auto-selection + error-feedback (train/collective.py,
+    # dag/tuner.py codec band): allreduce_gradients(codec="auto") picks
+    # the cheapest probed codec (int4 < int8 < bf16 < fp32) whose
+    # observed ``allreduce_quant_error`` bound stays at or below this —
+    # a lossy codec whose bound trips backs off to bf16/fp32 on the
+    # next round.
+    collective_codec_error_bound: float = 1e-2
+    # Payloads below this many bytes always ship fp32 under
+    # codec="auto": per-block scale framing plus quant error buy
+    # nothing when the whole gradient fits a few channel slots.
+    collective_codec_min_bytes: int = 64 * 1024
+    # Error-feedback accumulation: each rank carries the quantization
+    # residual (sent-minus-shipped, reconstructed from the local codec
+    # round-trip — no extra wire) into the next round's gradients, the
+    # EF-SGD trick that makes int8/int4 gradient sync convergence-safe
+    # (ZERO_BENCH codec_convergence: int4+EF within 1e-3 relative of
+    # the fp32 trajectory; no-EF int8 is NOT). With this off,
+    # codec="auto" never picks a lossy codec.
+    codec_error_feedback: bool = True
 
     # --- pipeline parallelism (train/pipeline.py) ---
     # Default microbatch schedule for train.Pipeline: "1f1b" keeps

@@ -83,8 +83,8 @@ from ray_tpu.util import events
 
 _UNSET = object()              # "use the constructor default" sentinel
 DEFAULT_CHUNK_BYTES = 1 << 20
-QUANT_BLOCK = 256           # elements per int8 quantization block
-_QUANTIZE_MODES = (None, "int8")
+QUANT_BLOCK = 256           # elements per int8/int4 quantization block
+_QUANTIZE_MODES = (None, "int8", "int4")
 
 
 class RingPeerDead(Exception):
@@ -131,9 +131,11 @@ def allreduce_metrics() -> dict:
                               round's critical path (see _RingTrace)
       allreduce_bytes_total   wire bytes this participant wrote
       allreduce_quant_error   elementwise error bound of the last
-                              quantized round: (N * max_block_scale) / 2
-                              where scale = max|block|/127 (0 when the
-                              round was unquantized)
+                              round per wire codec ({codec=int8|int4|
+                              bf16|fp32}): (N * max_block_scale) / 2
+                              where scale = max|block|/127 (int8) or
+                              max|block|/7 (int4); 0 for the lossless
+                              and cast codecs
       allreduce_hier_inter_bytes_total  wire bytes written by this
                               participant on the CROSS-NODE (inter)
                               leg of hierarchical collectives — the
@@ -191,15 +193,18 @@ def allreduce_metrics() -> dict:
             "until a full round of attribution data exists"),
         "quant_err": m.Gauge(
             "allreduce_quant_error",
-            "Elementwise error bound of the last quantized round over "
-            "the quantization events this participant OBSERVED (frames "
-            "sent or received): (N*max_scale)/2, scale = "
-            "max|block|/127. Exact when gradient magnitudes are "
-            "comparable across ranks; partial sums quantized at "
-            "non-adjacent hops can exceed it under cross-rank "
-            "magnitude skew with cancellation. +inf when a non-finite "
-            "gradient was NaN-poisoned through the wire; 0 for "
-            "unquantized rounds"),
+            "Elementwise error bound of the last round over the "
+            "quantization events this participant OBSERVED (frames "
+            "sent or received), labelled by wire codec "
+            "(codec=int8|int4|bf16|fp16|fp32): (N*max_scale)/2, "
+            "scale = max|block|/127 (int8) or max|block|/7 (int4). "
+            "Exact when gradient magnitudes are comparable across "
+            "ranks; partial sums quantized at non-adjacent hops can "
+            "exceed it under cross-rank magnitude skew with "
+            "cancellation. +inf when a non-finite gradient was "
+            "NaN-poisoned through the wire; 0 for cast and fp32 "
+            "rounds",
+            tag_keys=("codec",)),
         "hier_inter_bytes": m.Counter(
             "allreduce_hier_inter_bytes_total",
             "Wire bytes this participant wrote on the cross-node "
@@ -389,6 +394,65 @@ def _scales_max(frame, n: int) -> float:
     return m if np.isfinite(m) else float("inf")
 
 
+def _quantize4(x: np.ndarray) -> Tuple[bytearray, float]:
+    """[nblocks float32 scales | ceil(n/2) packed bytes] — two 4-bit
+    two's-complement values per byte (even element in the low nibble),
+    per-block scale = max|block|/7 so |q| <= 7 without clipping and
+    the per-element dequantization error is bounded by scale/2. The
+    zero / NaN semantics match ``_quantize``: all-zero blocks ship
+    scale 0 (exact), non-finite blocks ship scale NaN (the whole block
+    NaN-poisons on decode; max_scale reports +inf)."""
+    n = x.size
+    nb = -(-n // QUANT_BLOCK)
+    xb = np.zeros(nb * QUANT_BLOCK, np.float32)
+    xb[:n] = x
+    xb = xb.reshape(nb, QUANT_BLOCK)
+    absmax = xb.__abs__().max(axis=1)
+    finite = np.isfinite(absmax)
+    div = np.where(finite & (absmax > 0.0), absmax / 7.0,
+                   np.float32(1.0)).astype(np.float32)
+    q = np.rint(np.where(finite[:, None], xb, np.float32(0.0))
+                / div[:, None]).astype(np.int8).reshape(-1)[:n]
+    # pack pairs into bytes: QUANT_BLOCK is even, so only the tail of
+    # an odd-length payload pads — the pad nibble is 0 and never read
+    if n % 2:
+        q = np.concatenate([q, np.zeros(1, np.int8)])
+    u = q.view(np.uint8) & 0x0F
+    packed = (u[0::2] | (u[1::2] << 4)).astype(np.uint8)
+    scales = np.where(finite,
+                      np.where(absmax > 0.0, absmax / 7.0,
+                               np.float32(0.0)),
+                      np.float32(np.nan)).astype(np.float32)
+    if not n:
+        max_scale = 0.0
+    elif finite.all():
+        max_scale = float(absmax.max()) / 7.0
+    else:
+        max_scale = float("inf")
+    frame = bytearray(4 * nb + (n + 1) // 2)
+    frame[:4 * nb] = scales.tobytes()
+    frame[4 * nb:] = packed.tobytes()
+    return frame, max_scale
+
+
+def _dequantize4(frame, n: int) -> np.ndarray:
+    nb = -(-n // QUANT_BLOCK)
+    scales = np.frombuffer(frame, np.float32, nb)
+    packed = np.frombuffer(frame, np.uint8, (n + 1) // 2,
+                           offset=4 * nb)
+    u = np.empty(2 * packed.size, np.uint8)
+    u[0::2] = packed & 0x0F
+    u[1::2] = packed >> 4
+    # sign-extend the 4-bit two's-complement nibbles
+    q = ((u.astype(np.int16) ^ 8) - 8).astype(np.float32)
+    out = np.zeros(nb * QUANT_BLOCK, np.float32)
+    out[:n] = q[:n]
+    out = out.reshape(nb, QUANT_BLOCK)
+    out *= scales[:, None]
+    # NaN scales poison the ENTIRE block, same as _dequantize
+    return out.reshape(-1)[:n]
+
+
 # --- wire codecs ---------------------------------------------------------
 #
 # A codec transforms chunk frames on the wire while accumulation stays
@@ -416,6 +480,30 @@ class _Int8Codec:
     def decode(self, frame, n: int, wire: np.dtype) -> np.ndarray:
         self.max_scale = max(self.max_scale, _scales_max(frame, n))
         out = _dequantize(frame, n)
+        return out if wire == np.float32 else out.astype(wire)
+
+
+class _Int4Codec:
+    """Two quantized values per byte with per-block scales — ~12.9% of
+    the fp32 wire bytes (4-bit payload + f32 scales per 256 elements).
+    Coarser than int8 (15 levels per block), so gradient sync with
+    this codec NEEDS error-feedback accumulation (train/collective.py)
+    to stay convergence-safe; the bound rides the same
+    ``allreduce_quant_error`` gauge under {codec=int4}."""
+
+    tag = "int4"
+
+    def __init__(self):
+        self.max_scale = 0.0     # feeds the allreduce_quant_error gauge
+
+    def encode(self, arr: np.ndarray) -> bytes:
+        frame, smax = _quantize4(arr)
+        self.max_scale = max(self.max_scale, smax)
+        return bytes(frame)
+
+    def decode(self, frame, n: int, wire: np.dtype) -> np.ndarray:
+        self.max_scale = max(self.max_scale, _scales_max(frame, n))
+        out = _dequantize4(frame, n)
         return out if wire == np.float32 else out.astype(wire)
 
 
@@ -465,9 +553,51 @@ def resolve_wire_dtype(wire_dtype) -> Optional[np.dtype]:
 def _make_codec(quantize: Optional[str], wdt: Optional[np.dtype]):
     if quantize == "int8":
         return _Int8Codec()
+    if quantize == "int4":
+        return _Int4Codec()
     if wdt is not None:
         return _CastCodec(wdt)
     return None
+
+
+def codec_roundtrip(x: np.ndarray, quantize: str) -> np.ndarray:
+    """What a lossy wire codec would RECONSTRUCT from ``x`` — the local
+    encode/decode round-trip error-feedback accumulation subtracts to
+    recover the residual the wire dropped, without any extra frames
+    (train/collective.py ErrorFeedback). Block boundaries here follow
+    the flat vector; the ring chunks by channel slot, so per-element
+    scales can differ slightly — EF only needs the residual to be a
+    faithful estimate, not bitwise wire parity."""
+    codec = _make_codec(quantize, None)
+    if codec is None:
+        return np.asarray(x, np.float32)
+    flat = np.ascontiguousarray(np.asarray(x, np.float32)).reshape(-1)
+    return codec.decode(codec.encode(flat), flat.size,
+                        np.dtype(np.float32))
+
+
+# Last observed per-codec error bound in THIS process (what _finish
+# just pushed to the tagged gauge) — the live signal
+# ``allreduce_gradients(codec="auto")`` consults to back off a codec
+# whose bound tripped. Keyed by codec tag ("int8"/"int4"/"bf16"/...).
+_LAST_QUANT_ERR: Dict[str, float] = {}
+
+
+def last_quant_error(tag: str) -> Optional[float]:
+    """The most recent ``allreduce_quant_error`` this process observed
+    for one codec tag, or None when that codec never ran here."""
+    return _LAST_QUANT_ERR.get(tag)
+
+
+def _codec_gauge_tag(q: Optional[str], codec) -> str:
+    """The {codec=...} label value for one round: the quantize mode
+    when set, the cast codec's short name, "fp32" otherwise."""
+    if q:
+        return q
+    wdt = getattr(codec, "wdt", None)
+    if wdt is not None:
+        return "bf16" if "bfloat16" in str(wdt) else "fp16"
+    return "fp32"
 
 
 def rebuild_from_layout(flat: np.ndarray, layout: dict):
@@ -1119,8 +1249,10 @@ class RingReducer:
             # the cross-node leg of a hierarchical collective: THE
             # traffic the ring-of-rings exists to shrink
             self._m["hier_inter_bytes"].inc(self._wrote)
-        self._m["quant_err"].set(
-            0.5 * self._qmax * self.size if self._q else 0.0)
+        tag = _codec_gauge_tag(self._q, self._codec)
+        err = 0.5 * self._qmax * self.size if self._q else 0.0
+        self._m["quant_err"].set(err, tags={"codec": tag})
+        _LAST_QUANT_ERR[tag] = err
         self._m[key].observe(time.monotonic() - t0)
         if self._tr is not None:
             try:            # tracing must never mask the round's error
@@ -1180,7 +1312,7 @@ class RingReducer:
 
     def _check_codec_wire(self, wire: np.dtype):
         if self._codec is not None and wire.kind != "f":
-            name = ("int8 block quantization" if self._q
+            name = (f"{self._q} block quantization" if self._q
                     else f"wire_dtype={self._codec.tag!r}")
             raise TypeError(
                 f"{name} requires floating-point values "

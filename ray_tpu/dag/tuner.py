@@ -47,6 +47,20 @@ _CACHE: Dict[str, dict] = {}     # group id -> profile entry
 _DEFAULT: Optional[str] = None   # last probed group (compile-time table)
 _MAX_ENTRIES = 64                # rings come and go with incarnations
 
+# The codec band rides beside the impl/chunk profile: per ring
+# generation, per wire codec, the probed round time and the observed
+# quant-error bound. Separate cache because codec probes are optional
+# (``allreduce_gradients(codec="auto")`` triggers them lazily) and a
+# generation bump must drop BOTH — invalidate() clears this too.
+_CODEC_CACHE: Dict[str, dict] = {}   # group id -> codec band entry
+
+# Probe preference order, cheapest wire first: auto selection walks
+# this list and takes the first codec whose probed error bound clears
+# Config.collective_codec_error_bound (lossy codecs additionally
+# require error-feedback to be on).
+CODEC_ORDER = ("int4", "int8", "bf16", "fp32")
+_LOSSY = ("int4", "int8")
+
 
 def _cfg():
     from ray_tpu.config import get_config
@@ -84,14 +98,19 @@ def register_profile(group: str, size: int, alpha_s: float,
 
 def invalidate(group: Optional[str] = None) -> None:
     """Drop one ring generation's profile (or all of them): the next
-    collective on a tuning ring re-probes."""
+    collective on a tuning ring re-probes. Clears the codec band for
+    the same generation too — an elastic reshape changes the wire
+    (different size, possibly different hosts), so a cached codec
+    choice from the dead topology must not survive the bump."""
     global _DEFAULT
     with _LOCK:
         if group is None:
             _CACHE.clear()
+            _CODEC_CACHE.clear()
             _DEFAULT = None
         else:
             _CACHE.pop(group, None)
+            _CODEC_CACHE.pop(group, None)
             if _DEFAULT == group:
                 _DEFAULT = None
 
@@ -249,3 +268,115 @@ def table(key: Optional[str], size: int,
     else:
         rows.append({"max_bytes": None, "impl": "ring"})
     return rows
+
+
+# --- the codec band -------------------------------------------------------
+
+
+def codec_profile_for(group: str, size: int) -> Optional[dict]:
+    """The cached codec band for a ring generation, or None (the
+    signal to probe): {"size": N, "codecs": {tag: {"round_s", "err"}}}.
+    Same generation discipline as the impl profile — a same-named
+    group at a different world size never reuses the band."""
+    with _LOCK:
+        e = _CODEC_CACHE.get(group or "")
+        return e if e is not None and e["size"] == int(size) else None
+
+
+def register_codec_profile(group: str, size: int, codec: str,
+                           round_s: float, err: float) -> dict:
+    """Record one codec's probed round time + observed quant-error
+    bound for a ring generation (the probe path, and the injection
+    hook benches/tests use)."""
+    with _LOCK:
+        if len(_CODEC_CACHE) >= _MAX_ENTRIES:
+            oldest = min(_CODEC_CACHE,
+                         key=lambda k: _CODEC_CACHE[k]["probed_at"])
+            del _CODEC_CACHE[oldest]
+        e = _CODEC_CACHE.setdefault(
+            group or "", {"group": group or "", "size": int(size),
+                          "codecs": {}, "probed_at": time.time()})
+        if e["size"] != int(size):      # stale generation — replace
+            e = {"group": group or "", "size": int(size),
+                 "codecs": {}, "probed_at": time.time()}
+            _CODEC_CACHE[group or ""] = e
+        e["codecs"][codec] = {"round_s": float(round_s),
+                              "err": float(err)}
+        e["probed_at"] = time.time()
+        return e
+
+
+_CODEC_KW = {"int4": {"quantize": "int4"},
+             "int8": {"quantize": "int8"},
+             "bf16": {"wire_dtype": "bfloat16"},
+             "fp32": {}}
+
+
+def probe_codecs(ring) -> Optional[dict]:
+    """One timed small round per wire codec on the live ring,
+    recording wall time and the ``allreduce_quant_error`` bound the
+    round observed. Probes are collectives — every rank runs the
+    identical sequence in lockstep; the payload is rank-seeded noise
+    (option validation only needs the OPTIONS to agree, and rank-skewed
+    values exercise the bound the way real gradients do). Codecs whose
+    wire prerequisites are missing on this host (bf16 without
+    ml_dtypes) are skipped, never fatal."""
+    import numpy as np
+    from ray_tpu.dag import ring as ring_mod
+    n = max(1, int(getattr(_cfg(), "collective_tuner_probe_bytes",
+                           1 << 20)) // 32)
+    v = np.random.default_rng(1 + getattr(ring, "rank", 0)) \
+        .standard_normal(n).astype(np.float32)
+    out = None
+    for tag in CODEC_ORDER:
+        kw = _CODEC_KW[tag]
+        try:
+            t0 = time.perf_counter()
+            ring.reduce(v, op="mean", **kw)
+            dt = time.perf_counter() - t0
+        except Exception:   # codec unavailable on this deployment
+            continue
+        err = ring_mod.last_quant_error(tag)
+        out = register_codec_profile(getattr(ring, "group", ""),
+                                     ring.size, tag, dt,
+                                     0.0 if err is None else err)
+    return out
+
+
+def choose_codec(payload_bytes: Optional[int], size: int, *,
+                 key: Optional[str] = None,
+                 ef_enabled: bool = True,
+                 live_err: Optional[Dict[str, float]] = None) -> str:
+    """Resolve ``codec="auto"`` for one payload: the cheapest wire
+    codec that is SAFE for this round. Small payloads (below
+    Config.collective_codec_min_bytes) stay fp32 — framing overhead
+    and quant error buy nothing on a wire that cheap. Lossy codecs
+    (int4/int8) require error-feedback; with EF off they are never
+    chosen (bf16 is the floor). A codec is rejected when its probed
+    error bound OR its live ``allreduce_quant_error`` reading (pass
+    ``live_err={tag: bound}``) exceeds
+    Config.collective_codec_error_bound. No codec band probed yet →
+    bf16 with EF on, fp32 without (safe until measured)."""
+    cfg = _cfg()
+    bound = float(getattr(cfg, "collective_codec_error_bound", 1e-2))
+    min_b = int(getattr(cfg, "collective_codec_min_bytes", 64 * 1024))
+    if payload_bytes is not None and int(payload_bytes) < min_b:
+        return "fp32"
+    band = codec_profile_for(key or "", size)
+    if band is None:
+        return "bf16" if ef_enabled else "fp32"
+    codecs = band["codecs"]
+    for tag in CODEC_ORDER:
+        if tag == "fp32":
+            break               # the unconditional floor
+        if tag in _LOSSY and not ef_enabled:
+            continue
+        if tag not in codecs:
+            continue            # not probed (or probe failed) here
+        err = codecs[tag]["err"]
+        if live_err and tag in live_err:
+            err = max(err, live_err[tag])
+        if tag in _LOSSY and err > bound:
+            continue            # the bound tripped — back off
+        return tag
+    return "fp32"

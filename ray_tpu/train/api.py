@@ -320,6 +320,11 @@ class TrainContext:
         self._recovered_mirrors = list(payload.get("recovered") or [])
         self._lost_info = dict(payload.get("lost") or {})
         self.generation += 1
+        # any error-feedback residual was accumulated against the old
+        # incarnation's wire: drop it here so the next compensated
+        # round starts provably zeroed even if a caller bypasses the
+        # (group_id, generation) rekey (train/collective.ErrorFeedback)
+        self._grad_ef = None
         return {"rank": self.rank, "world_size": self.world_size,
                 "generation": self.generation,
                 "group_id": self.group_id,

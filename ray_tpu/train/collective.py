@@ -95,18 +95,19 @@ def _validate_codec_opts(value: Any, op: str, quantize: Optional[str],
     """The single-worker paths still validate like the ring would: a
     bad op/quantize/wire_dtype (or a codec over non-float leaves) must
     not pass on 1 worker and only explode at scale."""
-    from ray_tpu.dag.ring import _flatten, _wire_dtype, resolve_wire_dtype
+    from ray_tpu.dag.ring import (_QUANTIZE_MODES, _flatten, _wire_dtype,
+                                  resolve_wire_dtype)
     if op not in ("sum", "mean", "max", "min"):
         raise ValueError(f"unknown op {op!r}")
-    if quantize not in (None, "int8"):
-        raise ValueError(f"quantize must be None or 'int8', "
+    if quantize not in _QUANTIZE_MODES:
+        raise ValueError(f"quantize must be one of {_QUANTIZE_MODES}, "
                          f"got {quantize!r}")
     wdt = resolve_wire_dtype(wire_dtype)
     if quantize is not None and wdt is not None:
         raise ValueError("quantize and wire_dtype are both wire codecs "
                          "— pass at most one")
-    if quantize == "int8" or wdt is not None:
-        name = ("int8 block quantization" if quantize
+    if quantize is not None or wdt is not None:
+        name = (f"{quantize} block quantization" if quantize
                 else f"wire_dtype={wire_dtype!r}")
         leaves, _, _ = _flatten(value)
         for leaf in leaves:
@@ -115,6 +116,80 @@ def _validate_codec_opts(value: Any, op: str, quantize: Optional[str],
                 raise TypeError(
                     f"{name} requires floating-point values "
                     f"(wire dtype would be {w})")
+
+
+# --- error-feedback compression ------------------------------------------
+#
+# Lossy wire codecs (int8/int4 block quantization) drop part of every
+# gradient on the floor. Plain quantized SGD compounds that bias step
+# over step; error-feedback (EF-SGD / 1-bit Adam lineage) carries the
+# dropped part forward instead: each rank keeps a per-element fp32
+# residual r, ships roundtrip(g + r), and sets
+# r <- (g + r) - roundtrip(g + r). The residual is reconstructed
+# LOCALLY from the codec round-trip — no extra wire — and the
+# compensated stream's time-average equals the true gradient stream,
+# which is what makes int4 gradient sync convergence-safe
+# (ZERO_BENCH codec_convergence rows pair every codec with its loss
+# trajectory vs fp32).
+
+
+class ErrorFeedback:
+    """Per-rank error-feedback accumulator for lossy gradient codecs.
+
+    The residual lives over the FULL flat gradient space (every rank
+    compensates what IT contributes; reduce-scatter/allreduce then mix
+    the compensated streams). It is keyed by (generation, layout,
+    codec): ANY change — elastic reshard, a different pytree, a codec
+    switch — re-zeroes it, the "provably zeroed, never silently stale"
+    contract. Bucketed syncs own per-bucket slices: bucket cuts are
+    leaf-aligned flat offsets, so ``compensate``/``absorb`` take an
+    ``offset`` and each bucket round-trips exactly the slice it ships.
+    """
+
+    def __init__(self):
+        self.residual: Optional[np.ndarray] = None
+        self.key = None             # (generation, total, codec tag)
+
+    def ensure(self, *, gen, total: int, tag: str) -> bool:
+        """(Re)key the residual buffer for one (generation, layout,
+        codec); returns True when it was (re)zeroed."""
+        key = (gen, int(total), tag)
+        if self.key != key or self.residual is None:
+            self.residual = np.zeros(int(total), np.float32)
+            self.key = key
+            return True
+        return False
+
+    def compensate(self, flat: np.ndarray, offset: int = 0) -> np.ndarray:
+        """gradient + carried residual for the ``[offset, offset+n)``
+        slice of the flat space (a fresh fp32 array — the caller's
+        input is never mutated)."""
+        r = self.residual[offset:offset + flat.size]
+        return np.asarray(flat, np.float32).reshape(-1) + r
+
+    def absorb(self, comp: np.ndarray, quantize: Optional[str],
+               offset: int = 0) -> None:
+        """residual <- compensated - what the codec ships, from the
+        LOCAL encode/decode round-trip (``ring.codec_roundtrip``) —
+        the wire never carries residuals."""
+        from ray_tpu.dag.ring import codec_roundtrip
+        shipped = codec_roundtrip(comp, quantize)
+        self.residual[offset:offset + comp.size] = comp - shipped
+
+    def invalidate(self) -> None:
+        self.residual = None
+        self.key = None
+
+
+def _grad_ef(ctx) -> ErrorFeedback:
+    """The context-scoped accumulator ``allreduce_gradients(codec=...)``
+    uses (one per train context — re-keyed, not shared, across
+    incarnations via the (group_id, generation) in its key)."""
+    ef = getattr(ctx, "_grad_ef", None)
+    if not isinstance(ef, ErrorFeedback):
+        ef = ErrorFeedback()
+        ctx._grad_ef = ef
+    return ef
 
 
 # --- bucketed gradient sync ----------------------------------------------
@@ -378,9 +453,101 @@ def _ring_call(ctx, timeout_s: Optional[float], fn,
         raise peer_lost_error(e) from e
 
 
+# codec= names the WHOLE wire policy in one arg; each concrete tag
+# maps to the (quantize, wire_dtype) pair the ring understands
+_CODEC_NAMES = ("auto", "int4", "int8", "bf16", "fp32")
+_CODEC_WIRE = {"int4": ("int4", None), "int8": ("int8", None),
+               "bf16": (None, "bfloat16"), "fp32": (None, None)}
+
+
+def _resolve_codec(ctx, value, codec: str, ef_enabled: bool,
+                   timeout_s: Optional[float]) -> str:
+    """``codec="auto"`` → a concrete tag for THIS payload: probe the
+    ring's codec band once per generation (probes are collectives —
+    every rank reaches this in lockstep with identical options, the
+    same argument the impl tuner rides), then let the tuner pick the
+    cheapest codec whose probed AND live ``allreduce_quant_error``
+    stay under Config.collective_codec_error_bound."""
+    if codec != "auto":
+        return codec
+    from ray_tpu.config import get_config
+    from ray_tpu.dag import tuner
+    from ray_tpu.dag import ring as ring_mod
+    payload = int(sum(_leaf_nbytes(l) for l in _raw_leaves(value)))
+    ring = ctx.gradient_sync_ring()
+    key, size = getattr(ring, "group", ""), ring.size
+    if tuner.codec_profile_for(key, size) is None and \
+            getattr(get_config(), "collective_tuner", True):
+        _ring_call(ctx, timeout_s, tuner.probe_codecs)
+    live = {}
+    for t in ("int8", "int4"):
+        e = ring_mod.last_quant_error(t)
+        if e is not None:
+            live[t] = e
+    return tuner.choose_codec(payload, size, key=key,
+                              ef_enabled=ef_enabled, live_err=live)
+
+
+def _ef_allreduce(ctx, value, op: str, quantize: str,
+                  bucket_bytes: Optional[int],
+                  timeout_s: Optional[float]):
+    """Lossy-codec allreduce with error-feedback: flatten to fp32, add
+    the carried residual, ship the compensated flat vector, keep
+    (compensated - local codec round-trip) for the next round. The
+    bucketed variant cuts the SAME leaf-aligned parts as the plain
+    bucketed sync and each bucket absorbs exactly its own residual
+    slice (per-bucket round-trip, so block boundaries match what that
+    bucket's frames actually shipped)."""
+    if op not in ("sum", "mean"):
+        raise ValueError(
+            f"error-feedback gradient sync carries a linear residual — "
+            f"op must be 'sum' or 'mean', got {op!r}")
+    _validate_codec_opts(value, op, quantize, None)
+    from ray_tpu.dag.ring import rebuild_from_layout
+    from ray_tpu.train.zero import _flat
+    flat, rebuild, total, leaves = _flat(value, np.dtype(np.float32))
+    layout = {"rebuild": rebuild,
+              "leaves": [(l.shape, l.size, l.dtype) for l in leaves]}
+    ef = _grad_ef(ctx)
+    ef.ensure(gen=(ctx.group_id, getattr(ctx, "generation", 0)),
+              total=total, tag=quantize)
+    comp = ef.compensate(flat)
+    if bucket_bytes is None:
+        ef.absorb(comp, quantize)
+        out = _ring_call(
+            ctx, timeout_s,
+            lambda ring: ring.reduce(comp, op=op, quantize=quantize),
+            bump_step=True)
+        return rebuild_from_layout(
+            np.asarray(out, np.float32).reshape(-1), layout)
+    offs, cum = [], 0
+    for a, b in _bucket_parts(leaves, bucket_bytes):
+        n = int(sum(l.size for l in leaves[a:b]))
+        offs.append((cum, cum + n))
+        cum += n
+
+    def stage(i):
+        a, b = offs[i]
+        seg = comp[a:b]
+        ef.absorb(seg, quantize, offset=a)
+        return seg
+
+    def run(ring):
+        outs, _ = _pipeline_buckets(
+            len(offs), stage,
+            lambda i, seg: ring.reduce(seg, op=op, quantize=quantize))
+        return np.concatenate(
+            [np.asarray(o, np.float32).reshape(-1) for o in outs]) \
+            if outs else np.empty(0, np.float32)
+
+    out = _ring_call(ctx, timeout_s, run, bump_step=True)
+    return rebuild_from_layout(out, layout)
+
+
 def allreduce_gradients(value: Any, op: str = "mean", *,
                         quantize: Optional[str] = None,
                         wire_dtype: Optional[str] = None,
+                        codec: Optional[str] = None,
                         bucket_bytes: Optional[int] = None,
                         timeout_s: Optional[float] = None) -> Any:
     """Elementwise allreduce of a host gradient pytree (dict / list /
@@ -390,9 +557,11 @@ def allreduce_gradients(value: Any, op: str = "mean", *,
     pipeline around the ring, accumulation is float32-or-wider).
 
     ``quantize="int8"`` ships chunks block-quantized — ~26% of the fp32
-    wire bytes; the per-round elementwise error bound
+    wire bytes (``"int4"``: two values per byte, ~13%, coarse enough
+    that it should only run under error-feedback — see ``codec``
+    below); the per-round elementwise error bound
     (world_size * max_block_scale / 2) is exported as the
-    ``allreduce_quant_error`` gauge. ``wire_dtype="bfloat16"`` instead
+    ``allreduce_quant_error`` gauge, labelled by codec. ``wire_dtype="bfloat16"`` instead
     ships chunks cast to bfloat16 — half the fp32 bytes, ~2^-8 relative
     rounding per hop, still accumulating in float32 per the
     accumulation_dtype rules (bf16 gradient sync for groups that do not
@@ -412,12 +581,46 @@ def allreduce_gradients(value: Any, op: str = "mean", *,
     reshape implies (bitwise equal whenever sums are exact). All
     ranks must pass the same ``bucket_bytes``.
 
+    ``codec`` names the whole wire policy in one arg — "int4", "int8",
+    "bf16", "fp32", or "auto" — and is mutually exclusive with
+    ``quantize``/``wire_dtype``. Lossy codecs chosen this way run with
+    **error-feedback accumulation** (Config.codec_error_feedback, on
+    by default): each rank carries the quantization residual into the
+    next round, which is what makes int8/int4 convergence-safe
+    (ZERO_BENCH codec_convergence). ``codec="auto"`` probes the ring's
+    codec band once per generation (dag/tuner.py) and picks the
+    cheapest codec whose observed ``allreduce_quant_error`` stays
+    under Config.collective_codec_error_bound — payloads under
+    Config.collective_codec_min_bytes stay fp32, and with EF off the
+    lossy codecs are never chosen.
+
     Every worker must call this the same number of times with matching
     layouts and options; a worker that dies mid-ring surfaces as a
     RuntimeError on every survivor within the ring timeout."""
     ctx = get_context()
     if bucket_bytes is not None and bucket_bytes <= 0:
         raise ValueError("bucket_bytes must be > 0")
+    if codec is not None:
+        if quantize is not None or wire_dtype is not None:
+            raise ValueError(
+                "codec and quantize/wire_dtype are competing wire "
+                "selectors — pass at most one")
+        if codec not in _CODEC_NAMES:
+            raise ValueError(
+                f"codec must be one of {_CODEC_NAMES}, got {codec!r}")
+        from ray_tpu.config import get_config
+        ef_on = bool(getattr(get_config(), "codec_error_feedback", True))
+        if ctx.get_world_size() == 1:
+            tag = "fp32" if codec == "auto" else codec
+            q, w = _CODEC_WIRE[tag]
+            _validate_codec_opts(value, op, q, w)
+            return value
+        tag = _resolve_codec(ctx, value, codec, ef_on, timeout_s)
+        quantize, wire_dtype = _CODEC_WIRE[tag]
+        if quantize is not None and ef_on:
+            return _ef_allreduce(ctx, value, op, quantize,
+                                 bucket_bytes, timeout_s)
+        # lossless/cast resolution falls through to the plain path
     if ctx.get_world_size() == 1:
         _validate_codec_opts(value, op, quantize, wire_dtype)
         return value

@@ -34,6 +34,14 @@ ZERO_BENCH.json as well as stdout. Headline numbers at 64 MB / 4
 participants: per-rank optimizer-moment bytes (≈1/N of replicated),
 zero_step wire bytes vs the allreduce path, and max parameter
 divergence vs a replicated-optimizer baseline.
+
+``--codecs`` benches the wire-codec band: the same payload allreduced
+through fp32 / bf16 / int8 / int4 (plus the lossy codecs'
+reduce-scatter leg) for per-codec wire/time/error rows, and the
+error-feedback convergence A/B — fp32 vs int8+EF vs int4+EF (no-EF
+variants for contrast) over a real optax adam trajectory. Merged into
+ZERO_BENCH.json; ``codec_convergence_*_rel_final`` are the
+acceptance numbers (EF variants within 1e-3 relative of fp32).
 """
 
 from __future__ import annotations
@@ -680,6 +688,192 @@ def run_zero(quick: bool) -> dict:
     return summary
 
 
+def _codec_participant(spec, rank, nbytes, rounds, out_q):
+    """One process, one ring rank: the SAME payload allreduced through
+    every wire codec (fp32 / bf16 / int8 / int4), plus the lossy
+    codecs' reduce-scatter leg — the leg a ZeRO grad sync actually
+    ships — so the per-codec wire and error rows come off one ring."""
+    from ray_tpu.dag.ring import (RingReducer, allreduce_metrics,
+                                  last_quant_error)
+
+    n_el = nbytes // 4
+    n = spec["size"]
+    grads = np.random.default_rng(rank).standard_normal(n_el).astype(
+        np.float32)
+    ring = RingReducer.from_spec(spec)
+    metrics = allreduce_metrics()
+    ring.reduce(np.zeros(1024, np.float32))     # attach + allocations
+    exact = None
+    if rank == 0:
+        exact = np.zeros(n_el, np.float64)
+        for r in range(n):
+            exact += np.random.default_rng(r).standard_normal(n_el)
+        exact /= n
+    out = {"rank": rank, "codecs": {}}
+    for tag, kw in (("fp32", {}), ("bf16", {"wire_dtype": "bfloat16"}),
+                    ("int8", {"quantize": "int8"}),
+                    ("int4", {"quantize": "int4"})):
+        try:
+            got = ring.reduce(grads, op="mean", **kw)       # warmup
+        except Exception:           # codec unavailable (e.g. no bf16)
+            continue
+        wire0 = sum(metrics["bytes"]._values.values())
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            got = ring.reduce(grads, op="mean", **kw)
+        elapsed = time.perf_counter() - t0
+        row = {"round_s": (elapsed / rounds),
+               "wire_bytes": (sum(metrics["bytes"]._values.values())
+                              - wire0) / rounds}
+        if tag in ("int8", "int4"):
+            row["quant_error_bound"] = last_quant_error(tag)
+            ring.reduce_scatter(grads, op="mean", quantize=tag)
+            w0 = sum(metrics["bytes"]._values.values())
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                ring.reduce_scatter(grads, op="mean", quantize=tag)
+            row["rs_round_s"] = (time.perf_counter() - t0) / rounds
+            row["rs_wire_bytes"] = (
+                sum(metrics["bytes"]._values.values()) - w0) / rounds
+        if rank == 0:
+            row["max_err"] = float(
+                np.abs(got.astype(np.float64) - exact).max())
+        out["codecs"][tag] = row
+    out_q.put(out)
+    for ch in ring.channels():
+        ch.close()
+
+
+def run_codec_wire(size_mb: int, nparts: int = 4,
+                   rounds: int = 3) -> list:
+    """Per-codec wire/time/error rows at one payload size."""
+    from ray_tpu.dag.channel import ShmRingChannel
+
+    nbytes = size_mb * MB
+    channels, edges = [], []
+    for _ in range(nparts):
+        ch = ShmRingChannel(create=True, nslots=8, slot_bytes=2 * MB)
+        channels.append(ch)
+        edges.append(ch.spec())
+    specs = [{"rank": r, "size": nparts, "op": "sum", "timeout_s": 300.0,
+              "to_next": edges[r], "from_prev": edges[(r - 1) % nparts]}
+             for r in range(nparts)]
+    ctx = mp.get_context("fork")
+    out_q = ctx.Queue()
+    procs = [ctx.Process(target=_codec_participant,
+                         args=(specs[r], r, nbytes, rounds, out_q))
+             for r in range(nparts)]
+    for p in procs:
+        p.start()
+    outs = [out_q.get(timeout=900) for _ in range(nparts)]
+    for p in procs:
+        p.join(timeout=60)
+    for ch in channels:
+        ch.close()
+        ch.unlink()
+    r0 = next(o for o in outs if o["rank"] == 0)
+    rows = []
+    for tag in ("fp32", "bf16", "int8", "int4"):
+        if tag not in r0["codecs"]:
+            continue
+        per = [o["codecs"][tag] for o in outs]
+        row = {"mode": f"codec_{tag}", "size_mb": size_mb,
+               "participants": nparts, "rounds": rounds,
+               "round_s": round(max(p["round_s"] for p in per), 4),
+               "wire_bytes_per_participant": int(max(
+                   p["wire_bytes"] for p in per)),
+               "max_elementwise_err": r0["codecs"][tag].get("max_err")}
+        if "rs_wire_bytes" in per[0]:
+            row["rs_round_s"] = round(max(
+                p["rs_round_s"] for p in per), 4)
+            row["rs_wire_bytes_per_participant"] = int(max(
+                p["rs_wire_bytes"] for p in per))
+            row["quant_error_bound"] = r0["codecs"][tag][
+                "quant_error_bound"]
+        rows.append(row)
+    return rows
+
+
+def _codec_convergence_variant(quantize, error_feedback, steps=1500,
+                               n_ranks=4, dim=256, nbatch=2048,
+                               lr=1e-2):
+    """One optimizer trajectory: full-batch least squares (noisy
+    labels, over-determined so the loss FLOOR is real and a relative
+    final-loss comparison means something), optax adam, gradients
+    synced through the codec round-trip per simulated rank — with or
+    without the error-feedback residual. Returns (final tail loss,
+    worst mid-training loss, curve every 100 steps)."""
+    import optax
+
+    from ray_tpu.dag.ring import codec_roundtrip
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(nbatch, dim)).astype(np.float32)
+    w_true = rng.normal(size=dim).astype(np.float32)
+    y = (X @ w_true + 0.1 * rng.normal(size=nbatch)).astype(np.float32)
+    opt = optax.adam(lr)
+    w = np.zeros(dim, np.float32)
+    st = opt.init(w)
+    resid = [np.zeros(dim, np.float32) for _ in range(n_ranks)]
+    losses = []
+    for _ in range(steps):
+        shipped, ltot = [], 0.0
+        for rk in range(n_ranks):
+            lo, hi = nbatch * rk // n_ranks, nbatch * (rk + 1) // n_ranks
+            Xi, yi = X[lo:hi], y[lo:hi]
+            r = Xi @ w - yi
+            ltot += float(np.mean(r * r)) / n_ranks
+            g = ((2.0 / len(yi)) * (Xi.T @ r)).astype(np.float32)
+            if quantize is None:
+                shipped.append(g)
+            elif error_feedback:
+                comp = g + resid[rk]
+                ship = codec_roundtrip(comp, quantize)
+                resid[rk] = comp - ship
+                shipped.append(ship)
+            else:
+                shipped.append(codec_roundtrip(g, quantize))
+        mean_g = np.mean(shipped, axis=0,
+                         dtype=np.float64).astype(np.float32)
+        upd, st = opt.update(mean_g, st, w)
+        w = (w + np.asarray(upd, np.float32)).astype(np.float32)
+        losses.append(ltot)
+    return (float(np.mean(losses[-20:])), losses,
+            [round(l, 6) for l in losses[::100]])
+
+
+def run_codec_convergence(steps: int = 1500) -> list:
+    """The convergence A/B every codec claim ships with: the same
+    trajectory under fp32 / int8+EF / int4+EF, with the no-EF lossy
+    variants for contrast. ``loss_rel_final`` is the acceptance
+    number (int8_ef / int4_ef must sit within 1e-3 of fp32);
+    ``loss_rel_worst`` shows the whole-curve drift no-EF hides from a
+    final-loss-only comparison."""
+    variants = (("fp32", None, False), ("int8_ef", "int8", True),
+                ("int4_ef", "int4", True), ("int8_noef", "int8", False),
+                ("int4_noef", "int4", False))
+    rows = []
+    base_curve = None
+    for name, q, ef in variants:
+        final, curve, sampled = _codec_convergence_variant(q, ef,
+                                                           steps=steps)
+        row = {"mode": "codec_convergence", "variant": name,
+               "steps": steps, "final_loss": round(final, 9),
+               "loss_curve_every_100": sampled}
+        if name == "fp32":
+            base_curve = curve
+            row["loss_rel_final"] = 0.0
+            row["loss_rel_worst"] = 0.0
+        else:
+            row["loss_rel_final"] = round(
+                abs(final - np.mean(base_curve[-20:]))
+                / np.mean(base_curve[-20:]), 9)
+            row["loss_rel_worst"] = round(max(
+                abs(c - b) / b for c, b in zip(curve, base_curve)), 6)
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr, flush=True)
+    return rows
+
+
 def run_trace_overhead(quick: bool) -> dict:
     """A/B the collective tracing levels on the ring hot path: the
     same config at trace_level off / round / chunk. The acceptance
@@ -760,6 +954,10 @@ def main():
     ap.add_argument("--zero-bucketed", action="store_true",
                     help="bucketed-vs-unbucketed ZeRO step overlap "
                          "row; merged into ZERO_BENCH.json")
+    ap.add_argument("--codecs", action="store_true",
+                    help="per-codec wire/time/error rows (fp32/bf16/"
+                         "int8/int4 over one ring) + the error-feedback "
+                         "convergence A/B; merged into ZERO_BENCH.json")
     args = ap.parse_args()
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -803,6 +1001,57 @@ def main():
             json.dump(base, f)
             f.write("\n")
         print(json.dumps(row), flush=True)
+        return
+
+    if args.codecs:
+        size_mb = 8 if args.quick else 64
+        wire_rows = run_codec_wire(size_mb)
+        for r in wire_rows:
+            print(json.dumps(r), file=sys.stderr, flush=True)
+        conv_rows = run_codec_convergence(400 if args.quick else 1500)
+        out = os.path.join(root, "ZERO_BENCH.json")
+        try:
+            with open(out) as f:
+                base = json.load(f)
+        except Exception:
+            base = {"bench": "zero", "results": []}
+        # one row per (mode, size) / convergence variant: re-runs
+        # replace, never duplicate
+        wire_modes = {r["mode"] for r in wire_rows}
+
+        def keep(r):
+            if r.get("mode") == "codec_convergence":
+                return False
+            return not (r.get("mode") in wire_modes
+                        and r.get("size_mb") == size_mb)
+
+        base["results"] = [r for r in base.get("results", [])
+                           if keep(r)]
+        base["results"].extend(wire_rows + conv_rows)
+        # headline keys, size-labelled so --quick can't clobber 64 MB
+        by_mode = {r["mode"]: r for r in wire_rows}
+        bw = by_mode["codec_fp32"]["wire_bytes_per_participant"]
+        for tag in ("bf16", "int8", "int4"):
+            r = by_mode.get(f"codec_{tag}")
+            if r is None:
+                continue
+            base[f"codec_{tag}_wire_fraction_{size_mb}mb_4p"] = round(
+                r["wire_bytes_per_participant"] / bw, 3)
+            if "rs_wire_bytes_per_participant" in r:
+                # the acceptance pin: int4 RS leg <= 0.25x the fp32
+                # allreduce bytes
+                base[f"codec_{tag}_rs_wire_fraction_{size_mb}mb_4p"] \
+                    = round(r["rs_wire_bytes_per_participant"] / bw, 3)
+        for r in conv_rows:
+            if r["variant"] != "fp32":
+                base[f"codec_convergence_{r['variant']}_rel_final"] \
+                    = r["loss_rel_final"]
+        with open(out, "w") as f:
+            json.dump(base, f)
+            f.write("\n")
+        print(json.dumps({"bench": "codecs", "size_mb": size_mb,
+                          "wire": wire_rows,
+                          "convergence": conv_rows}), flush=True)
         return
 
     if args.trace:

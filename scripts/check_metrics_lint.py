@@ -202,11 +202,16 @@ SERVE_METRIC_PREFIXES = ("serve_autoscale_", "llm_paged_",
 # seconds/steps counters + the straggler-rank gauge); ``train_mfu``
 # covers extensions of the MFU gauge family.
 GOODPUT_METRIC_PREFIXES = ("goodput_", "train_mfu")
+# ``allreduce_quant_`` is the wire-codec error family (dag/ring.py):
+# one gauge labelled {codec=int8|int4|bf16|fp16|fp32} — a call site
+# inventing a sibling series must register it the same way.
+COLLECTIVE_METRIC_PREFIXES = ("allreduce_quant_",)
 METRIC_FAMILY_PREFIXES = (DEVICE_METRIC_PREFIXES
                           + HEALTH_METRIC_PREFIXES
                           + CKPT_METRIC_PREFIXES
                           + SERVE_METRIC_PREFIXES
-                          + GOODPUT_METRIC_PREFIXES)
+                          + GOODPUT_METRIC_PREFIXES
+                          + COLLECTIVE_METRIC_PREFIXES)
 
 # prefixed literals that are NOT metric names: control RPC method
 # names etc. (Config knob names are exempted wholesale below — the
@@ -324,18 +329,26 @@ KNOB_FAMILIES = {
     # speculative decoding: master switch, draft length, n-gram
     # horizon, accept-rate backoff window (llm/spec.py + llm/engine.py)
     "spec": ("spec_", ""),
+    # wire codec selection + error feedback: auto-codec error bound /
+    # min payload (collective_codec_*) and the EF master switch
+    # (codec_error_feedback) — train/collective.py + dag/tuner.py.
+    # A family may enumerate SEVERAL (prefix, suffix) pairs.
+    "codec": (("collective_codec", ""), ("codec_error_feedback", "")),
 }
 
 
 def family_knobs(family: str) -> list:
-    """Every ray_tpu/config.py Config knob in one lint family."""
+    """Every ray_tpu/config.py Config knob in one lint family. A
+    family spec is one (prefix, suffix) pair or a tuple of them."""
     from dataclasses import fields
 
     from ray_tpu.config import Config
-    prefix, suffix = KNOB_FAMILIES[family]
+    spec = KNOB_FAMILIES[family]
+    pairs = spec if spec and isinstance(spec[0], tuple) else (spec,)
     return sorted(f.name for f in fields(Config)
-                  if f.name.startswith(prefix)
-                  and f.name.endswith(suffix))
+                  if any(f.name.startswith(prefix)
+                         and f.name.endswith(suffix)
+                         for prefix, suffix in pairs))
 
 
 def chaos_knobs() -> list:

@@ -142,6 +142,94 @@ def test_chaos_kill_midstep_reshards_to_n_minus_1(cluster, tmp_path):
         [m["loss"] for m in hist], _reference_losses(), **TOL)
 
 
+def test_chaos_reshard_preserves_error_feedback_discipline(
+        cluster, tmp_path):
+    """int8+error-feedback gradient sync through the SAME mid-step kill:
+    the quantization residual is nonzero while training (EF is live),
+    provably dropped at the reshard (a residual accumulated against the
+    3-rank split must never compensate 2-rank frames), and the job
+    still completes one continuous trajectory at the codec's
+    tolerance."""
+    marker = os.path.join(str(tmp_path), "died_once")
+    problem, loss_grad = _problem, _loss_grad
+    steps_n, die_at, dim, lr = STEPS, DIE_AT, DIM, LR
+
+    def train_fn():
+        import os as _os
+        import signal as _signal
+        import time as _time
+
+        import numpy as _np
+        import optax
+
+        from ray_tpu import train as _train
+        ctx = _train.get_context()
+        X, y = problem()
+        params = {"w": _np.zeros(dim, _np.float32)}
+        opt = _train.ShardedOptimizer(optax.adam(lr),
+                                      grad_quantize="int8",
+                                      error_feedback=True,
+                                      mirror_interval_steps=1)
+        state = opt.init(params)
+
+        def resid():
+            ef = opt._ef
+            return float(_np.abs(ef.residual).max()) \
+                if ef is not None and ef.residual is not None else -1.0
+
+        step, resid_pre, dropped = 0, 0.0, 0
+        while step < steps_n:
+            loss, g = loss_grad(params["w"], X, y)
+            if step == die_at and ctx.generation == 0 \
+                    and ctx.get_world_rank() == 1 \
+                    and not _os.path.exists(marker):
+                open(marker, "w").close()
+                _time.sleep(0.5)
+                _os.kill(_os.getpid(), _signal.SIGKILL)
+            try:
+                params, state = opt.update({"w": g}, state, params)
+            except _train.PeerLostError:
+                resid_pre = resid()     # accumulated against 3 ranks
+                _train.await_regroup(timeout_s=60)
+                state = opt.reshard(state)
+                # reshard() must have invalidated the accumulator
+                dropped = int(opt._ef is None or opt._ef.residual is None)
+                continue
+            _train.report({"step": step, "loss": loss,
+                           "world": ctx.get_world_size(),
+                           "generation": ctx.generation,
+                           "resid": resid(), "resid_pre": resid_pre,
+                           "resid_dropped": dropped})
+            step += 1
+            _time.sleep(0.15)
+
+    res = train.JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(
+            num_workers=(2, 3), sync_timeout_s=8.0,
+            elastic_grow_interval_s=0.0),
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=1))).fit()
+    assert res.error is None, res.error
+    assert os.path.exists(marker), "the victim never fired"
+    hist = [m for m in res.metrics_history if "step" in m]
+    assert [m["step"] for m in hist] == list(range(STEPS))
+    assert set(m["world"] for m in hist[DIE_AT:]) == {2}
+    assert hist[-1]["generation"] == 1          # resharded, no restart
+    # EF was live on the old split: residual nonzero both during the
+    # 3-rank prefix and at the moment the peer died
+    assert all(m["resid"] > 0 for m in hist[1:DIE_AT]), hist[:DIE_AT]
+    assert hist[-1]["resid_pre"] > 0
+    # ...and provably dropped at the reshard, then rebuilt at 2 ranks
+    assert hist[-1]["resid_dropped"] == 1
+    assert all(m["resid"] > 0 for m in hist[DIE_AT:]), hist[DIE_AT:]
+    # loss continuity at the codec's tolerance: int8+EF tracks the
+    # exact fp32 reference within the quantized sync's noise floor
+    np.testing.assert_allclose(
+        [m["loss"] for m in hist], _reference_losses(),
+        rtol=0.05, atol=5e-3)
+
+
 @pytest.mark.slow
 def test_chaos_kill_midstep_checkpoint_restore_same_tolerance(
         cluster, tmp_path):

@@ -142,13 +142,16 @@ def test_hier_allreduce_bitwise_parity_vs_flat_2x2():
 
 
 def test_hier_codecs_on_inter_leg_bitwise_identical_across_ranks():
-    """int8 / bf16 wire codecs ride the cross-node leg only: results
-    stay bitwise identical across ALL ranks (owner round-trip +
-    verbatim broadcast), and the int8 error stays within the
-    documented (L * max_scale)/2-style bound."""
+    """int8 / int4 / bf16 wire codecs ride the cross-node leg only:
+    results stay bitwise identical across ALL ranks (owner round-trip
+    + verbatim broadcast), and each codec's error stays within its
+    documented (L * max_scale)/2-style bound (int4's 15-level blocks
+    are ~18x coarser than int8's — hence the looser pin)."""
     vals = _int_vals(4, n_el=2048, extra=0)
     exact = sum(v["w"].astype(np.float64) for v in vals) / 4
-    for codec_kw in ({"quantize": "int8"}, {"wire_dtype": "bfloat16"}):
+    for codec_kw, tol in (({"quantize": "int8"}, 0.25),
+                          ({"quantize": "int4"}, 3.0),
+                          ({"wire_dtype": "bfloat16"}, 0.25)):
         gen = _make_hier([2, 2])
         reds = next(gen)
         outs = _all(reds, lambda g: g.reduce(
@@ -156,7 +159,7 @@ def test_hier_codecs_on_inter_leg_bitwise_identical_across_ranks():
         for o in outs[1:]:
             assert np.array_equal(o["w"], outs[0]["w"])
         err = np.abs(outs[0]["w"].astype(np.float64) - exact).max()
-        assert err < 0.25, (codec_kw, err)   # quantized, not garbage
+        assert err < tol, (codec_kw, err)    # quantized, not garbage
         gen.close()
     # fp32 control: exact
     gen = _make_hier([2, 2])
