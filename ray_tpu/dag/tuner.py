@@ -287,7 +287,11 @@ def register_codec_profile(group: str, size: int, codec: str,
                            round_s: float, err: float) -> dict:
     """Record one codec's probed round time + observed quant-error
     bound for a ring generation (the probe path, and the injection
-    hook benches/tests use)."""
+    hook benches/tests use). Eviction here is per-process and may
+    leave RANKS disagreeing about what is cached — safe only because
+    the probe decision is AGREED on the ring (_resolve_codec
+    max-reduces the cache-miss bit, so one rank's eviction re-probes
+    on all ranks in lockstep, never a lone collective)."""
     with _LOCK:
         if len(_CODEC_CACHE) >= _MAX_ENTRIES:
             oldest = min(_CODEC_CACHE,
@@ -312,34 +316,65 @@ _CODEC_KW = {"int4": {"quantize": "int4"},
              "fp32": {}}
 
 
+def codec_wire_available(tag: str) -> bool:
+    """LOCAL availability of one wire codec's prerequisites (bf16
+    needs ml_dtypes; the lossy codecs need their frame cutters). No
+    collectives here — a per-rank availability check must never be a
+    round some peers skip."""
+    import numpy as np
+    from ray_tpu.dag import ring as ring_mod
+    try:
+        if tag == "bf16":
+            ring_mod.resolve_wire_dtype("bfloat16")
+        elif tag in _LOSSY:
+            ring_mod.codec_roundtrip(np.ones(2, np.float32), tag)
+        return True
+    except Exception:   # noqa: BLE001 — "missing" is the answer
+        return False
+
+
 def probe_codecs(ring) -> Optional[dict]:
     """One timed small round per wire codec on the live ring,
     recording wall time and the ``allreduce_quant_error`` bound the
-    round observed. Probes are collectives — every rank runs the
-    identical sequence in lockstep; the payload is rank-seeded noise
-    (option validation only needs the OPTIONS to agree, and rank-skewed
-    values exercise the bound the way real gradients do). Codecs whose
-    wire prerequisites are missing on this host (bf16 without
-    ml_dtypes) are skipped, never fatal."""
+    round observed. Probes are collectives, so the probe LIST must be
+    identical on every rank: availability is checked locally first
+    (``codec_wire_available`` — no collective can fail on a subset of
+    hosts without stranding the rest), then min-agreed on the ring so
+    a codec probes only where EVERY rank has its prerequisites. A
+    genuine collective failure mid-probe (peer death, timeout) is
+    terminal for the group and PROPAGATES — swallowing it would leave
+    peers blocked in a round this rank skipped. The payload is
+    rank-seeded noise (rank-skewed values exercise the error bound the
+    way real gradients do), and the recorded band is itself max-agreed
+    — per-rank clocks and quant errors differ, but every rank must
+    register the bitwise-identical band for ``choose_codec`` to
+    resolve the same tag everywhere."""
     import numpy as np
     from ray_tpu.dag import ring as ring_mod
+    avail = np.array([1.0 if codec_wire_available(t) else 0.0
+                      for t in CODEC_ORDER], np.float64)
+    agreed_avail = ring.reduce(avail, op="min")
+    tags = [t for t, a in zip(CODEC_ORDER, agreed_avail) if a > 0]
     n = max(1, int(getattr(_cfg(), "collective_tuner_probe_bytes",
                            1 << 20)) // 32)
     v = np.random.default_rng(1 + getattr(ring, "rank", 0)) \
         .standard_normal(n).astype(np.float32)
-    out = None
-    for tag in CODEC_ORDER:
-        kw = _CODEC_KW[tag]
-        try:
-            t0 = time.perf_counter()
-            ring.reduce(v, op="mean", **kw)
-            dt = time.perf_counter() - t0
-        except Exception:   # codec unavailable on this deployment
-            continue
+    stats: List[float] = []
+    for tag in tags:
+        t0 = time.perf_counter()
+        ring.reduce(v, op="mean", **_CODEC_KW[tag])
+        stats.append(time.perf_counter() - t0)
         err = ring_mod.last_quant_error(tag)
+        stats.append(0.0 if err is None else float(err))
+    # max over ranks: the ring is as slow as its slowest rank, and the
+    # error bound must cover every rank's frames
+    agreed = ring.reduce(np.array(stats, np.float64), op="max")
+    out = None
+    for i, tag in enumerate(tags):
         out = register_codec_profile(getattr(ring, "group", ""),
-                                     ring.size, tag, dt,
-                                     0.0 if err is None else err)
+                                     ring.size, tag,
+                                     float(agreed[2 * i]),
+                                     float(agreed[2 * i + 1]))
     return out
 
 
@@ -356,7 +391,10 @@ def choose_codec(payload_bytes: Optional[int], size: int, *,
     error bound OR its live ``allreduce_quant_error`` reading (pass
     ``live_err={tag: bound}``) exceeds
     Config.collective_codec_error_bound. No codec band probed yet →
-    bf16 with EF on, fp32 without (safe until measured)."""
+    bf16 when that state is transient (EF on, the tuner enabled to
+    probe on the next round, ml_dtypes importable), fp32 otherwise —
+    with the tuner off nothing will ever probe, so "auto" must not
+    park forever on a codec whose prerequisites may not even import."""
     cfg = _cfg()
     bound = float(getattr(cfg, "collective_codec_error_bound", 1e-2))
     min_b = int(getattr(cfg, "collective_codec_min_bytes", 64 * 1024))
@@ -364,7 +402,11 @@ def choose_codec(payload_bytes: Optional[int], size: int, *,
         return "fp32"
     band = codec_profile_for(key or "", size)
     if band is None:
-        return "bf16" if ef_enabled else "fp32"
+        if not ef_enabled \
+                or not getattr(cfg, "collective_tuner", True) \
+                or not codec_wire_available("bf16"):
+            return "fp32"
+        return "bf16"
     codecs = band["codecs"]
     for tag in CODEC_ORDER:
         if tag == "fp32":

@@ -167,14 +167,30 @@ class ErrorFeedback:
         r = self.residual[offset:offset + flat.size]
         return np.asarray(flat, np.float32).reshape(-1) + r
 
+    def pending(self, comp: np.ndarray,
+                quantize: Optional[str]) -> np.ndarray:
+        """What the residual WILL become once this round ships:
+        compensated - what the codec ships, from the LOCAL
+        encode/decode round-trip (``ring.codec_roundtrip``) — the wire
+        never carries residuals. Computed BEFORE the collective,
+        committed (``commit``) only after it returns: a round that
+        raises leaves the residual untouched, so a retry at the same
+        key re-compensates the identical stream instead of
+        double-compensating a round that never shipped."""
+        from ray_tpu.dag.ring import codec_roundtrip
+        flat = np.asarray(comp, np.float32).reshape(-1)
+        return flat - codec_roundtrip(flat, quantize)
+
+    def commit(self, pend: np.ndarray, offset: int = 0) -> None:
+        """Install a ``pending`` residual slice — call after the ring
+        round that shipped its frames came back successfully."""
+        self.residual[offset:offset + pend.size] = pend
+
     def absorb(self, comp: np.ndarray, quantize: Optional[str],
                offset: int = 0) -> None:
-        """residual <- compensated - what the codec ships, from the
-        LOCAL encode/decode round-trip (``ring.codec_roundtrip``) —
-        the wire never carries residuals."""
-        from ray_tpu.dag.ring import codec_roundtrip
-        shipped = codec_roundtrip(comp, quantize)
-        self.residual[offset:offset + comp.size] = comp - shipped
+        """``pending`` + ``commit`` in one step, for call sites that
+        already sit after the collective (and the unit tests)."""
+        self.commit(self.pending(comp, quantize), offset)
 
     def invalidate(self) -> None:
         self.residual = None
@@ -462,28 +478,48 @@ _CODEC_WIRE = {"int4": ("int4", None), "int8": ("int8", None),
 
 def _resolve_codec(ctx, value, codec: str, ef_enabled: bool,
                    timeout_s: Optional[float]) -> str:
-    """``codec="auto"`` → a concrete tag for THIS payload: probe the
-    ring's codec band once per generation (probes are collectives —
-    every rank reaches this in lockstep with identical options, the
-    same argument the impl tuner rides), then let the tuner pick the
-    cheapest codec whose probed AND live ``allreduce_quant_error``
-    stay under Config.collective_codec_error_bound."""
+    """``codec="auto"`` → a concrete tag for THIS payload, AGREED
+    across ranks. The inputs to the choice are rank-local — the live
+    ``allreduce_quant_error`` gauge reflects only the frames THIS rank
+    cut (each rank quantizes different partial sums), and the tuner's
+    codec band can be evicted on one rank but not another — so a
+    per-rank choice could hand different wire options to the same
+    collective round (frames decoding as garbage, or a hang).
+    Resolution is therefore itself a tiny collective: ranks max-reduce
+    [band-missing, live int8 err, live int4 err] on the ring, probe
+    the band in lockstep when ANY rank lacks it, and feed the agreed
+    (worst-case) errors to ``choose_codec`` — every input is then
+    bitwise identical on every rank, so every rank resolves the same
+    tag. Payloads under Config.collective_codec_min_bytes short out to
+    fp32 from layout+config alone, with no agreement round."""
     if codec != "auto":
         return codec
     from ray_tpu.config import get_config
     from ray_tpu.dag import tuner
     from ray_tpu.dag import ring as ring_mod
+    cfg = get_config()
     payload = int(sum(_leaf_nbytes(l) for l in _raw_leaves(value)))
     ring = ctx.gradient_sync_ring()
     key, size = getattr(ring, "group", ""), ring.size
-    if tuner.codec_profile_for(key, size) is None and \
-            getattr(get_config(), "collective_tuner", True):
+    if payload < int(getattr(cfg, "collective_codec_min_bytes",
+                             64 * 1024)):
+        return "fp32"
+    if not getattr(cfg, "collective_tuner", True):
+        # no probe/agreement machinery without the tuner: consult only
+        # the (identically injected, if at all) band — never the
+        # rank-local live gauge, which could split the choice
+        return tuner.choose_codec(payload, size, key=key,
+                                  ef_enabled=ef_enabled)
+    vote = np.array(
+        [1.0 if tuner.codec_profile_for(key, size) is None else 0.0,
+         ring_mod.last_quant_error("int8") or 0.0,
+         ring_mod.last_quant_error("int4") or 0.0], np.float64)
+    agreed = _ring_call(ctx, timeout_s,
+                        lambda r: r.reduce(vote, op="max"))
+    if agreed[0] > 0:
         _ring_call(ctx, timeout_s, tuner.probe_codecs)
-    live = {}
-    for t in ("int8", "int4"):
-        e = ring_mod.last_quant_error(t)
-        if e is not None:
-            live[t] = e
+    live = {t: float(e) for t, e in
+            (("int8", agreed[1]), ("int4", agreed[2])) if e > 0}
     return tuner.choose_codec(payload, size, key=key,
                               ef_enabled=ef_enabled, live_err=live)
 
@@ -513,11 +549,15 @@ def _ef_allreduce(ctx, value, op: str, quantize: str,
               total=total, tag=quantize)
     comp = ef.compensate(flat)
     if bucket_bytes is None:
-        ef.absorb(comp, quantize)
+        # residual commits only AFTER the round ships: a raise leaves
+        # it untouched, so a same-key retry re-compensates the exact
+        # same stream (nothing reached the wire that round)
+        pend = ef.pending(comp, quantize)
         out = _ring_call(
             ctx, timeout_s,
             lambda ring: ring.reduce(comp, op=op, quantize=quantize),
             bump_step=True)
+        ef.commit(pend)
         return rebuild_from_layout(
             np.asarray(out, np.float32).reshape(-1), layout)
     offs, cum = [], 0
@@ -525,22 +565,34 @@ def _ef_allreduce(ctx, value, op: str, quantize: str,
         n = int(sum(l.size for l in leaves[a:b]))
         offs.append((cum, cum + n))
         cum += n
+    pend: dict = {}
 
     def stage(i):
         a, b = offs[i]
         seg = comp[a:b]
-        ef.absorb(seg, quantize, offset=a)
+        pend[i] = ef.pending(seg, quantize)
         return seg
 
     def run(ring):
-        outs, _ = _pipeline_buckets(
-            len(offs), stage,
-            lambda i, seg: ring.reduce(seg, op=op, quantize=quantize))
+        def rf(i, seg):
+            o = ring.reduce(seg, op=op, quantize=quantize)
+            # this bucket's frames shipped — its residual slice is real
+            ef.commit(pend.pop(i), offset=offs[i][0])
+            return o
+
+        outs, _ = _pipeline_buckets(len(offs), stage, rf)
         return np.concatenate(
             [np.asarray(o, np.float32).reshape(-1) for o in outs]) \
             if outs else np.empty(0, np.float32)
 
-    out = _ring_call(ctx, timeout_s, run, bump_step=True)
+    try:
+        out = _ring_call(ctx, timeout_s, run, bump_step=True)
+    except BaseException:
+        # some buckets shipped, some did not: the residual's slices
+        # now describe different rounds — zero it rather than let a
+        # retry double-compensate the committed part
+        ef.invalidate()
+        raise
     return rebuild_from_layout(out, layout)
 
 
@@ -587,12 +639,16 @@ def allreduce_gradients(value: Any, op: str = "mean", *,
     **error-feedback accumulation** (Config.codec_error_feedback, on
     by default): each rank carries the quantization residual into the
     next round, which is what makes int8/int4 convergence-safe
-    (ZERO_BENCH codec_convergence). ``codec="auto"`` probes the ring's
-    codec band once per generation (dag/tuner.py) and picks the
+    (ZERO_BENCH codec_convergence). ``codec="auto"`` picks the
     cheapest codec whose observed ``allreduce_quant_error`` stays
     under Config.collective_codec_error_bound — payloads under
     Config.collective_codec_min_bytes stay fp32, and with EF off the
-    lossy codecs are never chosen.
+    lossy codecs are never chosen. The resolution is itself a tiny
+    agreed collective (one max-reduce of the live error gauges, plus
+    the dag/tuner.py codec-band probe once per generation): the inputs
+    to the choice are rank-local, and the chosen tag sets the round's
+    wire options, so ranks must agree on it or the ring would decode
+    mismatched frames.
 
     Every worker must call this the same number of times with matching
     layouts and options; a worker that dies mid-ring surfaces as a

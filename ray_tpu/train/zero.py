@@ -383,6 +383,7 @@ class ShardedOptimizer:
                     "layout")
         else:
             ef = self._ef_for(g, total)
+            pend = None
             if ef is not None:
                 gflat, _, gtotal, _ = _flat(grads, np.dtype(np.float32))
                 if gtotal != total:
@@ -390,7 +391,7 @@ class ShardedOptimizer:
                         "gradient layout does not match the parameter "
                         "layout")
                 send = ef.compensate(gflat)
-                ef.absorb(send, self.grad_quantize)
+                pend = ef.pending(send, self.grad_quantize)
             else:
                 send = grads
             gshard = np.asarray(self._wrap_peer_lost(
@@ -399,6 +400,13 @@ class ShardedOptimizer:
                     quantize=self.grad_quantize
                     if self.grad_quantize is not None else _UNSET)),
                 dtype=wire)
+            if ef is not None:
+                # commit only after the round shipped: a raise above
+                # leaves the residual untouched, so a same-key retry
+                # re-compensates the identical stream instead of
+                # double-compensating a round that never reached the
+                # wire
+                ef.commit(pend)
             lo, hi = g.seg_bounds(total)
             if gshard.size != hi - lo:
                 raise ValueError(
@@ -467,26 +475,40 @@ class ShardedOptimizer:
         for _, _, t, _, _ in buckets:
             offs.append(offs[-1] + t)
 
+        pend: dict = {}
+
         def stage(i):
             a, b = buckets[i][0], buckets[i][1]
             if ef is None:
                 return [_stage(l) for l in graw[a:b]]
             # EF stages the bucket as ONE flat fp32 slice: this bucket
             # owns exactly its residual slice of the flat space, and
-            # the absorb round-trips the same slice its frames ship
+            # the round-trip covers the same slice its frames ship
             seg = np.concatenate(
                 [np.asarray(l, np.float32).reshape(-1)
                  for l in graw[a:b]]) if b > a \
                 else np.empty(0, np.float32)
             comp = ef.compensate(seg, offset=offs[i])
-            ef.absorb(comp, self.grad_quantize, offset=offs[i])
+            pend[i] = ef.pending(comp, self.grad_quantize)
             return comp
 
-        outs, _ = _pipeline_buckets(
-            len(buckets), stage,
-            lambda i, staged: self._wrap_peer_lost(
-                lambda: g.reduce_scatter(staged, op="mean",
-                                         quantize=q)))
+        def rs(i, staged):
+            out = self._wrap_peer_lost(
+                lambda: g.reduce_scatter(staged, op="mean", quantize=q))
+            if ef is not None:
+                # this bucket's frames shipped — its slice is real
+                ef.commit(pend.pop(i), offset=offs[i])
+            return out
+
+        try:
+            outs, _ = _pipeline_buckets(len(buckets), stage, rs)
+        except BaseException:
+            if ef is not None:
+                # some buckets shipped, some did not: the residual's
+                # slices describe different rounds — zero it rather
+                # than let a retry double-compensate the shipped part
+                ef.invalidate()
+            raise
         lens = [hi - lo for _, _, _, lo, hi in buckets]
         for o, ln in zip(outs, lens):
             if np.asarray(o).size != ln:

@@ -467,18 +467,90 @@ def test_resolve_codec_auto_on_live_rings_switches_by_knobs():
         tags = _all(reds, lambda red: _resolve_codec(
             ctxs[red.rank], big, "auto", True, None))
         assert tags == ["int4"] * 4
-        # the band is cached now — no more collectives needed, the
-        # remaining checks can run single-threaded
+        # a small payload resolves from layout+config alone — fp32,
+        # no agreement round, safe to call single-threaded
         assert _resolve_codec(ctxs[0], {"w": np.zeros(8, np.float32)},
                               "auto", True, None) == "fp32"
+        # every other resolution is ITSELF a collective (the
+        # live-error agreement round) — knob flips run on all ranks
         cfg.collective_codec_error_bound = 1e-9
-        assert _resolve_codec(ctxs[0], big, "auto", True, None) \
-            in ("bf16", "fp32")
+        tags = _all(reds, lambda red: _resolve_codec(
+            ctxs[red.rank], big, "auto", True, None))
+        assert len(set(tags)) == 1 and tags[0] in ("bf16", "fp32")
         cfg.collective_codec_error_bound = 100.0
-        assert _resolve_codec(ctxs[0], big, "auto", False, None) \
-            in ("bf16", "fp32")
+        tags = _all(reds, lambda red: _resolve_codec(
+            ctxs[red.rank], big, "auto", False, None))
+        assert len(set(tags)) == 1 and tags[0] in ("bf16", "fp32")
+        # a concrete codec= passes straight through, no collective
         assert _resolve_codec(ctxs[0], big, "int8", True, None) == "int8"
     finally:
+        (cfg.collective_codec_error_bound,
+         cfg.collective_codec_min_bytes) = saved
+        gen.close()
+
+
+def test_resolve_codec_agrees_across_divergent_rank_local_state():
+    """The cross-rank agreement contract: the live error gauge and the
+    tuner's band cache are rank-local (each rank quantizes different
+    partial sums; LRU eviction is per-process), so without agreement
+    ranks near the error bound would resolve DIFFERENT codecs and feed
+    the same collective mismatched wire options. The resolution round
+    max-reduces those inputs: a hot gauge on ONE rank backs every rank
+    off the lossy codec, and a band miss on ONE rank re-probes on all
+    ranks in lockstep (the test completing without a ring timeout IS
+    the lockstep assertion)."""
+    import threading
+
+    from ray_tpu.train.collective import _resolve_codec
+    cfg = get_config()
+    saved = (cfg.collective_codec_error_bound,
+             cfg.collective_codec_min_bytes)
+    gen = _make_ring(4)
+    reds = next(gen)
+    ctxs = [_FakeCtx(red) for red in reds]
+    big = {"w": np.zeros(64 * 1024, np.float32)}
+    tls = threading.local()
+    orig_err = ring_mod.last_quant_error
+    orig_prof = tuner.codec_profile_for
+
+    def fake_err(tag):
+        d = getattr(tls, "live", None)
+        return d.get(tag, orig_err(tag)) if d else orig_err(tag)
+
+    def fake_prof(key, size):
+        if getattr(tls, "evicted", False):
+            tls.evicted = False      # one miss, as an eviction would be
+            return None
+        return orig_prof(key, size)
+
+    try:
+        cfg.collective_codec_min_bytes = 64 * 1024
+        cfg.collective_codec_error_bound = 1.0
+        for tag, err in (("int4", 1e-6), ("int8", 1e-6),
+                         ("bf16", 0.0), ("fp32", 0.0)):
+            tuner.register_codec_profile("", 4, tag, 1e-3, err)
+        ring_mod.last_quant_error = fake_err
+        tuner.codec_profile_for = fake_prof
+
+        def run_live(red):
+            # rank 2's gauge alone trips the bound for int4
+            tls.live = {"int4": 50.0} if red.rank == 2 else {"int4": 1e-6}
+            tls.evicted = False
+            return _resolve_codec(ctxs[red.rank], big, "auto", True, None)
+
+        tags = _all(reds, run_live)
+        assert tags == ["int8"] * 4, tags
+
+        def run_evicted(red):
+            tls.live = None
+            tls.evicted = red.rank == 1
+            return _resolve_codec(ctxs[red.rank], big, "auto", True, None)
+
+        tags = _all(reds, run_evicted)
+        assert len(set(tags)) == 1, tags
+    finally:
+        ring_mod.last_quant_error = orig_err
+        tuner.codec_profile_for = orig_prof
         (cfg.collective_codec_error_bound,
          cfg.collective_codec_min_bytes) = saved
         gen.close()
