@@ -356,6 +356,29 @@ class Config:
     # per-rank step window the p50s are taken over.
     goodput_straggler_z: float = 6.0
     goodput_straggler_window_steps: int = 32
+    # Hang & desync forensics (util/forensics.py): the bounded
+    # per-rank collective ledger (group/seq/kind/codec/options-sig,
+    # enqueued|in_flight|done|aborted). On by default — recording is
+    # two dict writes per round riding the clock reads the round-level
+    # trace already pays (FORENSICS_BENCH.json: within noise). Off =
+    # no ledger, no watchdog signal, autopsy bundles carry no ledgers.
+    forensics_ledger: bool = True
+    forensics_ledger_size: int = 256
+    # Controller watchdog: a collective in_flight on any rank past
+    # this deadline (or a persistent straggler signal) triggers the
+    # cross-rank ledger audit — pull every rank's ledger, diff, name
+    # the culprit as a collective_stall/collective_desync event + the
+    # forensics_stall_rank health sentinel + a postmortem bundle.
+    forensics_stall_timeout_s: float = 60.0
+    # Opt-in pre-flight desync guard (train/collective.py): "step"
+    # agrees the options-signature across ranks once per train step,
+    # "round" before every collective — turning a codec/options
+    # desync into a typed, named CollectiveDesyncError instead of a
+    # ring hang. Costs one rendezvous-actor round trip per check, so
+    # it is off by default (a debugging lever, per the PERF runbook).
+    forensics_verify_level: str = "off"
+    # Where postmortem-<step>.json bundles land ("" = <tmp>/ray_tpu_forensics).
+    forensics_dir: str = ""
 
     # --- durable checkpoint plane (train/ckptio.py) ---
     # How long the rank-0 commit coordinator waits for every rank's

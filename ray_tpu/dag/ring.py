@@ -79,7 +79,7 @@ from ray_tpu.dag.channel import (DATA, ERROR, ChannelAttachRefused,
                                  ChannelClosed, ChannelTimeout,
                                  attach_channel, chaos_mark_retry)
 from ray_tpu.runtime.serialization import dumps_oob, loads_oob
-from ray_tpu.util import events
+from ray_tpu.util import events, forensics
 
 _UNSET = object()              # "use the constructor default" sentinel
 DEFAULT_CHUNK_BYTES = 1 << 20
@@ -975,6 +975,12 @@ class RingReducer:
         self._ph = "hdr"                  # current phase for chunk spans
         self._seg_tx = self._seg_rx = -1  # current segments in flight
         self._abort = False               # set by abort() (any thread)
+        # Hang/desync forensics: the process-wide collective ledger
+        # this ring feeds (util/forensics.py). Resolved once here —
+        # per round the cost is two dict appends when on, one None
+        # check when off.
+        self._fx = forensics.ledger() if forensics.enabled() else None
+        self._fx_tok: Optional[int] = None
 
     @classmethod
     def from_spec(cls, spec: Dict[str, Any],
@@ -1058,8 +1064,19 @@ class RingReducer:
         dead neighbor must not wait out the full ring timeout before
         it can re-form). The next sliced wait raises RingPeerDead with
         a reshape message; the flag is sticky for this ring — a
-        reshaped group attaches a FRESH ring."""
+        reshaped group attaches a FRESH ring. Any in-flight ledger
+        entry is stamped terminal ``aborted`` HERE (not just when the
+        blocked op unwinds) so a post-abort audit never reports a
+        phantom in-flight collective from a rank that already gave
+        up."""
         self._abort = True
+        try:
+            if self._fx is not None and self._fx_tok is not None:
+                self._fx.exit(self._fx_tok, state="aborted",
+                              err="abort(): ring declared dead while "
+                                  "the collective was in flight")
+        except Exception:   # noqa: BLE001 — bookkeeping must not mask
+            pass
 
     def _op_sliced(self, op):
         """Run one channel op under the ring timeout, sliced into
@@ -1173,6 +1190,13 @@ class RingReducer:
         attribution rides frames that move anyway)."""
         if self._tr is not None:
             hdr.update(self._tr.header_extra())
+        if self._fx is not None and self._fx_tok is not None and \
+                hdr.get("sig") is not None:
+            # the ONE chokepoint every op's resolved options pass
+            # through: the signature hash lands on the ledger row so a
+            # cross-rank audit can diff what each rank actually sent
+            self._fx.note(self._fx_tok,
+                          sig=forensics.sig_hash(hdr["sig"]))
         self._ph = "hdr"
         headers = {self.rank: hdr}
         frame = dumps_oob(hdr)
@@ -1199,7 +1223,8 @@ class RingReducer:
         else:
             self._write(arr.data.cast("B"))
 
-    def _begin(self, op: Optional[str], quantize, wire_dtype):
+    def _begin(self, op: Optional[str], quantize, wire_dtype,
+               kind: str = "allreduce"):
         """Resolve + validate per-round options BEFORE any frame moves
         (a bad option discovered mid-phase would waste a collective
         round on every rank). Returns the resolved op; sets the round's
@@ -1218,6 +1243,8 @@ class RingReducer:
         self._qmax = 0.0
         self._wrote = 0
         self._tr_err = None
+        self._fx_tok = None   # cleared FIRST: a validation raise below
+        #                       must not leave _finish a stale token
         self._ph = "hdr"
         self._seg_tx = self._seg_rx = -1
         if self._tr is not None:
@@ -1239,6 +1266,14 @@ class RingReducer:
         self._codec = _make_codec(q, wdt)
         if self._tr is not None:
             self._tr.options(op, self._codec.tag if self._codec else None)
+        if self._fx is not None:
+            # ledger enter AFTER option validation: a raise above never
+            # reaches the wire, so it must not leave an in_flight row
+            g = self.group or "ring"
+            self._fx_tok = self._fx.enter(
+                group=g, kind=kind, seq=self._fx.next_seq(g), op=op,
+                codec=self._codec.tag if self._codec else None,
+                step=self.step, size=self.size)
         return op
 
     def _finish(self, key: str, t0: float):
@@ -1259,6 +1294,18 @@ class RingReducer:
                 self._tr.end(key, self._wrote, self._tr_err)
             except Exception:
                 pass
+        if self._fx is not None and self._fx_tok is not None:
+            try:            # ledger close rides the same clock read
+                self._fx.exit(
+                    self._fx_tok,
+                    state="done" if self._tr_err is None else "aborted",
+                    err=None if self._tr_err is None
+                    else f"{type(self._tr_err).__name__}: "
+                         f"{self._tr_err}",
+                    nbytes=self._wrote)
+            except Exception:
+                pass
+            self._fx_tok = None
 
     # --- in-situ auto-tuning (dag/tuner.py) ------------------------------
 
@@ -1457,7 +1504,8 @@ class RingReducer:
             # option resolution INSIDE the try: a rank-local failure
             # ships as an error frame and reaches every peer in one
             # header relay instead of stalling them to ring timeout
-            op = self._begin(op, quantize, _UNSET)
+            op = self._begin(op, quantize, _UNSET,
+                             kind="reduce_scatter")
             leaves, rebuild, sig = _flatten(value)
             wire = _wire_dtype([l.dtype for l in leaves], op) \
                 if leaves else np.dtype(np.float32)
@@ -1532,7 +1580,7 @@ class RingReducer:
             # ships as an error frame and reaches every peer in one
             # header relay, instead of leaving them blocked for the
             # full ring timeout
-            self._begin(None, _UNSET, wire_dtype)
+            self._begin(None, _UNSET, wire_dtype, kind="allgather")
             shard = np.ascontiguousarray(np.asarray(shard)).reshape(-1)
             layout = self._layout if rebuild else None
             if layout is not None:
@@ -1607,7 +1655,8 @@ class RingReducer:
         err_frame = None
         arr = None
         try:
-            self._begin(None, None, None)   # broadcasts ship raw bytes
+            self._begin(None, None, None,   # broadcasts ship raw bytes
+                        kind="broadcast")
             if self._tr is not None and self._tr.cur is not None:
                 self._tr.cur["level"] = "bcast"
                 self._tr.options("bcast", None)

@@ -309,6 +309,15 @@ class LLMEngine:
         self._tokens_generated = 0
         self._ttft_sum = 0.0
         self._ttft_count = 0
+        # Postmortem bundles snapshot engine state through a weakref —
+        # the provider must not keep a dead engine (and its KV cache)
+        # alive, and a collected engine silently drops out of dumps.
+        import weakref
+        from ray_tpu.util import forensics
+        ref = weakref.ref(self)
+        forensics.register_state_provider(
+            f"llm_engine:{id(self):x}",
+            lambda: (lambda e: e.stats if e is not None else None)(ref()))
 
     @property
     def stats(self) -> dict:
@@ -514,6 +523,11 @@ class LLMEngine:
 
     async def stop(self):
         self._stopped = True
+        try:
+            from ray_tpu.util import forensics
+            forensics.unregister_state_provider(f"llm_engine:{id(self):x}")
+        except Exception:  # noqa: BLE001
+            pass
         if self._loop_task is not None:
             # The loop may be parked awaiting new work — cancel wakes it.
             self._loop_task.cancel()

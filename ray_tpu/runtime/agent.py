@@ -139,6 +139,7 @@ class NodeAgent:
             "clock_probe": self.clock_probe,
             "report_events": self.report_events,
             "profile_worker": self.profile_worker,
+            "node_forensics": self.node_forensics,
             "ping": self.ping,
         }
 
@@ -395,6 +396,32 @@ class NodeAgent:
         r["worker_id"] = w.worker_id.hex()
         r["node_id"] = self.node_id.hex()
         return r
+
+    async def node_forensics(self, timeout_s: float = 10.0):
+        """The autopsy fan-out's node leg: this agent's own forensics
+        dump plus one ``forensics_dump`` pull per live worker process
+        on this node (concurrently — one wedged worker must not
+        serialize the others). Per-worker failures degrade to error
+        rows: on a hung node the absence of an answer is itself
+        evidence."""
+        from ray_tpu.util import forensics
+        out = {"node_id": self.node_id.hex(),
+               "agent": forensics.local_dump(), "workers": {}}
+        live = [(wid.hex(), w) for wid, w in self.workers.items()
+                if w.state != DEAD and w.addr is not None]
+
+        async def pull(wid, w):
+            try:
+                r = await self.pool.call(w.addr, "forensics_dump",
+                                         timeout=float(timeout_s))
+            except Exception as e:  # noqa: BLE001 — evidence, not fatal
+                r = {"error": f"{type(e).__name__}: {e}",
+                     "pid": w.proc.pid if w.proc is not None else None}
+            out["workers"][wid] = r
+
+        if live:
+            await asyncio.gather(*(pull(wid, w) for wid, w in live))
+        return out
 
     async def node_stats(self):
         return {"node_id": self.node_id,

@@ -188,6 +188,7 @@ class ControlService:
             "cluster_view": self.cluster_view,
             "report_metrics": self.report_metrics,
             "profile_target": self.profile_target,
+            "autopsy": self.autopsy,
             "health_state": self.health_state,
             "query_series": self.query_series,
             "ping": self.ping,
@@ -1183,6 +1184,64 @@ class ControlService:
         r.pop("found", None)
         r.setdefault("target", {"pid": pid, "node_id": n.node_id.hex()})
         return r
+
+    async def autopsy(self, stall_timeout_s: float = 0.0) -> dict:
+        """One-command postmortem: fan ``node_forensics`` out to every
+        alive agent (each agent pulls stacks + collective ledgers +
+        engine state from its own workers), run the cross-rank ledger
+        audit over whatever came back, and write one atomic
+        ``postmortem-*.json`` bundle on the head. Nodes that fail to
+        answer are recorded as error rows — on a hung cluster the
+        silence IS the finding. Returns the bundle path plus the
+        audit's findings so the CLI can print a diagnosis without
+        re-opening the file."""
+        from ray_tpu.util import events as _ev
+        from ray_tpu.util import forensics
+
+        async def pull(n):
+            try:
+                return n.node_id.hex(), await self.pool.call(
+                    n.addr, "node_forensics", timeout=30.0)
+            except Exception as e:  # noqa: BLE001 — evidence, not fatal
+                return n.node_id.hex(), \
+                    {"error": f"{type(e).__name__}: {e}"}
+
+        alive = [n for n in list(self.nodes.values()) if n.alive]
+        results = await asyncio.gather(*[pull(n) for n in alive])
+        nodes = {nid: dump for nid, dump in results}
+
+        # Cross-rank audit over every worker dump that carries a rank
+        # (train workers stamp one; bare task workers stay rank -1 and
+        # only contribute stacks).
+        ledgers: Dict[int, dict] = {}
+        for dump in nodes.values():
+            if not isinstance(dump, dict):
+                continue
+            for w in (dump.get("workers") or {}).values():
+                r = w.get("rank", -1) if isinstance(w, dict) else -1
+                snap = w.get("ledger") if isinstance(w, dict) else None
+                if isinstance(r, int) and r >= 0 \
+                        and isinstance(snap, dict) and "entries" in snap:
+                    ledgers[r] = snap
+        tmo = float(stall_timeout_s) if stall_timeout_s else \
+            float(self.config.forensics_stall_timeout_s)
+        findings = forensics.audit(ledgers, stall_timeout_s=tmo) \
+            if ledgers else []
+        payload = {
+            "trigger": "autopsy",
+            "findings": [dict(f) for f in findings],
+            "nodes": nodes,
+            "head_events": _ev.dump()[-512:],
+        }
+        try:
+            path = forensics.write_bundle(payload)
+        except Exception as e:  # noqa: BLE001 — diagnosis beats bundle
+            path = None
+            payload["bundle_error"] = f"{type(e).__name__}: {e}"
+        _ev.record("forensics", "bundle", trigger="autopsy", path=path,
+                   findings=len(findings))
+        return {"path": path, "findings": payload["findings"],
+                "nodes": sorted(nodes), "ranks": sorted(ledgers)}
 
     async def report_node_events(self, events: list) -> dict:
         """A stopping node archives its span buffer here so the cluster

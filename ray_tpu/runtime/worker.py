@@ -96,6 +96,7 @@ class WorkerExecutor:
         ctx.server.add_handler("shutdown_worker", self.shutdown_worker)
         ctx.server.add_handler("dump_stacks", self.dump_stacks)
         ctx.server.add_handler("profile", self.profile)
+        ctx.server.add_handler("forensics_dump", self.forensics_dump)
 
     # --- live profiling (util/profiling.py over the control plane) ----
 
@@ -105,6 +106,15 @@ class WorkerExecutor:
         py-spy dump through dashboard/modules/reporter/)."""
         from ray_tpu.util import profiling
         return {"pid": os.getpid(), "stacks": profiling.dump_stacks()}
+
+    async def forensics_dump(self):
+        """This process's postmortem contribution (util/forensics.py):
+        collective ledger + stacks + goodput rows + HBM snapshot +
+        registered engine state. Served off the control-plane loop, so
+        it answers while hosted actors are wedged in a hung
+        collective — the property the autopsy fan-out relies on."""
+        from ray_tpu.util import forensics
+        return forensics.local_dump()
 
     async def profile(self, duration_s: float = 2.0, hz: int = 100):
         """Sample this process's stacks for duration_s at hz; returns

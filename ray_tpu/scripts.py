@@ -468,6 +468,37 @@ def cmd_stack(args) -> int:
     return 0
 
 
+def cmd_autopsy(args) -> int:
+    """One-command postmortem (`ray-tpu autopsy`): the head fans a
+    forensics pull out to every agent, each agent pulls its workers,
+    the cross-rank ledger audit names the culprit, and one atomic
+    postmortem-*.json bundle lands on the head. Prints the diagnosis
+    and the bundle path."""
+    addr = _resolve_address(args)
+    r = _call_head(addr, "autopsy",
+                   stall_timeout_s=args.stall_timeout, timeout=90.0)
+    if not isinstance(r, dict):
+        print(f"autopsy failed: {r!r}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(r, indent=2, default=str))
+        return 0
+    findings = r.get("findings") or []
+    ranks = r.get("ranks") or []
+    print(f"autopsy: {len(r.get('nodes') or [])} node(s), "
+          f"{len(ranks)} ranked worker(s) audited")
+    if findings:
+        for f in findings:
+            print(f"  {f.get('kind')}: {f.get('detail')} "
+                  f"(culprits: {f.get('culprits')})")
+    else:
+        print("  no stall/desync findings — see bundle for stacks "
+              "and ledgers")
+    if r.get("path"):
+        print(f"bundle: {r['path']}")
+    return 0
+
+
 def cmd_profile(args) -> int:
     """Sample a live worker/actor's stacks over the control plane and
     write folded stacks (flamegraph.pl input) or speedscope JSON."""
@@ -951,6 +982,18 @@ def main(argv=None) -> int:
     pc.add_argument("--json", action="store_true")
     pc.add_argument("--limit", type=int, default=50)
     pc.set_defaults(fn=cmd_collectives)
+
+    pa = sub.add_parser(
+        "autopsy",
+        help="one-command postmortem: pull every rank's stacks + "
+             "collective ledger, audit for stalls/desyncs, write a "
+             "postmortem-*.json bundle")
+    pa.add_argument("--address")
+    pa.add_argument("--json", action="store_true")
+    pa.add_argument("--stall-timeout", type=float, default=0.0,
+                    help="in-flight age (s) that counts as stalled in "
+                         "the audit (default: forensics_stall_timeout_s)")
+    pa.set_defaults(fn=cmd_autopsy)
 
     pj = sub.add_parser("job", help="submit / inspect entrypoint jobs")
     jsub = pj.add_subparsers(dest="job_cmd", required=True)

@@ -51,11 +51,18 @@ def peer_lost_error(e) -> PeerLostError:
 
 
 class _Rendezvous:
-    """Named actor holding per-epoch barrier/broadcast state."""
+    """Named actor holding per-epoch barrier/broadcast state, plus the
+    pre-flight desync guard's per-collective options-signature posts
+    (forensics_verify_level): tiny descriptors, bounded keys."""
+
+    _DESC_KEYS = 512    # oldest verify keys age out (opt-in debugging
+    #                     lever — long "round"-level runs must not grow
+    #                     the actor without bound)
 
     def __init__(self):
         self._barriers: dict = {}
         self._values: dict = {}
+        self._descs: dict = {}
 
     def arrive(self, key: str, rank: int, world: int) -> bool:
         s = self._barriers.setdefault(key, set())
@@ -72,6 +79,15 @@ class _Rendezvous:
     def get_value(self, key: str):
         return ("ok", self._values[key]) if key in self._values \
             else ("pending", None)
+
+    def put_desc(self, key: str, rank: int, desc: str) -> bool:
+        self._descs.setdefault(key, {})[int(rank)] = str(desc)
+        while len(self._descs) > self._DESC_KEYS:
+            self._descs.pop(next(iter(self._descs)))
+        return True
+
+    def get_descs(self, key: str) -> dict:
+        return dict(self._descs.get(key, {}))
 
 
 def _rendezvous_handle():
@@ -469,6 +485,118 @@ def _ring_call(ctx, timeout_s: Optional[float], fn,
         raise peer_lost_error(e) from e
 
 
+# --- pre-flight desync guard (util/forensics.py) -------------------------
+
+
+def preflight_verify(ctx, desc: str,
+                     timeout_s: Optional[float] = None) -> None:
+    """Opt-in options-signature agreement BEFORE entering a collective
+    (Config.forensics_verify_level: "off" | "step" | "round").
+
+    The ring's own header relay already catches same-round option
+    mismatches — but only once every rank has ENTERED the round, which
+    is exactly what a conditional desync (ranks issuing different
+    collective sequences, the PR 19 ``codec="auto"`` bug class)
+    prevents: the ring hangs to its full timeout instead. This guard
+    rides the rendezvous ACTOR plane, not the ring: every rank posts a
+    descriptor of the collective it is about to issue under a
+    lockstep-counted key and polls for the group with a deadline, so
+    both failure shapes get a typed, named diagnosis in seconds —
+    ``CollectiveDesyncError`` ("rank 0 int4 vs rank 2 fp32") when the
+    descriptors differ, ``CollectiveStallError`` ("rank 3 never posted
+    ...") when a rank never arrives.
+
+    "step" verifies once per collective_step (first collective of the
+    step); "round" verifies every call. Each check is one actor round
+    trip — a debugging lever, not a default (see the PERF.md runbook).
+    """
+    from ray_tpu.config import get_config
+    from ray_tpu.util import events, forensics
+    cfg = get_config()
+    level = str(getattr(cfg, "forensics_verify_level", "off") or "off")
+    if level not in ("step", "round"):
+        if level != "off":
+            raise ValueError(
+                f"forensics_verify_level must be 'off', 'step' or "
+                f"'round', got {level!r}")
+        return
+    if ctx.get_world_size() == 1:
+        return
+    step = int(getattr(ctx, "collective_step", 0) or 0)
+    if level == "step" and getattr(ctx, "_fx_verified_step", None) == step:
+        return
+    # the verify sequence counts CHECKS, not collectives: lockstep as
+    # long as every rank issues the same call sequence — which is the
+    # invariant being verified
+    seq = int(getattr(ctx, "_fx_verify_seq", 0))
+    ctx._fx_verify_seq = seq + 1
+    key = f"{ctx.group_id}:fxv:{step if level == 'step' else seq}"
+    world, rank = ctx.get_world_size(), ctx.get_world_rank()
+    tmo = float(timeout_s if timeout_s is not None else
+                getattr(cfg, "forensics_stall_timeout_s", 60.0))
+    h = _rendezvous_handle()
+    ray_tpu.get(h.put_desc.remote(key, rank, desc), timeout=tmo)
+    deadline = time.monotonic() + tmo
+    descs: dict = {}
+    while True:
+        descs = {int(r): d for r, d in ray_tpu.get(
+            h.get_descs.remote(key), timeout=tmo).items()}
+        if len(descs) >= world or time.monotonic() >= deadline:
+            break
+        time.sleep(0.02)
+    group = f"verify:{ctx.group_id[:8]}"
+    if len(descs) < world:
+        missing = sorted(set(range(world)) - set(descs))
+        who = ", ".join(f"rank {r}" for r in missing)
+        detail = (f"{who} never entered seq {seq} of group {group} "
+                  f"within {tmo:.0f}s (parked before the collective, "
+                  f"or issuing a different collective sequence); "
+                  f"this rank was about to issue: {desc}")
+        events.record("forensics", "collective_stall", group=group,
+                      seq=seq, step=step, culprits=missing,
+                      detail=detail, rank=rank)
+        raise forensics.CollectiveStallError(
+            f"pre-flight verify: {detail}", group=group, seq=seq,
+            culprits=missing)
+    if len(set(descs.values())) > 1:
+        variants: dict = {}
+        for r in sorted(descs):
+            variants.setdefault(descs[r], []).append(r)
+        culprits = sorted(min(variants.values(), key=len)) \
+            if len(variants) == 2 and \
+            len(set(map(len, variants.values()))) > 1 \
+            else sorted(descs)
+        detail = (f"seq {seq} options-signature mismatch on group "
+                  f"{group}: " + " vs ".join(
+                      f"rank {rs[0]} {d}" for d, rs in variants.items()))
+        events.record("forensics", "collective_desync", group=group,
+                      seq=seq, step=step, culprits=culprits,
+                      detail=detail, rank=rank)
+        raise forensics.CollectiveDesyncError(
+            f"pre-flight verify: {detail}", group=group, seq=seq,
+            culprits=culprits)
+    if level == "step":
+        ctx._fx_verified_step = step
+
+
+def _pre_collective(ctx, kind: str, desc: str,
+                    timeout_s: Optional[float] = None) -> None:
+    """The forensics front door every train-plane collective passes:
+    an ``enqueued`` intent row on this rank's ledger (written BEFORE
+    the ring round opens its own in_flight row — a rank that parks
+    between enqueue and enter still shows intent in the audit), then
+    the opt-in pre-flight verify."""
+    try:
+        from ray_tpu.util import forensics
+        forensics.record_enqueued(
+            group=f"train:{getattr(ctx, 'group_id', '')[:8]}",
+            kind=kind, step=getattr(ctx, "collective_step", None),
+            detail=desc)
+    except Exception:   # noqa: BLE001 — bookkeeping must never block
+        pass
+    preflight_verify(ctx, desc, timeout_s=timeout_s)
+
+
 # codec= names the WHOLE wire policy in one arg; each concrete tag
 # maps to the (quantize, wire_dtype) pair the ring understands
 _CODEC_NAMES = ("auto", "int4", "int8", "bf16", "fp32")
@@ -656,6 +784,10 @@ def allreduce_gradients(value: Any, op: str = "mean", *,
     ctx = get_context()
     if bucket_bytes is not None and bucket_bytes <= 0:
         raise ValueError("bucket_bytes must be > 0")
+    _pre_collective(
+        ctx, "allreduce",
+        f"allreduce:op={op}:quantize={quantize}:wire={wire_dtype}:"
+        f"codec={codec}:bucket={bucket_bytes}", timeout_s)
     if codec is not None:
         if quantize is not None or wire_dtype is not None:
             raise ValueError(
@@ -719,6 +851,10 @@ def reduce_scatter_gradients(value: Any, op: str = "mean", *,
     ctx = get_context()
     if bucket_bytes is not None and bucket_bytes <= 0:
         raise ValueError("bucket_bytes must be > 0")
+    _pre_collective(
+        ctx, "reduce_scatter",
+        f"reduce_scatter:op={op}:quantize={quantize}:"
+        f"bucket={bucket_bytes}", timeout_s)
     if ctx.get_world_size() == 1:
         _validate_codec_opts(value, op, quantize, None)
         import numpy as np
@@ -777,6 +913,11 @@ def allgather_params(shard, *, wire_dtype: Optional[str] = None,
     ctx = get_context()
     if bucket_bytes is not None and bucket_bytes <= 0:
         raise ValueError("bucket_bytes must be > 0")
+    # the descriptor names OPTIONS only, never per-rank values (shard
+    # lengths legitimately differ across ranks)
+    _pre_collective(
+        ctx, "allgather",
+        f"allgather:wire={wire_dtype}:bucket={bucket_bytes}", timeout_s)
     if ctx.get_world_size() == 1:
         import numpy as np
         from ray_tpu.dag.ring import resolve_wire_dtype

@@ -29,6 +29,16 @@ def _goodput_anatomy():
         return None
 
 
+def _forensics_summary():
+    """In-flight collective rows for poll() — never raises, tiny
+    (full ledgers only move on an explicit forensics_dump pull)."""
+    try:
+        from ray_tpu.util import forensics
+        return forensics.poll_summary()
+    except Exception:   # noqa: BLE001
+        return None
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("", 0))
@@ -118,8 +128,10 @@ class TrainWorker:
 
         def run():
             set_context(self.ctx)
-            from ray_tpu.util import goodput
+            from ray_tpu.util import forensics, goodput
             goodput.set_rank(self.rank)
+            forensics.set_rank(self.rank)
+            forensics.set_meta(group_id=group_id)
             try:
                 if train_loop_config is not None:
                     self._result = fn(train_loop_config)
@@ -169,7 +181,23 @@ class TrainWorker:
                 # rolling step-anatomy summary (util/goodput.py): p50
                 # per category over the window — the controller's
                 # straggler detector compares these across the ring
-                "goodput": _goodput_anatomy()}
+                "goodput": _goodput_anatomy(),
+                # in-flight collective descriptors + per-group issue
+                # counters (util/forensics.py): the stall watchdog's
+                # cheap signal — the controller only pulls full
+                # ledgers (forensics_dump) when one of these ages
+                # past forensics_stall_timeout_s
+                "forensics": _forensics_summary()}
+
+    def forensics_dump(self) -> Dict[str, Any]:
+        """Everything this worker contributes to a postmortem bundle:
+        full collective ledger, thread stacks, goodput rows, HBM
+        snapshot, registered engine state (util/forensics.local_dump).
+        Runs on the actor thread, so it works while the train_fn
+        thread is parked inside a hung collective — that is the whole
+        point."""
+        from ray_tpu.util import forensics
+        return forensics.local_dump()
 
     # --- elastic reshape -------------------------------------------------
 

@@ -362,6 +362,19 @@ class ShardedOptimizer:
             # the timeline's ring lanes group by it, and a straggler
             # row names the step it stalled
             g.step = self._step
+        if g is not None:
+            ctx = self._ctx()
+            if ctx is not None:
+                # forensics front door (ledger intent row + opt-in
+                # pre-flight options agreement): one check covers both
+                # halves of the update — the RS and AG ride the same
+                # option set, so a desync would already differ here
+                from ray_tpu.train.collective import _pre_collective
+                _pre_collective(
+                    ctx, "zero_update",
+                    f"zero_update:quantize={self.grad_quantize}:"
+                    f"wire={self.param_wire_dtype}:"
+                    f"bucket={self.bucket_bytes}")
         # ONE structure walk per step: leaves feed the wire dtype, the
         # total, the owned-slice copy, and the final rebuild
         leaves, rebuild, _ = _flatten(params)

@@ -45,6 +45,7 @@ _NAV = ("<nav><a href='/'>overview</a><a href='/nodes'>nodes</a>"
         "<a href='/health'>health</a>"
         "<a href='/history'>history</a>"
         "<a href='/profile'>profile</a>"
+        "<a href='/autopsy'>autopsy</a>"
         "<a href='/metrics'>metrics</a></nav>")
 
 
@@ -732,6 +733,59 @@ async def _history(fetch: Fetch, query: str = "") -> bytes:
     return _page("history", body)
 
 
+# --- autopsy (hang & desync forensics) ---------------------------------
+
+
+async def _autopsy(fetch: Fetch, query: str = "") -> bytes:
+    """One-click postmortem: ?run=1 triggers the head's autopsy RPC
+    (every agent pulls its workers' stacks + collective ledgers, the
+    cross-rank audit names the culprit, one bundle lands on the head)
+    and renders the findings. The index page just explains + links —
+    an autopsy is a cluster-wide fan-out, not something to fire on
+    every 5s auto-refresh."""
+    from urllib.parse import parse_qs
+    q = parse_qs(query or "")
+    if (q.get("run") or ["0"])[0] not in ("1", "true"):
+        body = (
+            "<p>Pulls every rank's thread stacks, collective ledger, "
+            "engine state and recent events in one fan-out, runs the "
+            "cross-rank stall/desync audit, and writes an atomic "
+            "<code>postmortem-&lt;step&gt;.json</code> bundle on the "
+            "head.</p>"
+            "<p><a href='/autopsy?run=1'><b>run autopsy now</b></a> "
+            "&mdash; CLI: <code>ray-tpu autopsy</code></p>"
+            "<p class=dim>Tune with <code>forensics_stall_timeout_s"
+            "</code> / <code>forensics_dir</code>; the stall watchdog "
+            "fires this automatically when a rank's ledger shows a "
+            "collective in flight past the timeout. On a badly hung "
+            "cluster prefer the CLI &mdash; dashboard fetches carry a "
+            "10s RPC timeout.</p>")
+        return _page("autopsy", body, refresh=False)
+    r = await fetch("autopsy")
+    if not isinstance(r, dict):
+        return _page("autopsy",
+                     f"<p class=bad>autopsy failed: {_esc(repr(r))}"
+                     "</p>", refresh=False)
+    findings = r.get("findings") or []
+    rows = [(f"<span class=bad>{_esc(f.get('kind'))}</span>",
+             _esc(f.get("group")), _esc(f.get("seq")),
+             _esc(f.get("culprits")), _esc(f.get("detail")))
+            for f in findings]
+    body = (f"<p>{len(r.get('nodes') or [])} node(s), "
+            f"{len(r.get('ranks') or [])} ranked worker(s) audited "
+            f"&mdash; bundle: <code>{_esc(r.get('path') or '?')}"
+            f"</code></p>")
+    if rows:
+        body += _table(("finding", "group", "seq", "culprits",
+                        "diagnosis"), rows)
+    else:
+        body += ("<p class=ok>no stall/desync findings &mdash; the "
+                 "bundle still holds every rank's stacks and ledger"
+                 "</p>")
+    body += "<p><a href='/autopsy?run=1'>run again</a></p>"
+    return _page("autopsy", body, refresh=False)
+
+
 # --- live profiler -----------------------------------------------------
 
 
@@ -810,7 +864,8 @@ _PAGES = {"/": _overview, "/overview": _overview, "/nodes": _nodes,
           "/serve": _serve, "/tasks": _tasks, "/traces": _traces,
           "/devices": _devices, "/goodput": _goodput,
           "/health": _health,
-          "/history": _history, "/profile": _profile}
+          "/history": _history, "/profile": _profile,
+          "/autopsy": _autopsy}
 
 
 async def render(path: str, fetchers, query: str = "") -> Optional[bytes]:

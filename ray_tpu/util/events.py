@@ -61,9 +61,13 @@ from typing import Deque, Dict, List
 #   goodput     step-anatomy ledger (util/goodput.py): one "step" span
 #               per training step with the category breakdown, plus
 #               controller-side "straggler" instants naming the rank
+#   forensics   hang/desync diagnoses (util/forensics.py): typed
+#               collective_stall / collective_desync instants naming
+#               the culprit rank, plus autopsy/bundle markers
 CATEGORIES = ("trace", "collective", "train", "worker", "cgroup",
               "memory", "request", "device", "device_window",
-              "pipeline", "health", "ckpt", "serve", "goodput")
+              "pipeline", "health", "ckpt", "serve", "goodput",
+              "forensics")
 
 _DEFAULT_CAP = 65536
 # Dedicated sub-budgets: the key also names the bucket. Everything
@@ -102,7 +106,12 @@ _CATEGORY_CAPS: Dict[str, int] = {"collective": 16384, "train": 4096,
                                   # one span per training step — a
                                   # long run's anatomy must age out
                                   # against itself, not the task spans
-                                  "goodput": 4096}
+                                  "goodput": 4096,
+                                  # stall/desync diagnoses + audit
+                                  # instants are rare, but a watchdog
+                                  # firing every poll during a long
+                                  # hang must age against itself
+                                  "forensics": 2048}
 
 _BUFS: Dict[str, Deque[dict]] = {}
 _LOCK = threading.Lock()

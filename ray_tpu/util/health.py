@@ -295,6 +295,14 @@ class HealthEngine:
                 description="a rank's p50 step anatomy diverges from "
                             "the ring beyond goodput_straggler_z "
                             "(-1 = healthy)"))
+        if "forensics_stall_rank" in names:
+            out.append(Objective(
+                name="collective_stall", kind="gauge",
+                metric="forensics_stall_rank", threshold=-0.5,
+                direction="above",
+                description="the forensics watchdog named a culprit "
+                            "rank for a stalled/desynced collective "
+                            "(-1 = healthy); run `ray-tpu autopsy`"))
         if "device_hbm_used_bytes" in names \
                 and "device_hbm_limit_bytes" in names:
             out.append(Objective(

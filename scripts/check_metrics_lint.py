@@ -115,6 +115,8 @@ def instantiate_all() -> dict:
     take(health.health_metrics())
     from ray_tpu.util import goodput
     take(goodput.goodput_metrics())
+    from ray_tpu.util import forensics
+    take(forensics.forensics_metrics())
     return out
 
 
@@ -206,12 +208,16 @@ GOODPUT_METRIC_PREFIXES = ("goodput_", "train_mfu")
 # one gauge labelled {codec=int8|int4|bf16|fp16|fp32} — a call site
 # inventing a sibling series must register it the same way.
 COLLECTIVE_METRIC_PREFIXES = ("allreduce_quant_",)
+# ``forensics_`` is the hang/desync forensics family (util/forensics.py:
+# the stall-rank sentinel gauge + audit/bundle counters).
+FORENSICS_METRIC_PREFIXES = ("forensics_",)
 METRIC_FAMILY_PREFIXES = (DEVICE_METRIC_PREFIXES
                           + HEALTH_METRIC_PREFIXES
                           + CKPT_METRIC_PREFIXES
                           + SERVE_METRIC_PREFIXES
                           + GOODPUT_METRIC_PREFIXES
-                          + COLLECTIVE_METRIC_PREFIXES)
+                          + COLLECTIVE_METRIC_PREFIXES
+                          + FORENSICS_METRIC_PREFIXES)
 
 # prefixed literals that are NOT metric names: control RPC method
 # names etc. (Config knob names are exempted wholesale below — the
@@ -228,7 +234,10 @@ EXEMPT_METRIC_LITERALS = {"health_state",
                           "goodput_straggler",
                           # jax device attribute probed via getattr
                           # (util/goodput.py), not a series name
-                          "device_kind"}
+                          "device_kind",
+                          # worker RPC method name for the autopsy
+                          # ledger pull (runtime/worker.py, agent.py)
+                          "forensics_dump"}
 
 _DEVICE_METRIC_RE = re.compile(
     r"""['"]((?:%s)[a-z0-9_]+)['"]"""
@@ -334,6 +343,10 @@ KNOB_FAMILIES = {
     # (codec_error_feedback) — train/collective.py + dag/tuner.py.
     # A family may enumerate SEVERAL (prefix, suffix) pairs.
     "codec": (("collective_codec", ""), ("codec_error_feedback", "")),
+    # hang & desync forensics: ledger switch/size, stall-watchdog
+    # timeout, pre-flight verify level, bundle dir (util/forensics.py,
+    # train/collective.py preflight, train/controller.py watchdog)
+    "forensics": ("forensics_", ""),
 }
 
 

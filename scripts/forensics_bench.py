@@ -1,0 +1,157 @@
+"""Forensics ledger overhead A/B: ring allreduce with the collective
+ledger on vs off.
+
+Method: the COLLECTIVE_TRACE_BENCH recipe — reps INTERLEAVED
+(off, on, off, on, ...) so drift hits both arms equally; the headline
+is best-of-reps round time per arm. The workload is the exact path
+the ledger instruments: thread-participant ring allreduce over shm
+channels (dag/ring.py), where per-round ledger cost (two dict writes
++ one blake2s of the header signature) has no model time to hide
+behind.
+
+Arms:
+  off  RAY_TPU_FORENSICS_LEDGER=0 (rings skip the ledger entirely)
+  on   default: every round writes enter/exit descriptors + the
+       options-signature hash to the process ledger
+
+enabled() is resolved at ring construction, so each (rep, arm) runs
+in a fresh subprocess.
+
+Run from the repo root: python scripts/forensics_bench.py
+Commit the aggregate JSON to FORENSICS_BENCH.json.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def one_run(size_mb: int, participants: int, rounds: int) -> dict:
+    import numpy as np
+
+    from ray_tpu.dag.channel import ShmRingChannel
+    from ray_tpu.dag.ring import RingReducer
+    from ray_tpu.util import forensics
+
+    n = participants
+    nelem = size_mb * (1 << 20) // 4
+    chans = [ShmRingChannel(create=True, nslots=4,
+                            slot_bytes=(nelem * 4) // n + (1 << 16))
+             for _ in range(n)]
+    reds = [RingReducer(chans[r], chans[(r - 1) % n], rank=r, size=n,
+                        timeout_s=120.0, group="fxbench")
+            for r in range(n)]
+    vals = [np.full(nelem, float(r + 1), np.float32) for r in range(n)]
+    from concurrent.futures import ThreadPoolExecutor
+    try:
+        with ThreadPoolExecutor(n) as ex:
+            # warm: channel attach, first-round header relay
+            list(ex.map(lambda red: red.reduce(vals[red.rank], op="sum"),
+                        reds))
+            times = []
+            for _ in range(rounds):
+                t0 = time.monotonic()
+                outs = list(ex.map(
+                    lambda red: red.reduce(vals[red.rank], op="sum"),
+                    reds))
+                times.append(time.monotonic() - t0)
+            assert abs(outs[0][0] - n * (n + 1) / 2) < 1e-3
+        led = len(forensics.ledger().snapshot()) \
+            if forensics.enabled() else 0
+        best = min(times)
+        return {
+            "size_mb": size_mb, "participants": n, "rounds": rounds,
+            "round_s": round(best, 4),
+            "algbw_gbps": round(nelem * 4 / best / 1e9, 3),
+            "ledger_rows": led,
+        }
+    finally:
+        for c in chans:
+            c.close()
+            c.unlink()
+
+
+ARMS = {
+    "off": {"RAY_TPU_FORENSICS_LEDGER": "0"},
+    "on": {},
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--participants", type=int, default=4)
+    ap.add_argument("--sizes-mb", default="8,64")
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--one-run", action="store_true",
+                    help="internal: run one arm in THIS process and "
+                         "print its JSON lines")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the aggregate JSON here too")
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.sizes_mb.split(",") if s]
+    if args.one_run:
+        for size in sizes:
+            print("RESULT " + json.dumps(
+                one_run(size, args.participants, args.rounds)))
+        return 0
+    results = []
+    for rep in range(args.reps):
+        for arm, env in ARMS.items():       # interleaved: off, on, ...
+            child_env = dict(os.environ)
+            child_env.pop("PYTHONPATH", None)
+            child_env.update(env)
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--one-run", "--participants", str(args.participants),
+                 "--sizes-mb", args.sizes_mb,
+                 "--rounds", str(args.rounds)],
+                env=child_env, capture_output=True, text=True,
+                timeout=900)
+            lines = [ln for ln in p.stdout.splitlines()
+                     if ln.startswith("RESULT ")]
+            if p.returncode != 0 or len(lines) != len(sizes):
+                print(p.stdout[-2000:], p.stderr[-2000:],
+                      file=sys.stderr)
+                raise RuntimeError(f"run failed: rep={rep} arm={arm}")
+            for ln in lines:
+                r = {"arm": arm, "rep": rep, **json.loads(ln[7:])}
+                print(json.dumps(r))
+                results.append(r)
+    big = max(sizes)
+
+    def best(arm, size):
+        return min((r for r in results
+                    if r["arm"] == arm and r["size_mb"] == size),
+                   key=lambda r: r["round_s"])
+
+    agg = {
+        "bench": "forensics_ledger_overhead",
+        "method": "min-of-reps interleaved thread-ring allreduce over "
+                  "shm (best rep per arm; ledger cost has no model "
+                  "time to hide behind)",
+        "participants": args.participants,
+        "rounds": args.rounds,
+        "reps": args.reps,
+        "results": results,
+        "best_round_s": {
+            f"{a}_{s}mb": best(a, s)["round_s"]
+            for a in ARMS for s in sizes},
+        f"on_vs_off_{big}mb_{args.participants}p": round(
+            best("on", big)["round_s"] / best("off", big)["round_s"], 4),
+    }
+    print(json.dumps(agg, indent=2))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(agg, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,6 +113,19 @@ def test_cli_cluster_end_to_end(cli_cluster):
     r = _cli(env, "stack", "not_an_actor", "--address", address)
     assert r.returncode == 1 and "no live actor" in r.stderr
 
+    # one-command postmortem over the same control plane: every agent
+    # pulls its workers' stacks + collective ledgers, and one
+    # postmortem-*.json bundle lands on the head
+    r = _cli(env, "autopsy", "--address", address)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "node(s)" in r.stdout and "bundle: " in r.stdout
+    bundle = r.stdout.rsplit("bundle: ", 1)[1].strip()
+    with open(bundle) as f:
+        doc = json.load(f)
+    assert doc["trigger"] == "autopsy"
+    assert doc["nodes"] and all("agent" in d for d in
+                                doc["nodes"].values())
+
 
 def test_cli_stop_kills_nodes(cli_cluster):
     address, env = cli_cluster
